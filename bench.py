@@ -1,0 +1,110 @@
+"""Headline benchmark: Llama decoder training throughput on one TPU chip.
+
+Prints ONE JSON line: tokens/sec/chip for a full fwd+bwd+adamw train step on a
+350M-param Llama config (bf16 compute, f32 masters, remat, flash attention).
+`vs_baseline` is model FLOPs utilization (6*N*tokens FLOPs) against the
+north-star 45% MFU anchor from BASELINE.md.
+"""
+
+import json
+import os
+import sys
+import time
+
+# Keep CPU test-env overrides out of the bench path.
+if "--xla_force_host_platform_device_count" in os.environ.get("XLA_FLAGS", ""):
+    os.environ.pop("XLA_FLAGS")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+
+from ray_tpu.models import llama  # noqa: E402
+from ray_tpu.parallel import MeshConfig, build_mesh, use_mesh  # noqa: E402
+from ray_tpu.train import batch_sharding, init_train_state, make_train_step  # noqa: E402
+
+PEAK_FLOPS = {
+    "tpu v5 lite": 197e12,  # v5e bf16 peak per chip
+    "tpu v5": 459e12,
+    "tpu v4": 275e12,
+}
+NORTH_STAR_MFU = 0.45  # BASELINE.md: Llama-2-7B fine-tune >= 45% MFU target
+
+
+def peak_flops_per_chip() -> float:
+    kind = jax.devices()[0].device_kind.lower()
+    for key, val in PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    return 197e12  # default to v5e
+
+
+def main():
+    batch, seq = (8, 2048)
+    cfg = llama.llama2_size("350m")
+    cfg = llama.LlamaConfig(
+        **{
+            **cfg.__dict__,
+            "vocab_size": 32128,
+            "max_seq_len": seq,
+            "dtype": "bfloat16",
+            "remat": True,
+        }
+    )
+    n_params = cfg.num_params()
+
+    mesh = build_mesh(MeshConfig(), jax.devices()[:1])
+    opt = optax.adamw(1e-4, weight_decay=0.01)
+    state, state_sh = init_train_state(
+        lambda k: llama.init_params(cfg, k),
+        llama.param_logical_axes(cfg),
+        opt,
+        mesh,
+        key=jax.random.PRNGKey(0),
+    )
+    step = make_train_step(
+        lambda p, b: llama.loss_fn(p, b, cfg), opt, mesh, state_sh
+    )
+
+    toks = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size, dtype=jnp.int32
+    )
+    data = {"inputs": toks[:, :-1], "targets": toks[:, 1:]}
+
+    with use_mesh(mesh):
+        data = jax.device_put(data, batch_sharding(mesh))
+        # Warmup / compile.
+        for _ in range(2):
+            state, metrics = step(state, data)
+        jax.block_until_ready(state.params)
+
+        n_steps = 5
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            state, metrics = step(state, data)
+        jax.block_until_ready(state.params)
+        dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * n_steps / dt
+    model_flops = 6.0 * n_params * tokens_per_sec  # fwd+bwd FLOPs/token ~ 6N
+    mfu = model_flops / peak_flops_per_chip()
+    result = {
+        "metric": "llama350m_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / NORTH_STAR_MFU, 4),
+        "extra": {
+            "mfu": round(mfu, 4),
+            "n_params": n_params,
+            "batch": batch,
+            "seq": seq,
+            "step_time_s": round(dt / n_steps, 4),
+            "device": jax.devices()[0].device_kind,
+            "loss": round(float(jax.device_get(metrics["loss"])), 4),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

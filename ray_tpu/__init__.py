@@ -1,0 +1,51 @@
+"""ray_tpu — a TPU-native distributed AI framework.
+
+Capability-parity rebuild of the reference Ray fork (surveyed in SURVEY.md):
+a task/actor/object runtime plus an ML library stack (train/tune/data/serve/rl),
+re-designed TPU-first. Compute lowers to XLA via jax/pjit/pallas; collectives are
+compiler-native over ICI (no NCCL analog); TPU chips/hosts/slices are first-class
+schedulable resources.
+
+Public surface mirrors the reference's `python/ray/__init__.py` API
+(`ray.init/get/put/wait/remote/...`, reference: python/ray/_private/worker.py:1123)
+while the model stack (`ray_tpu.models`, `ray_tpu.parallel`, `ray_tpu.ops`) has no
+reference analog — Ray delegates tensor math to torch; here it is native.
+"""
+
+__version__ = "0.1.0"
+
+# Core runtime API (task/actor/object primitives). Imported lazily so that pure
+# model-stack users (ray_tpu.models / ops / parallel) don't pay for runtime init.
+_RUNTIME_API = (
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "cancel",
+    "get_actor",
+    "method",
+    "available_resources",
+    "cluster_resources",
+    "ObjectRef",
+    "ActorHandle",
+)
+
+
+def __getattr__(name):
+    if name in _RUNTIME_API:
+        try:
+            from ray_tpu._private import api as _api
+        except ImportError as e:
+            raise AttributeError(
+                f"ray_tpu.{name} requires the runtime (ray_tpu._private.api): {e}"
+            ) from e
+        return getattr(_api, name)
+    raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_RUNTIME_API))

@@ -1,0 +1,9 @@
+"""Model zoo: functional JAX models with logical-axis sharding annotations.
+
+Every model module exposes:
+  Config dataclass, `init_params(cfg, key)`, `param_logical_axes(cfg)`,
+  `forward(params, tokens, cfg)`, `loss_fn(params, batch, cfg)`.
+Params are plain pytrees; sharding comes from ray_tpu.parallel rules.
+"""
+
+from ray_tpu.models import llama, mlp  # noqa: F401

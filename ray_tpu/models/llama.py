@@ -1,0 +1,215 @@
+"""Llama-family decoder-only transformer, TPU-first.
+
+Design choices (vs. the reference, which ships no models — its Llama/GPT-J
+workloads live in torch release tests, e.g. reference
+release/air_examples/gptj_deepspeed_finetuning/):
+  - layers stacked into single [L, ...] arrays + lax.scan: one compiled layer
+    body regardless of depth (fast compiles, XLA-friendly).
+  - jax.checkpoint on the layer body: rematerialize activations, keep HBM for
+    params/optimizer (dots_with_no_batch_dims saveable policy).
+  - GQA + RoPE + SwiGLU, RMSNorm pre-norm. bf16 compute, f32 master params.
+  - every tensor dim carries a logical axis name; dp/fsdp/sp/tp placement is
+    decided by rule tables in ray_tpu.parallel.sharding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import attention
+from ray_tpu.ops.losses import softmax_cross_entropy
+from ray_tpu.ops.norms import rms_norm
+from ray_tpu.ops.rope import apply_rotary, rotary_embedding
+from ray_tpu.parallel.sharding import shard_constraint
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 11008
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    max_seq_len: int = 4096
+    dtype: str = "bfloat16"  # compute dtype; master params stay f32
+    remat: bool = True
+    use_flash: bool | None = None  # None = auto (flash on TPU)
+    tie_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    def num_params(self) -> int:
+        d, f, v, l = self.d_model, self.d_ff, self.vocab_size, self.n_layers
+        hd = self.head_dim
+        attn = d * self.n_heads * hd + 2 * d * self.n_kv_heads * hd + self.n_heads * hd * d
+        mlp = 3 * d * f
+        per_layer = attn + mlp + 2 * d
+        head = 0 if self.tie_embeddings else d * v
+        return v * d + l * per_layer + d + head
+
+    @staticmethod
+    def tiny(**kw) -> "LlamaConfig":
+        """Test-size config (runs on CPU in seconds)."""
+        base = dict(
+            vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            d_ff=128, max_seq_len=128, dtype="float32",
+        )
+        base.update(kw)
+        return LlamaConfig(**base)
+
+
+def llama2_7b() -> LlamaConfig:
+    return LlamaConfig(n_kv_heads=32)  # Llama-2-7B uses MHA (32 kv heads)
+
+
+def llama2_size(name: str) -> LlamaConfig:
+    """Named sizes for benchmarks: '125m', '350m', '1b', '7b'."""
+    table = {
+        "125m": dict(d_model=768, n_layers=12, n_heads=12, n_kv_heads=12, d_ff=2048),
+        "350m": dict(d_model=1024, n_layers=24, n_heads=16, n_kv_heads=16, d_ff=2816),
+        "1b": dict(d_model=2048, n_layers=22, n_heads=16, n_kv_heads=8, d_ff=5632),
+        "7b": dict(d_model=4096, n_layers=32, n_heads=32, n_kv_heads=32, d_ff=11008),
+    }
+    return LlamaConfig(**table[name])
+
+
+# --------------------------------------------------------------------------
+# Parameters
+# --------------------------------------------------------------------------
+
+def init_params(cfg: LlamaConfig, key):
+    """Initialize f32 master params. Layer params are stacked along axis 0."""
+    d, f, hd = cfg.d_model, cfg.d_ff, cfg.head_dim
+    hq, hkv, l = cfg.n_heads, cfg.n_kv_heads, cfg.n_layers
+    k = iter(jax.random.split(key, 16))
+
+    def dense(rng, shape, fan_in):
+        return (jax.random.normal(rng, shape, jnp.float32) / math.sqrt(fan_in))
+
+    params = {
+        "embed": jax.random.normal(next(k), (cfg.vocab_size, d), jnp.float32),
+        "layers": {
+            "attn_norm": jnp.ones((l, d), jnp.float32),
+            "wq": dense(next(k), (l, d, hq * hd), d),
+            "wk": dense(next(k), (l, d, hkv * hd), d),
+            "wv": dense(next(k), (l, d, hkv * hd), d),
+            "wo": dense(next(k), (l, hq * hd, d), hq * hd),
+            "mlp_norm": jnp.ones((l, d), jnp.float32),
+            "w_gate": dense(next(k), (l, d, f), d),
+            "w_up": dense(next(k), (l, d, f), d),
+            "w_down": dense(next(k), (l, f, d), f),
+        },
+        "final_norm": jnp.ones((d,), jnp.float32),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = dense(next(k), (d, cfg.vocab_size), d)
+    return params
+
+
+def param_logical_axes(cfg: LlamaConfig):
+    """Same structure as init_params, leaves = logical axis name tuples."""
+    axes = {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "attn_norm": ("layers", "norm"),
+            "wq": ("layers", "embed", "heads"),
+            "wk": ("layers", "embed", "kv_heads"),
+            "wv": ("layers", "embed", "kv_heads"),
+            "wo": ("layers", "heads", "embed"),
+            "mlp_norm": ("layers", "norm"),
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        },
+        "final_norm": ("norm",),
+    }
+    if not cfg.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+# --------------------------------------------------------------------------
+# Forward
+# --------------------------------------------------------------------------
+
+def _layer(cfg: LlamaConfig, h, layer_params, sin, cos):
+    """One pre-norm transformer block. h: [B, T, D] in compute dtype."""
+    p = layer_params
+    b, t, d = h.shape
+    hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    cdt = cfg.compute_dtype
+
+    # Attention
+    x = rms_norm(h, p["attn_norm"], cfg.rms_eps)
+    q = (x @ p["wq"].astype(cdt)).reshape(b, t, hq, hd)
+    k = (x @ p["wk"].astype(cdt)).reshape(b, t, hkv, hd)
+    v = (x @ p["wv"].astype(cdt)).reshape(b, t, hkv, hd)
+    q = apply_rotary(q, sin, cos)
+    k = apply_rotary(k, sin, cos)
+    q = shard_constraint(q, ("batch", "seq", "heads", "head_dim"))
+    k = shard_constraint(k, ("batch", "seq", "kv_heads", "head_dim"))
+    o = attention(q, k, v, causal=True, use_flash=cfg.use_flash)
+    o = o.reshape(b, t, hq * hd) @ p["wo"].astype(cdt)
+    h = h + shard_constraint(o, ("batch", "seq", "embed"))
+
+    # SwiGLU MLP
+    x = rms_norm(h, p["mlp_norm"], cfg.rms_eps)
+    gate = x @ p["w_gate"].astype(cdt)
+    up = x @ p["w_up"].astype(cdt)
+    y = (jax.nn.silu(gate) * up) @ p["w_down"].astype(cdt)
+    h = h + shard_constraint(y, ("batch", "seq", "embed"))
+    return h
+
+
+def forward(params, tokens, cfg: LlamaConfig, *, positions=None):
+    """tokens [B, T] int32 -> logits [B, T, V] f32."""
+    b, t = tokens.shape
+    cdt = cfg.compute_dtype
+    if positions is None:
+        positions = jnp.arange(t, dtype=jnp.int32)[None, :]
+    sin, cos = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta)
+
+    h = params["embed"].astype(cdt)[tokens]
+    h = shard_constraint(h, ("batch", "seq", "embed"))
+
+    layer_fn = lambda h_, p_: (_layer(cfg, h_, p_, sin, cos), None)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(
+            layer_fn,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        )
+    h, _ = jax.lax.scan(layer_fn, h, params["layers"])
+
+    h = rms_norm(h, params["final_norm"], cfg.rms_eps)
+    w_out = (
+        params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    ).astype(cdt)
+    logits = (h @ w_out).astype(jnp.float32)
+    return shard_constraint(logits, ("batch", "seq", "vocab"))
+
+
+def loss_fn(params, batch, cfg: LlamaConfig):
+    """batch: {'tokens': [B, T+1] or ('inputs','targets')} -> (loss, metrics)."""
+    if "inputs" in batch:
+        inputs, targets = batch["inputs"], batch["targets"]
+        mask = batch.get("mask")
+    else:
+        toks = batch["tokens"]
+        inputs, targets = toks[:, :-1], toks[:, 1:]
+        mask = None
+    logits = forward(params, inputs, cfg)
+    loss, n = softmax_cross_entropy(logits, targets, mask=mask)
+    return loss, {"loss": loss, "tokens": n}

@@ -1,0 +1,61 @@
+"""Minimal MLP classifier — the MNIST-class smoke-test workload
+(reference anchor: Ray Train TorchTrainer MNIST MLP, BASELINE.json config #1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPConfig:
+    d_in: int = 784
+    d_hidden: int = 512
+    n_hidden: int = 2
+    d_out: int = 10
+    dtype: str = "float32"
+
+
+def init_params(cfg: MLPConfig, key):
+    dims = [cfg.d_in] + [cfg.d_hidden] * cfg.n_hidden + [cfg.d_out]
+    keys = jax.random.split(key, len(dims) - 1)
+    return {
+        f"layer{i}": {
+            "w": jax.random.normal(keys[i], (dims[i], dims[i + 1]), jnp.float32)
+            / math.sqrt(dims[i]),
+            "b": jnp.zeros((dims[i + 1],), jnp.float32),
+        }
+        for i in range(len(dims) - 1)
+    }
+
+
+def param_logical_axes(cfg: MLPConfig):
+    n = cfg.n_hidden + 1
+    return {
+        f"layer{i}": {"w": ("embed", "mlp"), "b": ("norm",)} for i in range(n)
+    }
+
+
+def forward(params, x, cfg: MLPConfig):
+    n = cfg.n_hidden + 1
+    h = x.astype(jnp.dtype(cfg.dtype))
+    for i in range(n):
+        p = params[f"layer{i}"]
+        h = h @ p["w"].astype(h.dtype) + p["b"].astype(h.dtype)
+        if i < n - 1:
+            h = jax.nn.relu(h)
+    return h.astype(jnp.float32)
+
+
+def loss_fn(params, batch, cfg: MLPConfig):
+    logits = forward(params, batch["x"], cfg)
+    labels = batch["y"]
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    nll = logz - jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    loss = nll.mean()
+    acc = (logits.argmax(-1) == labels).mean()
+    return loss, {"loss": loss, "accuracy": acc}

@@ -1,0 +1,69 @@
+"""Attention ops: jnp reference + dispatch to the pallas TPU flash kernel.
+
+Layout convention throughout: q [B, T, Hq, D], k/v [B, S, Hkv, D] with
+Hq % Hkv == 0 (grouped-query attention; Hkv == Hq is vanilla MHA).
+
+The reference framework has no attention op at all (torch supplies it); flash
+attention here is the framework's flagship MXU kernel (see ops/flash_attention.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _repeat_kv(k, n_rep: int):
+    """[B, S, Hkv, D] -> [B, S, Hkv*n_rep, D] by repeating each kv head."""
+    if n_rep == 1:
+        return k
+    b, s, h, d = k.shape
+    return jnp.broadcast_to(k[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(
+        b, s, h * n_rep, d
+    )
+
+
+def attention_reference(q, k, v, *, causal: bool = True, logits_dtype=jnp.float32):
+    """O(T*S)-memory reference attention (also the autodiff oracle for flash).
+
+    Softmax in f32 regardless of input dtype; returns q.dtype.
+    """
+    n_rep = q.shape[2] // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum(
+        "bthd,bshd->bhts", q, k, preferred_element_type=logits_dtype
+    ) * scale
+    if causal:
+        t, s = logits.shape[-2:]
+        mask = jnp.tril(jnp.ones((t, s), dtype=bool), k=s - t)
+        logits = jnp.where(mask, logits, jnp.finfo(logits_dtype).min)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    out = jnp.einsum(
+        "bhts,bshd->bthd", probs.astype(v.dtype), v, preferred_element_type=jnp.float32
+    )
+    return out.astype(q.dtype)
+
+
+def attention(q, k, v, *, causal: bool = True, use_flash: bool | None = None):
+    """Dispatching attention entry point.
+
+    use_flash=None → flash kernel on TPU backends (when block divisibility
+    holds), reference elsewhere. The flash kernel is TPU-only (pltpu memory
+    spaces); other accelerators use the reference path, which XLA fuses.
+    """
+    if use_flash is None:
+        use_flash = jax.default_backend() == "tpu"
+    if use_flash:
+        from ray_tpu.ops.flash_attention import (
+            DEFAULT_BLOCK_K,
+            DEFAULT_BLOCK_Q,
+            flash_attention,
+        )
+
+        t, s = q.shape[1], k.shape[1]
+        bq, bk = min(DEFAULT_BLOCK_Q, t), min(DEFAULT_BLOCK_K, s)
+        if t % bq == 0 and s % bk == 0:
+            return flash_attention(q, k, v, causal=causal, block_q=bq, block_k=bk)
+    return attention_reference(q, k, v, causal=causal)

@@ -1,0 +1,187 @@
+"""Flash attention for TPU, written in pallas.
+
+Online-softmax tiled attention: grid (batch, q_head, q_block, k_block) with the
+k_block dimension innermost — TPU grids execute sequentially per core, so f32
+scratch accumulators (m, l, acc) carry across k iterations and the output tile
+is written once on the last k step. Causal blocks strictly above the diagonal
+are predicated off with pl.when, skipping ~half the FLOPs.
+
+GQA is handled in the BlockSpec index maps: q head h reads kv head h // group,
+so no kv replication ever materializes.
+
+Backward currently reuses the reference VJP (O(T·S) memory under remat);
+a pallas dq/dkv kernel pair replaces it in ops/flash_attention_bwd.py work.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ray_tpu.utils.math import cdiv
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, causal, scale, block_q, block_k, offset):
+    """offset = S - T: the causal mask is end-aligned (query row i attends
+    keys <= i + offset), matching attention_reference's tril(k=S-T) so decode
+    (T=1 against a long cache) sees the whole prefix."""
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Causal: block is live unless it lies strictly above the (shifted)
+    # diagonal, i.e. its first key index exceeds the last query's reach.
+    q_start = iq * block_q
+    k_start = ik * block_k
+    block_live = jnp.logical_or(
+        jnp.logical_not(causal), k_start <= q_start + block_q - 1 + offset
+    )
+
+    @pl.when(block_live)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale  # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)  # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bk]
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + q_start
+            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + k_start
+            s = jnp.where(rows + offset >= cols, s, _NEG_INF)
+
+        m_prev = m_scr[:, :1]  # [bq, 1] (lanes replicated)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)  # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)  # [bq, bk]
+        corr = jnp.exp(m_prev - m_new)  # [bq, 1]
+        l_new = corr * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+
+        v = v_ref[0, 0].astype(jnp.float32)  # [bk, d]
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, d]
+        acc_scr[:] = acc_scr[:] * corr + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
+    b, hq, t, d = q.shape
+    _, hkv, s, _ = k.shape
+    group = hq // hkv
+    block_q = min(block_q, t)
+    block_k = min(block_k, s)
+    if t % block_q or s % block_k:
+        raise ValueError(
+            f"flash_attention: T={t} / S={s} must be multiples of block sizes "
+            f"({block_q}, {block_k}); pad inputs or use attention()."
+        )
+    scale = d ** -0.5
+    grid = (b, hq, cdiv(t, block_q), cdiv(s, block_k))
+
+    kernel = functools.partial(
+        _fwd_kernel, causal=causal, scale=scale, block_q=block_q,
+        block_k=block_k, offset=s - t,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec(
+                (1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running max m
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running denom l
+            pltpu.VMEM((block_q, d), jnp.float32),  # output accumulator
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_fwd(q, k, v, causal=causal, block_q=block_q, block_k=block_k, interpret=interpret)
+
+
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = _flash_fwd(q, k, v, causal=causal, block_q=block_q, block_k=block_k, interpret=interpret)
+    return out, (q, k, v)
+
+
+def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, g):
+    # Reference-gradient backward (numerically the same function). The tiled
+    # pallas backward will replace this; until then XLA remats the [T, S]
+    # logits inside this vjp only.
+    from ray_tpu.ops.attention import attention_reference
+
+    q, k, v = res
+
+    def ref(q_, k_, v_):
+        # [B, H, T, D] kernel layout -> reference layout [B, T, H, D]
+        o = attention_reference(
+            q_.transpose(0, 2, 1, 3),
+            k_.transpose(0, 2, 1, 3),
+            v_.transpose(0, 2, 1, 3),
+            causal=causal,
+        )
+        return o.transpose(0, 2, 1, 3)
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    *,
+    causal: bool = True,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool | None = None,
+):
+    """Flash attention. Layout [B, T, H, D] (matching ops.attention).
+
+    Requires T and S to be multiples of the (clamped) block sizes; callers pad.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    # Kernel-internal layout is [B, H, T, D].
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out = _flash(qt, kt, vt, causal, block_q, block_k, interpret)
+    return out.transpose(0, 2, 1, 3)

@@ -1,0 +1,21 @@
+"""Loss functions."""
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(logits, labels, *, mask=None):
+    """Mean token cross-entropy. logits [..., V] (any dtype, upcast to f32),
+    labels int [...], optional mask [...] of {0,1}.
+
+    Returns (loss, n_tokens) so callers can re-weight across data shards.
+    """
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    label_logits = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    nll = logz - label_logits
+    if mask is None:
+        mask = jnp.ones_like(nll)
+    mask = mask.astype(jnp.float32)
+    n = jnp.maximum(mask.sum(), 1.0)
+    return (nll * mask).sum() / n, n

@@ -1,0 +1,20 @@
+"""Normalization ops. RMSNorm is the Llama-family default.
+
+Kept as straight jnp: XLA fuses the reduce + scale into neighboring ops on TPU,
+so a hand kernel buys nothing here (the fusion win lives in attention).
+"""
+
+import jax.lax as lax
+import jax.numpy as jnp
+
+
+def rms_norm(x, weight, eps: float = 1e-5):
+    """RMSNorm in f32 accumulation, cast back to input dtype.
+
+    y = x * rsqrt(mean(x^2) + eps) * weight, reduced over the trailing axis.
+    """
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    y = xf * lax.rsqrt(var + eps)
+    return (y * weight.astype(jnp.float32)).astype(dtype)

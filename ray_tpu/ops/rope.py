@@ -1,0 +1,29 @@
+"""Rotary position embeddings (RoPE), Llama convention.
+
+Sin/cos tables are computed in f32 once per call site; under jit XLA constant-
+folds them for static position ranges.
+"""
+
+import jax.numpy as jnp
+
+
+def rotary_embedding(positions, head_dim: int, theta: float = 10000.0):
+    """Return (sin, cos) tables of shape positions.shape + (head_dim // 2,)."""
+    half = head_dim // 2
+    freq = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions.astype(jnp.float32)[..., None] * freq
+    return jnp.sin(angles), jnp.cos(angles)
+
+
+def apply_rotary(x, sin, cos):
+    """Rotate pairs (x1, x2) = (x[..., :half], x[..., half:]).
+
+    x: [..., T, n_heads, head_dim]; sin/cos: [..., T, half] (broadcast over heads).
+    """
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    sin = sin[..., None, :]  # broadcast over the heads axis
+    cos = cos[..., None, :]
+    y1 = x1 * cos - x2 * sin
+    y2 = x2 * cos + x1 * sin
+    return jnp.concatenate([y1, y2], axis=-1).astype(x.dtype)

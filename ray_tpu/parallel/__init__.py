@@ -1,0 +1,23 @@
+"""Parallelism layer: device meshes, logical sharding rules, SPMD helpers.
+
+The reference framework has no native model/sequence parallelism (SURVEY.md §2.7:
+DP arrives via torch DDP in `train/torch/config.py`, TP/PP only via out-of-tree
+Alpa, SP absent). Here every strategy is a mesh axis: dp / fsdp / ep / sp / tp
+(+ pp reserved), and GSPMD inserts the collectives.
+"""
+
+from ray_tpu.parallel.mesh import (  # noqa: F401
+    AXES,
+    MeshConfig,
+    build_mesh,
+    auto_mesh_config,
+    local_mesh,
+    use_mesh,
+)
+from ray_tpu.parallel.sharding import (  # noqa: F401
+    LogicalRules,
+    DEFAULT_RULES,
+    logical_to_mesh_spec,
+    logical_tree_to_shardings,
+    shard_constraint,
+)

@@ -1,0 +1,11 @@
+"""Training layer: sharded train state/step builders and (soon) the
+JaxTrainer actor-group orchestration mirroring reference
+python/ray/train/data_parallel_trainer.py.
+"""
+
+from ray_tpu.train.step import (  # noqa: F401
+    TrainState,
+    make_train_step,
+    init_train_state,
+    batch_sharding,
+)

@@ -1,0 +1,8 @@
+"""Small shared utilities (math helpers, timing, pytree helpers)."""
+
+from ray_tpu.utils.math import cdiv, round_up_to_multiple  # noqa: F401
+from ray_tpu.utils.trees import (  # noqa: F401
+    tree_size_bytes,
+    tree_num_params,
+    tree_map_with_path_names,
+)

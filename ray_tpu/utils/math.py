@@ -1,0 +1,22 @@
+"""Integer math helpers used across kernels and shard layouts."""
+
+
+def cdiv(a: int, b: int) -> int:
+    """Ceiling division."""
+    return -(-a // b)
+
+
+def round_up_to_multiple(x: int, m: int) -> int:
+    """Round ``x`` up to the nearest multiple of ``m``."""
+    return cdiv(x, m) * m
+
+
+def pow2_factors(n: int) -> list[int]:
+    """Decompose n (a power of two) into a list of 2s; [] for n == 1."""
+    out = []
+    while n % 2 == 0 and n > 1:
+        out.append(2)
+        n //= 2
+    if n != 1:
+        out.append(n)
+    return out

@@ -1,0 +1,44 @@
+"""Test fixture backbone: an 8-device virtual CPU mesh.
+
+Analog of the reference's fake-cluster test backbone
+(reference: python/ray/cluster_utils.py:99 `Cluster`, conftest fixtures
+python/ray/tests/conftest.py:359) — multi-"chip" semantics without TPU
+hardware, via XLA host-platform virtual devices.
+
+Must set env vars before jax initializes its backends, hence the top-of-file
+placement and the sys.modules guard.
+"""
+
+import os
+
+# jax may already be imported (pytest plugins) with its config snapshotted from
+# the env, so set both the env var and the live config; backends init lazily.
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+assert jax.default_backend() == "cpu", (
+    "jax backend initialized before conftest could force CPU; "
+    f"got {jax.default_backend()}"
+)
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture
+def rng():
+    return jax.random.PRNGKey(0)

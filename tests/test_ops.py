@@ -1,0 +1,111 @@
+"""Ops unit tests: norms, rope, attention (reference vs flash-interpret)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.ops import attention_reference, rms_norm, softmax_cross_entropy
+from ray_tpu.ops.flash_attention import flash_attention
+from ray_tpu.ops.rope import apply_rotary, rotary_embedding
+
+
+def test_rms_norm_matches_manual(rng):
+    x = jax.random.normal(rng, (4, 16), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(1), (16,), jnp.float32)
+    got = rms_norm(x, w, eps=1e-6)
+    want = x / np.sqrt((np.asarray(x) ** 2).mean(-1, keepdims=True) + 1e-6) * np.asarray(w)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
+
+
+def test_rope_norm_preserving(rng):
+    x = jax.random.normal(rng, (2, 8, 4, 32), jnp.float32)
+    pos = jnp.arange(8, dtype=jnp.int32)[None, :]
+    sin, cos = rotary_embedding(pos, 32)
+    y = apply_rotary(x, sin, cos)
+    # Rotation preserves per-pair norms.
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(x), axis=-1),
+        np.linalg.norm(np.asarray(y), axis=-1),
+        rtol=1e-5,
+    )
+    # Position 0 is identity.
+    np.testing.assert_allclose(np.asarray(y[:, 0]), np.asarray(x[:, 0]), atol=1e-6)
+
+
+def test_attention_reference_causality(rng):
+    k1, k2, k3 = jax.random.split(rng, 3)
+    q = jax.random.normal(k1, (1, 8, 2, 16), jnp.float32)
+    k = jax.random.normal(k2, (1, 8, 2, 16), jnp.float32)
+    v = jax.random.normal(k3, (1, 8, 2, 16), jnp.float32)
+    out1 = attention_reference(q, k, v, causal=True)
+    # Perturbing future keys/values must not change earlier outputs.
+    k_mod = k.at[:, 4:].set(0.0)
+    v_mod = v.at[:, 4:].set(9.0)
+    out2 = attention_reference(q, k_mod, v_mod, causal=True)
+    np.testing.assert_allclose(np.asarray(out1[:, :4]), np.asarray(out2[:, :4]), rtol=1e-5)
+    assert not np.allclose(np.asarray(out1[:, 4:]), np.asarray(out2[:, 4:]))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("gqa", [1, 2])
+def test_flash_matches_reference(rng, causal, gqa):
+    b, t, hq, d = 2, 256, 4, 64
+    hkv = hq // gqa
+    k1, k2, k3 = jax.random.split(rng, 3)
+    q = jax.random.normal(k1, (b, t, hq, d), jnp.float32)
+    k = jax.random.normal(k2, (b, t, hkv, d), jnp.float32)
+    v = jax.random.normal(k3, (b, t, hkv, d), jnp.float32)
+    want = attention_reference(q, k, v, causal=causal)
+    got = flash_attention(q, k, v, causal=causal, block_q=128, block_k=128,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=1e-4)
+
+
+def test_flash_gradients_match_reference(rng):
+    b, t, h, d = 1, 128, 2, 32
+    k1, k2, k3 = jax.random.split(rng, 3)
+    q = jax.random.normal(k1, (b, t, h, d), jnp.float32)
+    k = jax.random.normal(k2, (b, t, h, d), jnp.float32)
+    v = jax.random.normal(k3, (b, t, h, d), jnp.float32)
+
+    def f_ref(q, k, v):
+        return attention_reference(q, k, v, causal=True).sum()
+
+    def f_flash(q, k, v):
+        return flash_attention(q, k, v, causal=True, block_q=64, block_k=64,
+                               interpret=True).sum()
+
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_ref, g_flash):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=1e-4, rtol=1e-3)
+
+
+def test_flash_decode_shape_matches_reference(rng):
+    """T != S (decode against a cache): mask must be end-aligned."""
+    b, t, s, h, d = 1, 64, 256, 2, 32
+    k1, k2, k3 = jax.random.split(rng, 3)
+    q = jax.random.normal(k1, (b, t, h, d), jnp.float32)
+    k = jax.random.normal(k2, (b, s, h, d), jnp.float32)
+    v = jax.random.normal(k3, (b, s, h, d), jnp.float32)
+    want = attention_reference(q, k, v, causal=True)
+    got = flash_attention(q, k, v, causal=True, block_q=64, block_k=64,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5,
+                               rtol=1e-4)
+
+
+def test_flash_rejects_ragged_lengths(rng):
+    q = jnp.zeros((1, 100, 2, 32))
+    with pytest.raises(ValueError, match="multiples"):
+        flash_attention(q, q, q, causal=True, block_q=64, block_k=64,
+                        interpret=True)
+
+
+def test_cross_entropy_uniform(rng):
+    logits = jnp.zeros((4, 7, 10))
+    labels = jnp.zeros((4, 7), jnp.int32)
+    loss, n = softmax_cross_entropy(logits, labels)
+    np.testing.assert_allclose(float(loss), np.log(10), rtol=1e-6)
+    assert int(n) == 28

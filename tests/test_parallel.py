@@ -1,0 +1,109 @@
+"""Sharding tests on the 8-device virtual CPU mesh: every parallelism layout
+compiles, runs, and produces results identical to single-device execution."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ray_tpu.models import llama
+from ray_tpu.parallel import (
+    DEFAULT_RULES,
+    MeshConfig,
+    build_mesh,
+    logical_to_mesh_spec,
+    logical_tree_to_shardings,
+    use_mesh,
+)
+from ray_tpu.train import batch_sharding, init_train_state, make_train_step
+
+
+def test_logical_to_mesh_spec_dedup():
+    spec = logical_to_mesh_spec(("batch", "seq", "embed"))
+    # fsdp already consumed by batch -> embed falls back to replicated, and
+    # the trailing None is trimmed.
+    assert spec[0] == ("dp", "fsdp")
+    assert spec[1] == "sp"
+    assert len(spec) == 2
+
+
+MESHES = [
+    MeshConfig(dp=8),
+    MeshConfig(fsdp=8),
+    MeshConfig(fsdp=2, sp=2, tp=2),
+    MeshConfig(dp=2, fsdp=2, tp=2),
+    MeshConfig(fsdp=4, tp=2),
+]
+
+
+@pytest.mark.parametrize("mcfg", MESHES, ids=lambda m: m.describe())
+def test_train_step_all_layouts(devices8, mcfg, rng):
+    """One train step under each mesh layout matches the single-device result."""
+    cfg = llama.LlamaConfig.tiny(n_layers=2)
+    mesh = build_mesh(mcfg, devices8)
+    opt = optax.adam(1e-3)
+
+    toks = jax.random.randint(jax.random.PRNGKey(7), (8, 33), 0, cfg.vocab_size)
+    # inputs/targets form: seq length 32 divides the sp axis.
+    batch = {"inputs": toks[:, :-1], "targets": toks[:, 1:]}
+
+    # Single-device truth.
+    params0 = llama.init_params(cfg, rng)
+    (loss0, _), grads0 = jax.value_and_grad(llama.loss_fn, has_aux=True)(
+        params0, batch, cfg
+    )
+
+    state, state_sh = init_train_state(
+        lambda k: llama.init_params(cfg, k),
+        llama.param_logical_axes(cfg),
+        opt,
+        mesh,
+        key=rng,
+    )
+    step = make_train_step(
+        lambda p, b: llama.loss_fn(p, b, cfg), opt, mesh, state_sh,
+        donate_state=False,
+    )
+    with use_mesh(mesh):
+        sharded_batch = jax.device_put(batch, batch_sharding(mesh))
+        state2, metrics = step(state, sharded_batch)
+
+    np.testing.assert_allclose(float(metrics["loss"]), float(loss0), rtol=2e-4)
+    assert int(jax.device_get(state2.step)) == 1
+
+    # Params actually sharded: under pure fsdp the wq leaf shard is 1/8 size.
+    if mcfg.fsdp == 8:
+        wq = state2.params["layers"]["wq"]
+        shard = wq.addressable_shards[0].data
+        assert shard.shape[1] == wq.shape[1] // 8
+
+
+def test_opt_state_shardings_follow_param_paths(devices8, rng):
+    """Adam moments for wo (shape == wq's) must use wo's transposed sharding."""
+    cfg = llama.LlamaConfig.tiny(n_heads=4, n_kv_heads=4)  # hq*hd == d_model
+    mesh = build_mesh(MeshConfig(fsdp=4, tp=2), devices8)
+    opt = optax.adam(1e-3)
+    state, state_sh = init_train_state(
+        lambda k: llama.init_params(cfg, k),
+        llama.param_logical_axes(cfg),
+        opt,
+        mesh,
+        key=rng,
+    )
+    mu = state.opt_state[0].mu["layers"]
+    # wq: (layers, embed->fsdp, heads->tp); wo: (layers, heads->tp, embed->fsdp)
+    assert mu["wq"].sharding.spec == state.params["layers"]["wq"].sharding.spec
+    assert mu["wo"].sharding.spec == state.params["layers"]["wo"].sharding.spec
+    assert (
+        state.params["layers"]["wq"].sharding.spec
+        != state.params["layers"]["wo"].sharding.spec
+    )
+
+
+def test_param_shardings_cover_tree(devices8, rng):
+    cfg = llama.LlamaConfig.tiny()
+    mesh = build_mesh(MeshConfig(fsdp=4, tp=2), devices8)
+    sh = logical_tree_to_shardings(llama.param_logical_axes(cfg), mesh, DEFAULT_RULES)
+    params = llama.init_params(cfg, rng)
+    assert jax.tree_util.tree_structure(params) == jax.tree_util.tree_structure(sh)
