@@ -11,9 +11,14 @@ import os
 import sys
 import time
 
-# Keep CPU test-env overrides out of the bench path.
-if "--xla_force_host_platform_device_count" in os.environ.get("XLA_FLAGS", ""):
-    os.environ.pop("XLA_FLAGS")
+# Keep the CPU test-env override out of the bench path (preserve other flags).
+_flags = os.environ.get("XLA_FLAGS", "").split()
+_kept = [f for f in _flags if "xla_force_host_platform_device_count" not in f]
+if _kept != _flags:
+    if _kept:
+        os.environ["XLA_FLAGS"] = " ".join(_kept)
+    else:
+        os.environ.pop("XLA_FLAGS")
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402

@@ -1,0 +1,1 @@
+"""Internal runtime machinery (analog of reference python/ray/_private/)."""

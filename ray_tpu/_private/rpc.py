@@ -1,0 +1,359 @@
+"""Asyncio msgpack-framed TCP RPC.
+
+Analog of the reference's gRPC substrate (`src/ray/rpc/grpc_server.h`,
+`rpc/client_call.h`): every control-plane and node-agent service in the
+runtime speaks this protocol. We use length-prefixed msgpack instead of
+gRPC/protobuf — no codegen, lower per-call overhead in Python, and the
+server can push frames to clients on the same connection (replacing the
+reference's long-poll pubsub, `src/ray/pubsub/subscriber.h`).
+
+Frame: 4-byte LE length | msgpack array.
+  [0, reqid, method, payload]   request
+  [1, reqid, ok, payload]       response (payload = result | error string)
+  [2, channel, payload]         push (server -> client pubsub)
+  [3, method, payload]          one-way request (no response)
+
+Payloads are msgpack-native structures; binary user data rides as msgpack
+bin (zero-copy on decode via memoryview).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+import threading
+import traceback
+from typing import Any, Awaitable, Callable
+
+import msgpack
+
+logger = logging.getLogger(__name__)
+
+REQUEST, RESPONSE, PUSH, ONEWAY = 0, 1, 2, 3
+_LEN = struct.Struct("<I")
+MAX_FRAME = 1 << 31
+
+
+def pack(obj: Any) -> bytes:
+    return msgpack.packb(obj, use_bin_type=True)
+
+
+def unpack(data: bytes) -> Any:
+    return msgpack.unpackb(data, raw=False, strict_map_key=False)
+
+
+class RpcError(Exception):
+    """Remote handler raised; message carries the remote traceback."""
+
+
+class ConnectionLost(Exception):
+    pass
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> Any:
+    header = await reader.readexactly(_LEN.size)
+    (n,) = _LEN.unpack(header)
+    if n > MAX_FRAME:
+        raise RpcError(f"frame too large: {n}")
+    body = await reader.readexactly(n)
+    return unpack(body)
+
+
+def _write_frame(writer: asyncio.StreamWriter, msg: Any) -> None:
+    body = pack(msg)
+    if len(body) > MAX_FRAME:
+        raise RpcError(
+            f"frame of {len(body)} bytes exceeds the {MAX_FRAME}-byte limit; "
+            "pass large payloads through the object store, not inline RPC"
+        )
+    writer.write(_LEN.pack(len(body)) + body)
+
+
+Handler = Callable[..., Awaitable[Any]]
+
+
+class ServerConn:
+    """Server-side view of one client connection; supports push()."""
+
+    def __init__(self, reader, writer, server: "RpcServer"):
+        self.reader = reader
+        self.writer = writer
+        self.server = server
+        self.peer = writer.get_extra_info("peername")
+        self.closed = asyncio.Event()
+        # Arbitrary per-connection state that services attach (e.g. node id).
+        self.state: dict = {}
+
+    def push(self, channel: str, payload: Any) -> None:
+        if self.writer.is_closing():
+            return
+        try:
+            _write_frame(self.writer, [PUSH, channel, payload])
+        except (ConnectionError, RuntimeError):
+            pass
+
+    async def drain(self):
+        try:
+            await self.writer.drain()
+        except ConnectionError:
+            pass
+
+
+class RpcServer:
+    """Method-dispatch TCP server.
+
+    Handlers: async fn(conn: ServerConn, payload) -> result payload.
+    Register with `server.handlers["method"] = fn` or via `route()`.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self.handlers: dict[str, Handler] = {}
+        self.conns: set[ServerConn] = set()
+        self._server: asyncio.base_events.Server | None = None
+        self.on_disconnect: Callable[[ServerConn], Awaitable[None]] | None = None
+
+    def route(self, name: str):
+        def deco(fn):
+            self.handlers[name] = fn
+            return fn
+
+        return deco
+
+    async def start(self) -> int:
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def stop(self):
+        if self._server is not None:
+            self._server.close()
+            try:
+                await self._server.wait_closed()
+            except Exception:
+                pass
+        for conn in list(self.conns):
+            try:
+                conn.writer.close()
+            except Exception:
+                pass
+
+    async def _handle_conn(self, reader, writer):
+        conn = ServerConn(reader, writer, self)
+        self.conns.add(conn)
+        try:
+            while True:
+                msg = await _read_frame(reader)
+                kind = msg[0]
+                if kind == REQUEST:
+                    _, reqid, method, payload = msg
+                    asyncio.ensure_future(
+                        self._dispatch(conn, reqid, method, payload)
+                    )
+                elif kind == ONEWAY:
+                    _, method, payload = msg
+                    asyncio.ensure_future(
+                        self._dispatch(conn, None, method, payload)
+                    )
+                else:
+                    logger.warning("server got unexpected frame kind %s", kind)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            self.conns.discard(conn)
+            conn.closed.set()
+            if self.on_disconnect is not None:
+                try:
+                    await self.on_disconnect(conn)
+                except Exception:
+                    logger.exception("on_disconnect handler failed")
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch(self, conn, reqid, method, payload):
+        handler = self.handlers.get(method)
+        try:
+            if handler is None:
+                raise RpcError(f"no such method: {method}")
+            result = await handler(conn, payload)
+            ok = True
+        except Exception as e:
+            if not isinstance(e, RpcError):
+                logger.exception("handler %s failed", method)
+            result = f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
+            ok = False
+        if reqid is not None:
+            try:
+                _write_frame(conn.writer, [RESPONSE, reqid, ok, result])
+                await conn.drain()
+            except (ConnectionError, RuntimeError):
+                pass
+
+
+class AsyncRpcClient:
+    """Client with multiplexed in-flight requests and push subscriptions."""
+
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self._reader = None
+        self._writer = None
+        self._reqid = 0
+        self._pending: dict[int, asyncio.Future] = {}
+        self._push_handlers: dict[str, Callable[[Any], None]] = {}
+        self._read_task: asyncio.Task | None = None
+        self.closed = False
+
+    async def connect(self, retries: int = 30, delay: float = 0.1):
+        last = None
+        for _ in range(retries):
+            try:
+                self._reader, self._writer = await asyncio.open_connection(
+                    self.host, self.port
+                )
+                break
+            except OSError as e:
+                last = e
+                await asyncio.sleep(delay)
+        else:
+            raise ConnectionLost(
+                f"cannot connect to {self.host}:{self.port}: {last}"
+            )
+        self._read_task = asyncio.ensure_future(self._read_loop())
+        return self
+
+    def on_push(self, channel: str, fn: Callable[[Any], None]):
+        self._push_handlers[channel] = fn
+
+    async def _read_loop(self):
+        try:
+            while True:
+                msg = await _read_frame(self._reader)
+                kind = msg[0]
+                if kind == RESPONSE:
+                    _, reqid, ok, payload = msg
+                    fut = self._pending.pop(reqid, None)
+                    if fut is not None and not fut.done():
+                        if ok:
+                            fut.set_result(payload)
+                        else:
+                            fut.set_exception(RpcError(payload))
+                elif kind == PUSH:
+                    _, channel, payload = msg
+                    fn = self._push_handlers.get(channel)
+                    if fn is not None:
+                        try:
+                            fn(payload)
+                        except Exception:
+                            logger.exception("push handler %s failed", channel)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            self.closed = True
+            err = ConnectionLost(f"connection to {self.host}:{self.port} lost")
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(err)
+            self._pending.clear()
+
+    async def call(self, method: str, payload: Any = None, timeout=None) -> Any:
+        if self.closed:
+            raise ConnectionLost(f"connection to {self.host}:{self.port} closed")
+        self._reqid += 1
+        reqid = self._reqid
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[reqid] = fut
+        _write_frame(self._writer, [REQUEST, reqid, method, payload])
+        await self._writer.drain()
+        if timeout is not None:
+            return await asyncio.wait_for(fut, timeout)
+        return await fut
+
+    async def oneway(self, method: str, payload: Any = None):
+        if self.closed:
+            raise ConnectionLost("closed")
+        _write_frame(self._writer, [ONEWAY, method, payload])
+        await self._writer.drain()
+
+    async def close(self):
+        self.closed = True
+        if self._read_task is not None:
+            self._read_task.cancel()
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+
+
+class EventLoopThread:
+    """A dedicated asyncio loop on a daemon thread.
+
+    Drivers and workers are synchronous user code; all their RPC rides this
+    background loop (the reference equivalently hides boost::asio loops inside
+    CoreWorker's io_service threads, `core_worker.h`).
+    """
+
+    def __init__(self, name: str = "ray_tpu-io"):
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(
+            target=self._run, name=name, daemon=True
+        )
+        self.thread.start()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def run(self, coro, timeout=None):
+        """Run coroutine on the loop, block for result."""
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def submit(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def call_soon(self, fn, *args):
+        self.loop.call_soon_threadsafe(fn, *args)
+
+    def stop(self):
+        def _shutdown():
+            for task in asyncio.all_tasks(self.loop):
+                task.cancel()
+            self.loop.stop()
+
+        try:
+            self.loop.call_soon_threadsafe(_shutdown)
+            self.thread.join(timeout=2)
+        except Exception:
+            pass
+
+
+class SyncRpcClient:
+    """Blocking facade over AsyncRpcClient via an EventLoopThread."""
+
+    def __init__(self, host: str, port: int, io: EventLoopThread):
+        self.io = io
+        self.client = AsyncRpcClient(host, port)
+        io.run(self.client.connect())
+
+    def call(self, method: str, payload: Any = None, timeout=None) -> Any:
+        return self.io.run(self.client.call(method, payload, timeout=timeout))
+
+    def oneway(self, method: str, payload: Any = None):
+        return self.io.run(self.client.oneway(method, payload))
+
+    def on_push(self, channel: str, fn):
+        self.client.on_push(channel, fn)
+
+    def close(self):
+        try:
+            self.io.run(self.client.close())
+        except Exception:
+            pass

@@ -1,0 +1,75 @@
+"""Object serialization: cloudpickle + out-of-band (pickle-5) buffers.
+
+Analog of reference `python/ray/_private/serialization.py`: user objects are
+cloudpickled with protocol 5 so large contiguous buffers (numpy arrays, and
+host-side jax arrays via numpy view) travel as raw bytes — written straight
+into the shared-memory object store with no extra copy — while the pickle
+stream only carries metadata.
+
+Also tracks ObjectRefs discovered while pickling (reference
+`serialization.py` `_get_contained_object_refs`): the submitting worker must
+pin/borrow nested refs for distributed refcounting.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+from typing import Any
+
+import cloudpickle
+
+# Serialized payload layout (msgpack-framed by the caller):
+#   meta: pickle bytes (protocol 5, buffers out-of-band)
+#   buffers: list of raw buffer bytes
+
+
+class _RefCollector(threading.local):
+    def __init__(self):
+        self.active: list | None = None
+
+
+_collector = _RefCollector()
+
+
+def note_object_ref(ref) -> None:
+    """Called from ObjectRef.__reduce__ during an active serialization."""
+    if _collector.active is not None:
+        _collector.active.append(ref)
+
+
+def serialize(obj: Any) -> tuple[bytes, list[pickle.PickleBuffer], list]:
+    """Returns (meta, buffers, contained_object_refs)."""
+    buffers: list[pickle.PickleBuffer] = []
+    _collector.active = []
+    try:
+        meta = cloudpickle.dumps(obj, protocol=5, buffer_callback=buffers.append)
+        refs = _collector.active
+    finally:
+        _collector.active = None
+    return meta, buffers, refs
+
+
+def deserialize(meta: bytes | memoryview, buffers: list) -> Any:
+    return pickle.loads(meta, buffers=buffers)
+
+
+def dumps_oob(obj: Any) -> tuple[bytes, list]:
+    """Serialize to (meta, [bytes-like]) for wire transport."""
+    meta, buffers, _ = serialize(obj)
+    return meta, [b.raw() for b in buffers]
+
+
+def loads_oob(meta, buffers) -> Any:
+    return deserialize(meta, buffers)
+
+
+def pack_payload(obj: Any) -> list:
+    """Msgpack-friendly [meta, [buf, ...]] encoding of an arbitrary object."""
+    meta, bufs = dumps_oob(obj)
+    return [meta, [bytes(b) for b in bufs]]
+
+
+def unpack_payload(payload: list) -> Any:
+    meta, bufs = payload
+    return loads_oob(meta, bufs)

@@ -1,0 +1,7 @@
+"""Core runtime: object store, control plane, node agent, worker processes.
+
+TPU-native analog of the reference's C++ core (`src/ray/`): the control plane
+mirrors the GCS server (SURVEY.md §2.2), the node agent mirrors the raylet
+(§2.3), the shared-memory object store mirrors plasma (§2.4), and the worker
+core mirrors the core-worker library (§2.5).
+"""

@@ -1,0 +1,15 @@
+"""Shared-memory object store: C++ data plane + Python client.
+
+Plasma-equivalent (reference `src/ray/object_manager/plasma/store.h:55`):
+node-local immutable object storage in a POSIX shm segment, zero-copy reads
+from every worker process on the node, LRU eviction of unreferenced sealed
+objects. The C++ core (`store.cc`) owns allocation, the object table, and
+refcounts; Python attaches via ctypes and mmaps the same segment.
+"""
+
+from ray_tpu.core.object_store.client import (  # noqa: F401
+    ObjectStoreClient,
+    ObjectBuffer,
+    StoreFullError,
+    ObjectExistsError,
+)

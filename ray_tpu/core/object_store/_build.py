@@ -1,0 +1,48 @@
+"""Compile the C++ store core to a shared library on first use.
+
+The .so is cached next to the source and rebuilt when store.cc is newer
+(the reference ships bazel-built binaries; we compile lazily so the package
+works from a plain checkout with just g++ present).
+"""
+
+from __future__ import annotations
+
+import fcntl
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(_DIR, "store.cc")
+SO = os.path.join(_DIR, "_store.so")
+_lock = threading.Lock()
+
+
+def _stale() -> bool:
+    return (
+        not os.path.exists(SO)
+        or os.path.getmtime(SO) < os.path.getmtime(SRC)
+    )
+
+
+def ensure_built() -> str:
+    with _lock:
+        if not _stale():
+            return SO
+        # Cross-process: flock a lockfile; per-process unique tmp so a
+        # concurrent g++ can never interleave writes into the same inode.
+        with open(SO + ".lock", "w") as lf:
+            fcntl.flock(lf, fcntl.LOCK_EX)
+            try:
+                if not _stale():  # built while we waited
+                    return SO
+                tmp = f"{SO}.{os.getpid()}.tmp"
+                cmd = [
+                    "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                    "-o", tmp, SRC, "-lpthread", "-lrt",
+                ]
+                subprocess.run(cmd, check=True, capture_output=True)
+                os.replace(tmp, SO)
+                return SO
+            finally:
+                fcntl.flock(lf, fcntl.LOCK_UN)

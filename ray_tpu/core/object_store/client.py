@@ -1,0 +1,285 @@
+"""ctypes client for the C++ shared-memory object store.
+
+Plasma-client equivalent (reference `src/ray/object_manager/plasma/client.cc`):
+attach the node's shm segment, create/seal/get objects with zero-copy reads.
+A `get` pins the object via its refcount; the returned `ObjectBuffer` releases
+the pin when garbage-collected (reference behavior: plasma buffers release on
+Python buffer GC, `plasma_store_provider.h`).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import weakref
+
+from ray_tpu.core.object_store._build import ensure_built
+
+_ID_SIZE = 16
+
+
+class StoreFullError(Exception):
+    pass
+
+
+class ObjectExistsError(Exception):
+    pass
+
+
+class ObjectNotFoundError(Exception):
+    pass
+
+
+_RC = {0: None, -1: "not_found", -2: "exists", -3: "full", -4: "bad_state", -5: "err"}
+
+
+def _load_lib():
+    lib = ctypes.CDLL(ensure_built())
+    u64, p = ctypes.c_uint64, ctypes.c_void_p
+    u64p = ctypes.POINTER(u64)
+    lib.store_create_segment.restype = p
+    lib.store_create_segment.argtypes = [ctypes.c_char_p, u64, u64]
+    lib.store_attach.restype = p
+    lib.store_attach.argtypes = [ctypes.c_char_p]
+    lib.store_detach.argtypes = [p]
+    lib.store_destroy.argtypes = [p]
+    lib.store_unlink_only.argtypes = [p]
+    lib.store_create.argtypes = [p, ctypes.c_char_p, u64, u64, u64p, u64p]
+    lib.store_seal.argtypes = [p, ctypes.c_char_p]
+    lib.store_get.argtypes = [p, ctypes.c_char_p, u64p, u64p, u64p, u64p]
+    lib.store_release.argtypes = [p, ctypes.c_char_p]
+    lib.store_delete.argtypes = [p, ctypes.c_char_p]
+    lib.store_abort.argtypes = [p, ctypes.c_char_p]
+    lib.store_contains.argtypes = [p, ctypes.c_char_p]
+    lib.store_pin.argtypes = [p, ctypes.c_char_p, ctypes.c_int]
+    lib.store_evict.restype = u64
+    lib.store_evict.argtypes = [p, u64]
+    for fn in ("store_used_bytes", "store_capacity", "store_num_objects",
+               "store_map_size"):
+        getattr(lib, fn).restype = u64
+        getattr(lib, fn).argtypes = [p]
+    lib.store_base_ptr.restype = ctypes.c_void_p
+    lib.store_base_ptr.argtypes = [p]
+    lib.store_list.restype = u64
+    lib.store_list.argtypes = [p, ctypes.c_char_p, u64]
+    return lib
+
+
+_lib = None
+
+
+def lib():
+    global _lib
+    if _lib is None:
+        _lib = _load_lib()
+    return _lib
+
+
+class ObjectBuffer:
+    """Zero-copy view of a sealed object; releases its store ref on GC."""
+
+    def __init__(self, client: "ObjectStoreClient", object_id: bytes,
+                 data: memoryview, metadata: bytes):
+        self.object_id = object_id
+        self.data = data
+        self.metadata = metadata
+        client._exported += 1
+        # Release exactly once, even if client is gone first.
+        self._finalizer = weakref.finalize(
+            self, ObjectStoreClient._release_static,
+            weakref.ref(client), object_id,
+        )
+
+    def release(self):
+        self._finalizer()
+
+
+class WritableBuffer:
+    """Unsealed object buffer the creator fills, then seals."""
+
+    def __init__(self, client, object_id: bytes, data: memoryview,
+                 meta: memoryview):
+        self.object_id = object_id
+        self.data = data
+        self.meta = meta
+        self._client = client
+        self._done = False
+        client._exported += 1
+
+    def seal(self):
+        if not self._done:
+            self._done = True
+            self.data = None
+            self.meta = None
+            self._client._exported -= 1
+            self._client.seal(self.object_id)
+
+    def abort(self):
+        if not self._done:
+            self._done = True
+            self.data = None
+            self.meta = None
+            self._client._exported -= 1
+            self._client.abort(self.object_id)
+
+
+class ObjectStoreClient:
+    """Python handle over one shm segment (creator or attacher)."""
+
+    def __init__(self, handle, name: str, owner: bool):
+        self._h = handle
+        self.name = name
+        self.owner = owner
+        self._exported = 0  # live zero-copy buffers handed to callers
+        base = lib().store_base_ptr(handle)
+        size = lib().store_map_size(handle)
+        # Zero-copy window over the whole segment.
+        self._seg = memoryview(
+            (ctypes.c_char * size).from_address(base)
+        ).cast("B")
+
+    # -- lifecycle --
+    @classmethod
+    def create(cls, name: str, capacity_bytes: int,
+               table_cap: int = 65536) -> "ObjectStoreClient":
+        h = lib().store_create_segment(
+            name.encode(), capacity_bytes, table_cap
+        )
+        if not h:
+            raise OSError(f"cannot create shm segment {name}")
+        return cls(h, name, owner=True)
+
+    @classmethod
+    def attach(cls, name: str) -> "ObjectStoreClient":
+        h = lib().store_attach(name.encode())
+        if not h:
+            raise OSError(f"cannot attach shm segment {name}")
+        return cls(h, name, owner=False)
+
+    def close(self):
+        """Detach/destroy the segment.
+
+        If zero-copy buffers handed out by get()/create_object() are still
+        alive, the mapping must NOT be munmapped (their memoryviews point into
+        it) — we unlink the shm name (owner) but keep the mapping until
+        process exit, and refuse new operations.
+        """
+        if self._h:
+            if self._exported > 0:
+                if self.owner:
+                    lib().store_unlink_only(self._h)
+                self._closed_leak = self._h  # keep mapping alive
+                self._h = None
+                return
+            self._seg.release()
+            self._seg = None
+            if self.owner:
+                lib().store_destroy(self._h)
+            else:
+                lib().store_detach(self._h)
+            self._h = None
+
+    # -- object ops --
+    def create_object(self, object_id: bytes, data_size: int,
+                      meta_size: int = 0) -> WritableBuffer:
+        d_off = ctypes.c_uint64()
+        m_off = ctypes.c_uint64()
+        rc = lib().store_create(
+            self._h, object_id, data_size, meta_size,
+            ctypes.byref(d_off), ctypes.byref(m_off),
+        )
+        if rc == -2:
+            raise ObjectExistsError(object_id.hex())
+        if rc == -3:
+            raise StoreFullError(
+                f"object of {data_size + meta_size} bytes doesn't fit "
+                f"(capacity {self.capacity()}, used {self.used_bytes()})"
+            )
+        if rc != 0:
+            raise OSError(f"store_create failed: {_RC.get(rc, rc)}")
+        data = self._seg[d_off.value:d_off.value + data_size]
+        meta = self._seg[m_off.value:m_off.value + meta_size]
+        return WritableBuffer(self, object_id, data, meta)
+
+    def put_bytes(self, object_id: bytes, data, metadata: bytes = b"") -> None:
+        """Create+fill+seal in one call. `data` is bytes-like or a list of
+        bytes-like chunks (concatenated without an intermediate copy)."""
+        chunks = data if isinstance(data, (list, tuple)) else [data]
+        total = sum(len(c) for c in chunks)
+        buf = self.create_object(object_id, total, len(metadata))
+        off = 0
+        for c in chunks:
+            n = len(c)
+            buf.data[off:off + n] = bytes(c) if not isinstance(
+                c, (bytes, bytearray, memoryview)) else c
+            off += n
+        if metadata:
+            buf.meta[:] = metadata
+        buf.seal()
+
+    def seal(self, object_id: bytes):
+        rc = lib().store_seal(self._h, object_id)
+        if rc != 0:
+            raise OSError(f"seal failed: {_RC.get(rc, rc)}")
+
+    def abort(self, object_id: bytes):
+        lib().store_abort(self._h, object_id)
+
+    def get(self, object_id: bytes) -> ObjectBuffer | None:
+        """Non-blocking: None if absent/unsealed; pins the object if found."""
+        d_off = ctypes.c_uint64()
+        d_sz = ctypes.c_uint64()
+        m_off = ctypes.c_uint64()
+        m_sz = ctypes.c_uint64()
+        rc = lib().store_get(
+            self._h, object_id, ctypes.byref(d_off), ctypes.byref(d_sz),
+            ctypes.byref(m_off), ctypes.byref(m_sz),
+        )
+        if rc != 0:
+            return None
+        data = self._seg[d_off.value:d_off.value + d_sz.value]
+        meta = bytes(self._seg[m_off.value:m_off.value + m_sz.value])
+        return ObjectBuffer(self, object_id, data, meta)
+
+    def release(self, object_id: bytes):
+        if self._h:
+            lib().store_release(self._h, object_id)
+
+    @staticmethod
+    def _release_static(client_ref, object_id: bytes):
+        client = client_ref()
+        if client is not None:
+            client._exported -= 1
+            if client._h:
+                lib().store_release(client._h, object_id)
+
+    def delete(self, object_id: bytes) -> bool:
+        return lib().store_delete(self._h, object_id) == 0
+
+    def contains(self, object_id: bytes) -> bool:
+        return lib().store_contains(self._h, object_id) == 2
+
+    def pin(self, object_id: bytes, pinned: bool = True):
+        lib().store_pin(self._h, object_id, 1 if pinned else 0)
+
+    def evict(self, needed: int) -> int:
+        return lib().store_evict(self._h, needed)
+
+    def list_objects(self, max_n: int = 65536) -> list[bytes]:
+        buf = ctypes.create_string_buffer(max_n * _ID_SIZE)
+        n = lib().store_list(self._h, buf, max_n)
+        raw = buf.raw
+        return [raw[i * _ID_SIZE:(i + 1) * _ID_SIZE] for i in range(n)]
+
+    def used_bytes(self) -> int:
+        return lib().store_used_bytes(self._h)
+
+    def capacity(self) -> int:
+        return lib().store_capacity(self._h)
+
+    def num_objects(self) -> int:
+        return lib().store_num_objects(self._h)
+
+
+def default_segment_name(session_id: str) -> str:
+    return f"/ray_tpu_store_{session_id}_{os.getuid()}"

@@ -1,0 +1,566 @@
+// Shared-memory object store core.
+//
+// TPU-native plasma equivalent (reference behavior:
+// src/ray/object_manager/plasma/store.h:55, object_lifecycle_manager.h:101,
+// eviction_policy.h:105). One POSIX shm segment per node holds a boundary-tag
+// heap plus an open-addressed object table; every process on the node attaches
+// the same segment and reads sealed objects zero-copy. Unlike plasma there is
+// no store server socket protocol: clients mutate the table directly under a
+// robust process-shared mutex (create/seal/get/release/delete are O(1) table
+// ops + allocator work), which removes a per-object IPC round trip entirely.
+//
+// Object lifecycle (mirrors plasma semantics):
+//   create (unsealed, writer fills buffer) -> seal (immutable, readable)
+//   -> refcounted by readers -> evictable only when sealed and refcount==0,
+//   LRU order. abort() frees an unsealed object whose writer died.
+//
+// Crash-safety: PTHREAD_MUTEX_ROBUST; a lock holder dying leaves the mutex
+// recoverable (EOWNERDEAD -> pthread_mutex_consistent). Table/heap metadata is
+// only touched under the lock, and each mutation is small enough that a
+// post-crash state is still structurally consistent for our purposes.
+
+#include <errno.h>
+#include <fcntl.h>
+#include <pthread.h>
+#include <stdint.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstdio>
+
+extern "C" {
+
+// ---- return codes ----
+#define OS_OK 0
+#define OS_NOT_FOUND -1
+#define OS_EXISTS -2
+#define OS_FULL -3
+#define OS_BAD_STATE -4
+#define OS_ERR -5
+
+#define OS_ID_SIZE 16
+#define OS_MAGIC 0x7261795f74707573ULL  // "ray_tpus"
+#define OS_ALIGN 64
+
+// Object states.
+#define ST_FREE 0
+#define ST_CREATED 1
+#define ST_SEALED 2
+#define ST_TOMBSTONE 3
+
+typedef struct ObjectEntry {
+  uint8_t id[OS_ID_SIZE];
+  uint64_t data_off;  // offset from segment base
+  uint64_t data_size;
+  uint64_t meta_off;
+  uint64_t meta_size;
+  int32_t refcount;
+  uint8_t state;
+  uint8_t pinned;  // primary copy pinned by the node agent: never evict
+  uint16_t _pad;
+  uint64_t lru_tick;
+} ObjectEntry;
+
+// Free block header, stored inside the heap region itself.
+typedef struct FreeBlock {
+  uint64_t size;       // bytes including this header
+  uint64_t next_off;   // offset of next free block from heap base, 0 = end
+} FreeBlock;
+
+typedef struct ShmHeader {
+  uint64_t magic;
+  uint64_t segment_size;
+  uint64_t heap_off;     // offset of heap region from segment base
+  uint64_t heap_size;
+  uint64_t table_cap;    // number of entries (power of two)
+  uint64_t num_objects;
+  uint64_t used_bytes;
+  uint64_t lru_clock;
+  uint64_t free_head;    // offset of first free block from heap base, 0=none
+  pthread_mutex_t mutex;
+  // ObjectEntry table[table_cap] follows.
+} ShmHeader;
+
+typedef struct Store {
+  ShmHeader* hdr;
+  uint8_t* base;
+  uint64_t map_size;
+  int owner;  // created (vs attached)
+  char name[256];
+} Store;
+
+static ObjectEntry* table_of(ShmHeader* h) {
+  return (ObjectEntry*)((uint8_t*)h + sizeof(ShmHeader));
+}
+
+static uint64_t id_hash(const uint8_t* id) {
+  uint64_t h;
+  memcpy(&h, id, 8);
+  h ^= h >> 33;
+  h *= 0xff51afd7ed558ccdULL;
+  h ^= h >> 33;
+  return h;
+}
+
+static void lock(ShmHeader* h) {
+  int rc = pthread_mutex_lock(&h->mutex);
+  if (rc == EOWNERDEAD) {
+    // Previous holder died; state is still usable for our small critical
+    // sections. Mark consistent and continue.
+    pthread_mutex_consistent(&h->mutex);
+  }
+}
+
+static void unlock(ShmHeader* h) { pthread_mutex_unlock(&h->mutex); }
+
+// ---- entry lookup (open addressing, linear probe) ----
+static ObjectEntry* find_entry(ShmHeader* h, const uint8_t* id) {
+  ObjectEntry* tab = table_of(h);
+  uint64_t mask = h->table_cap - 1;
+  uint64_t i = id_hash(id) & mask;
+  for (uint64_t probe = 0; probe < h->table_cap; probe++) {
+    ObjectEntry* e = &tab[i];
+    if (e->state == ST_FREE) return NULL;
+    if (e->state != ST_TOMBSTONE && memcmp(e->id, id, OS_ID_SIZE) == 0)
+      return e;
+    i = (i + 1) & mask;
+  }
+  return NULL;
+}
+
+static ObjectEntry* alloc_entry(ShmHeader* h, const uint8_t* id) {
+  ObjectEntry* tab = table_of(h);
+  uint64_t mask = h->table_cap - 1;
+  uint64_t i = id_hash(id) & mask;
+  ObjectEntry* first_tomb = NULL;
+  for (uint64_t probe = 0; probe < h->table_cap; probe++) {
+    ObjectEntry* e = &tab[i];
+    if (e->state == ST_FREE) return first_tomb ? first_tomb : e;
+    if (e->state == ST_TOMBSTONE) {
+      if (!first_tomb) first_tomb = e;
+    } else if (memcmp(e->id, id, OS_ID_SIZE) == 0) {
+      return NULL;  // exists
+    }
+    i = (i + 1) & mask;
+  }
+  return first_tomb;  // table full unless a tombstone was found
+}
+
+// ---- heap allocator: first-fit free list with coalescing ----
+static uint64_t heap_alloc(ShmHeader* h, uint64_t want) {
+  want = (want + OS_ALIGN - 1) & ~(uint64_t)(OS_ALIGN - 1);
+  if (want < sizeof(FreeBlock)) want = OS_ALIGN;
+  uint8_t* heap = (uint8_t*)h + h->heap_off;
+  uint64_t prev_off = 0;
+  uint64_t cur = h->free_head;
+  while (cur) {
+    FreeBlock* fb = (FreeBlock*)(heap + cur);
+    if (fb->size >= want) {
+      uint64_t remain = fb->size - want;
+      if (remain >= OS_ALIGN) {
+        // split: tail remains free
+        uint64_t tail_off = cur + want;
+        FreeBlock* tail = (FreeBlock*)(heap + tail_off);
+        tail->size = remain;
+        tail->next_off = fb->next_off;
+        if (prev_off)
+          ((FreeBlock*)(heap + prev_off))->next_off = tail_off;
+        else
+          h->free_head = tail_off;
+      } else {
+        want = fb->size;  // use whole block
+        if (prev_off)
+          ((FreeBlock*)(heap + prev_off))->next_off = fb->next_off;
+        else
+          h->free_head = fb->next_off;
+      }
+      h->used_bytes += want;
+      return cur;
+    }
+    prev_off = cur;
+    cur = fb->next_off;
+  }
+  return UINT64_MAX;  // no fit
+}
+
+static void heap_free(ShmHeader* h, uint64_t off, uint64_t size) {
+  size = (size + OS_ALIGN - 1) & ~(uint64_t)(OS_ALIGN - 1);
+  if (size < sizeof(FreeBlock)) size = OS_ALIGN;
+  uint8_t* heap = (uint8_t*)h + h->heap_off;
+  h->used_bytes -= size;
+  // insert sorted by offset, coalesce neighbors
+  uint64_t prev_off = 0, cur = h->free_head;
+  while (cur && cur < off) {
+    prev_off = cur;
+    cur = ((FreeBlock*)(heap + cur))->next_off;
+  }
+  FreeBlock* nb = (FreeBlock*)(heap + off);
+  nb->size = size;
+  nb->next_off = cur;
+  if (prev_off) {
+    FreeBlock* pb = (FreeBlock*)(heap + prev_off);
+    pb->next_off = off;
+    // coalesce prev + new
+    if (prev_off + pb->size == off) {
+      pb->size += nb->size;
+      pb->next_off = nb->next_off;
+      nb = pb;
+      off = prev_off;
+    }
+  } else {
+    h->free_head = off;
+  }
+  // coalesce new + next
+  if (nb->next_off && off + nb->size == nb->next_off) {
+    FreeBlock* nxt = (FreeBlock*)(heap + nb->next_off);
+    nb->size += nxt->size;
+    nb->next_off = nxt->next_off;
+  }
+}
+
+// Storage size for one object (data + meta in one block).
+static uint64_t obj_block_size(ObjectEntry* e) {
+  uint64_t total = e->data_size + e->meta_size;
+  total = (total + OS_ALIGN - 1) & ~(uint64_t)(OS_ALIGN - 1);
+  if (total < OS_ALIGN) total = OS_ALIGN;
+  return total;
+}
+
+// Evict LRU sealed unreferenced objects until `needed` heap bytes could fit.
+// Returns freed byte count. Caller holds lock.
+static uint64_t evict_locked(ShmHeader* h, uint64_t needed) {
+  uint64_t freed = 0;
+  while (h->used_bytes + needed > h->heap_size) {
+    ObjectEntry* tab = table_of(h);
+    ObjectEntry* victim = NULL;
+    for (uint64_t i = 0; i < h->table_cap; i++) {
+      ObjectEntry* e = &tab[i];
+      if (e->state == ST_SEALED && e->refcount == 0 && !e->pinned) {
+        if (!victim || e->lru_tick < victim->lru_tick) victim = e;
+      }
+    }
+    if (!victim) break;
+    uint64_t blk = obj_block_size(victim);
+    heap_free(h, victim->data_off - h->heap_off, blk);
+    victim->state = ST_TOMBSTONE;
+    h->num_objects--;
+    freed += blk;
+  }
+  return freed;
+}
+
+// ---- public API ----
+
+void* store_create_segment(const char* name, uint64_t heap_size,
+                           uint64_t table_cap) {
+  // round table_cap to power of two
+  uint64_t cap = 1;
+  while (cap < table_cap) cap <<= 1;
+  uint64_t table_bytes = cap * sizeof(ObjectEntry);
+  uint64_t header_bytes = sizeof(ShmHeader) + table_bytes;
+  header_bytes = (header_bytes + 4095) & ~4095ULL;
+  uint64_t total = header_bytes + heap_size;
+
+  shm_unlink(name);
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return NULL;
+  if (ftruncate(fd, (off_t)total) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return NULL;
+  }
+  void* base = mmap(NULL, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (base == MAP_FAILED) {
+    shm_unlink(name);
+    return NULL;
+  }
+  ShmHeader* h = (ShmHeader*)base;
+  memset(h, 0, sizeof(ShmHeader));
+  memset((uint8_t*)base + sizeof(ShmHeader), 0, table_bytes);
+  h->segment_size = total;
+  h->heap_off = header_bytes;
+  h->heap_size = heap_size;
+  h->table_cap = cap;
+  h->num_objects = 0;
+  h->used_bytes = 0;
+  h->lru_clock = 1;
+  // one big free block
+  uint8_t* heap = (uint8_t*)base + header_bytes;
+  FreeBlock* fb = (FreeBlock*)(heap + OS_ALIGN);  // offset 0 reserved (0 == nil)
+  fb->size = heap_size - OS_ALIGN;
+  fb->next_off = 0;
+  h->free_head = OS_ALIGN;
+  h->heap_size = heap_size;  // used_bytes compares against this
+  h->used_bytes = OS_ALIGN;  // reserved nil block counts as used
+
+  pthread_mutexattr_t mattr;
+  pthread_mutexattr_init(&mattr);
+  pthread_mutexattr_setpshared(&mattr, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&mattr, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mutex, &mattr);
+  pthread_mutexattr_destroy(&mattr);
+  h->magic = OS_MAGIC;
+
+  Store* s = new Store();
+  s->hdr = h;
+  s->base = (uint8_t*)base;
+  s->map_size = total;
+  s->owner = 1;
+  snprintf(s->name, sizeof(s->name), "%s", name);
+  return s;
+}
+
+void* store_attach(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return NULL;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return NULL;
+  }
+  void* base =
+      mmap(NULL, st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (base == MAP_FAILED) return NULL;
+  ShmHeader* h = (ShmHeader*)base;
+  if (h->magic != OS_MAGIC) {
+    munmap(base, st.st_size);
+    return NULL;
+  }
+  Store* s = new Store();
+  s->hdr = h;
+  s->base = (uint8_t*)base;
+  s->map_size = st.st_size;
+  s->owner = 0;
+  snprintf(s->name, sizeof(s->name), "%s", name);
+  return s;
+}
+
+void store_detach(void* sp) {
+  Store* s = (Store*)sp;
+  munmap(s->base, s->map_size);
+  delete s;
+}
+
+// Remove the shm name without unmapping: used when zero-copy buffers are
+// still exported to Python; the mapping lives until process exit.
+void store_unlink_only(void* sp) {
+  Store* s = (Store*)sp;
+  shm_unlink(s->name);
+}
+
+void store_destroy(void* sp) {
+  Store* s = (Store*)sp;
+  char name[256];
+  snprintf(name, sizeof(name), "%s", s->name);
+  munmap(s->base, s->map_size);
+  shm_unlink(name);
+  delete s;
+}
+
+int store_create(void* sp, const uint8_t* id, uint64_t data_size,
+                 uint64_t meta_size, uint64_t* data_off, uint64_t* meta_off) {
+  Store* s = (Store*)sp;
+  ShmHeader* h = s->hdr;
+  uint64_t want = data_size + meta_size;
+  want = (want + OS_ALIGN - 1) & ~(uint64_t)(OS_ALIGN - 1);
+  if (want < OS_ALIGN) want = OS_ALIGN;
+  lock(h);
+  if (find_entry(h, id)) {
+    unlock(h);
+    return OS_EXISTS;
+  }
+  if (want > h->heap_size) {
+    unlock(h);
+    return OS_FULL;
+  }
+  uint64_t off = heap_alloc(h, want);
+  if (off == UINT64_MAX) {
+    evict_locked(h, want);
+    off = heap_alloc(h, want);
+  }
+  if (off == UINT64_MAX) {
+    unlock(h);
+    return OS_FULL;
+  }
+  ObjectEntry* e = alloc_entry(h, id);
+  if (!e) {
+    heap_free(h, off, want);
+    unlock(h);
+    return OS_FULL;  // table full
+  }
+  memcpy(e->id, id, OS_ID_SIZE);
+  e->data_off = h->heap_off + off;
+  e->data_size = data_size;
+  e->meta_off = e->data_off + data_size;
+  e->meta_size = meta_size;
+  e->refcount = 1;  // creator holds a ref until seal+release
+  e->state = ST_CREATED;
+  e->pinned = 0;
+  e->lru_tick = h->lru_clock++;
+  h->num_objects++;
+  *data_off = e->data_off;
+  *meta_off = e->meta_off;
+  unlock(h);
+  return OS_OK;
+}
+
+int store_seal(void* sp, const uint8_t* id) {
+  Store* s = (Store*)sp;
+  ShmHeader* h = s->hdr;
+  lock(h);
+  ObjectEntry* e = find_entry(h, id);
+  if (!e) {
+    unlock(h);
+    return OS_NOT_FOUND;
+  }
+  if (e->state != ST_CREATED) {
+    unlock(h);
+    return OS_BAD_STATE;
+  }
+  e->state = ST_SEALED;
+  e->refcount -= 1;  // drop creator ref
+  e->lru_tick = h->lru_clock++;
+  unlock(h);
+  return OS_OK;
+}
+
+int store_get(void* sp, const uint8_t* id, uint64_t* data_off,
+              uint64_t* data_size, uint64_t* meta_off, uint64_t* meta_size) {
+  Store* s = (Store*)sp;
+  ShmHeader* h = s->hdr;
+  lock(h);
+  ObjectEntry* e = find_entry(h, id);
+  if (!e || e->state != ST_SEALED) {
+    int rc = (!e) ? OS_NOT_FOUND : OS_BAD_STATE;
+    unlock(h);
+    return rc;
+  }
+  e->refcount++;
+  e->lru_tick = h->lru_clock++;
+  *data_off = e->data_off;
+  *data_size = e->data_size;
+  *meta_off = e->meta_off;
+  *meta_size = e->meta_size;
+  unlock(h);
+  return OS_OK;
+}
+
+int store_release(void* sp, const uint8_t* id) {
+  Store* s = (Store*)sp;
+  ShmHeader* h = s->hdr;
+  lock(h);
+  ObjectEntry* e = find_entry(h, id);
+  if (!e) {
+    unlock(h);
+    return OS_NOT_FOUND;
+  }
+  if (e->refcount > 0) e->refcount--;
+  unlock(h);
+  return OS_OK;
+}
+
+int store_delete(void* sp, const uint8_t* id) {
+  Store* s = (Store*)sp;
+  ShmHeader* h = s->hdr;
+  lock(h);
+  ObjectEntry* e = find_entry(h, id);
+  if (!e) {
+    unlock(h);
+    return OS_NOT_FOUND;
+  }
+  if (e->refcount > 0) {
+    unlock(h);
+    return OS_BAD_STATE;
+  }
+  heap_free(h, e->data_off - h->heap_off, obj_block_size(e));
+  e->state = ST_TOMBSTONE;
+  h->num_objects--;
+  unlock(h);
+  return OS_OK;
+}
+
+// Abort an unsealed object (writer died / cancelled).
+int store_abort(void* sp, const uint8_t* id) {
+  Store* s = (Store*)sp;
+  ShmHeader* h = s->hdr;
+  lock(h);
+  ObjectEntry* e = find_entry(h, id);
+  if (!e) {
+    unlock(h);
+    return OS_NOT_FOUND;
+  }
+  if (e->state != ST_CREATED) {
+    unlock(h);
+    return OS_BAD_STATE;
+  }
+  heap_free(h, e->data_off - h->heap_off, obj_block_size(e));
+  e->state = ST_TOMBSTONE;
+  h->num_objects--;
+  unlock(h);
+  return OS_OK;
+}
+
+// 2 = sealed, 1 = created (unsealed), 0 = absent
+int store_contains(void* sp, const uint8_t* id) {
+  Store* s = (Store*)sp;
+  ShmHeader* h = s->hdr;
+  lock(h);
+  ObjectEntry* e = find_entry(h, id);
+  int rc = 0;
+  if (e) rc = (e->state == ST_SEALED) ? 2 : 1;
+  unlock(h);
+  return rc;
+}
+
+int store_pin(void* sp, const uint8_t* id, int pinned) {
+  Store* s = (Store*)sp;
+  ShmHeader* h = s->hdr;
+  lock(h);
+  ObjectEntry* e = find_entry(h, id);
+  if (!e) {
+    unlock(h);
+    return OS_NOT_FOUND;
+  }
+  e->pinned = (uint8_t)(pinned != 0);
+  unlock(h);
+  return OS_OK;
+}
+
+uint64_t store_evict(void* sp, uint64_t needed) {
+  Store* s = (Store*)sp;
+  ShmHeader* h = s->hdr;
+  lock(h);
+  uint64_t freed = evict_locked(h, needed);
+  unlock(h);
+  return freed;
+}
+
+uint64_t store_used_bytes(void* sp) { return ((Store*)sp)->hdr->used_bytes; }
+uint64_t store_capacity(void* sp) { return ((Store*)sp)->hdr->heap_size; }
+uint64_t store_num_objects(void* sp) { return ((Store*)sp)->hdr->num_objects; }
+
+uint8_t* store_base_ptr(void* sp) { return ((Store*)sp)->base; }
+uint64_t store_map_size(void* sp) { return ((Store*)sp)->map_size; }
+
+// Fill ids_out (cap OS_ID_SIZE*max) with sealed object ids; returns count.
+uint64_t store_list(void* sp, uint8_t* ids_out, uint64_t max) {
+  Store* s = (Store*)sp;
+  ShmHeader* h = s->hdr;
+  lock(h);
+  ObjectEntry* tab = table_of(h);
+  uint64_t n = 0;
+  for (uint64_t i = 0; i < h->table_cap && n < max; i++) {
+    if (tab[i].state == ST_SEALED) {
+      memcpy(ids_out + n * OS_ID_SIZE, tab[i].id, OS_ID_SIZE);
+      n++;
+    }
+  }
+  unlock(h);
+  return n;
+}
+
+}  // extern "C"

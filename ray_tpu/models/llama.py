@@ -72,7 +72,7 @@ class LlamaConfig:
 
 
 def llama2_7b() -> LlamaConfig:
-    return LlamaConfig(n_kv_heads=32)  # Llama-2-7B uses MHA (32 kv heads)
+    return llama2_size("7b")
 
 
 def llama2_size(name: str) -> LlamaConfig:

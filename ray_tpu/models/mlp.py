@@ -10,6 +10,8 @@ import math
 import jax
 import jax.numpy as jnp
 
+from ray_tpu.ops.losses import softmax_cross_entropy
+
 
 @dataclasses.dataclass(frozen=True)
 class MLPConfig:
@@ -54,8 +56,6 @@ def forward(params, x, cfg: MLPConfig):
 def loss_fn(params, batch, cfg: MLPConfig):
     logits = forward(params, batch["x"], cfg)
     labels = batch["y"]
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    nll = logz - jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
-    loss = nll.mean()
+    loss = softmax_cross_entropy(logits, labels).mean()
     acc = (logits.argmax(-1) == labels).mean()
     return loss, {"loss": loss, "accuracy": acc}

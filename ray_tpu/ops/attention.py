@@ -49,11 +49,14 @@ def attention_reference(q, k, v, *, causal: bool = True, logits_dtype=jnp.float3
 def attention(q, k, v, *, causal: bool = True, use_flash: bool | None = None):
     """Dispatching attention entry point.
 
-    use_flash=None → flash kernel on TPU backends (when block divisibility
-    holds), reference elsewhere. The flash kernel is TPU-only (pltpu memory
+    use_flash=None → flash kernel on TPU backends when block divisibility
+    holds, reference elsewhere. The flash kernel is TPU-only (pltpu memory
     spaces); other accelerators use the reference path, which XLA fuses.
+    Explicit use_flash=True is a hard request: non-divisible sequence lengths
+    raise (pad to the block size) instead of silently hitting the O(T*S) path.
     """
-    if use_flash is None:
+    auto = use_flash is None
+    if auto:
         use_flash = jax.default_backend() == "tpu"
     if use_flash:
         from ray_tpu.ops.flash_attention import (
@@ -66,4 +69,10 @@ def attention(q, k, v, *, causal: bool = True, use_flash: bool | None = None):
         bq, bk = min(DEFAULT_BLOCK_Q, t), min(DEFAULT_BLOCK_K, s)
         if t % bq == 0 and s % bk == 0:
             return flash_attention(q, k, v, causal=causal, block_q=bq, block_k=bk)
+        if not auto:
+            raise ValueError(
+                f"use_flash=True but seq lengths (T={t}, S={s}) are not "
+                f"multiples of the flash blocks ({bq}, {bk}); pad the "
+                "sequence or pass use_flash=None for automatic fallback"
+            )
     return attention_reference(q, k, v, causal=causal)

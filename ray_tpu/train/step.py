@@ -18,6 +18,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ray_tpu.parallel.mesh import use_mesh
+from ray_tpu.utils.trees import path_name
 from ray_tpu.parallel.sharding import (
     DEFAULT_RULES,
     LogicalRules,
@@ -51,17 +52,7 @@ def batch_sharding(mesh: Mesh, rules: LogicalRules = DEFAULT_RULES, *, ndim: int
 
 def _path_names(path) -> tuple[str, ...]:
     """Normalize a jax key path to a tuple of string names."""
-    out = []
-    for p in path:
-        if hasattr(p, "key"):
-            out.append(str(p.key))
-        elif hasattr(p, "name"):
-            out.append(str(p.name))
-        elif hasattr(p, "idx"):
-            out.append(str(p.idx))
-        else:  # pragma: no cover
-            out.append(str(p))
-    return tuple(out)
+    return tuple(path_name(path).split("/"))
 
 
 def init_train_state(

@@ -17,18 +17,21 @@ def tree_size_bytes(tree) -> int:
     )
 
 
+def path_name(path) -> str:
+    """Slash-joined name for a jax key path ('a/b/0/c')."""
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
 def tree_map_with_path_names(fn, tree):
     """Like tree_map but fn receives ('a/b/c', leaf) with slash-joined key path."""
-
-    def _name(path):
-        parts = []
-        for p in path:
-            if hasattr(p, "key"):
-                parts.append(str(p.key))
-            elif hasattr(p, "idx"):
-                parts.append(str(p.idx))
-            else:
-                parts.append(str(p))
-        return "/".join(parts)
-
-    return jax.tree_util.tree_map_with_path(lambda p, x: fn(_name(p), x), tree)
+    return jax.tree_util.tree_map_with_path(lambda p, x: fn(path_name(p), x), tree)

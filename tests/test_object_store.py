@@ -1,0 +1,227 @@
+"""Object store + runtime-foundation tests.
+
+Mirrors the reference's plasma test strategy (plasma store gtests +
+python/ray/tests/test_object_store.py): lifecycle, zero-copy, refcounts,
+eviction, cross-process sharing, crash of an unsealed writer.
+"""
+
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu._private import rpc, serialization
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID
+from ray_tpu.core.object_store import (
+    ObjectExistsError,
+    ObjectStoreClient,
+    StoreFullError,
+)
+
+MB = 1024 * 1024
+
+
+@pytest.fixture
+def store():
+    name = f"/rt_test_{os.getpid()}_{os.urandom(4).hex()}"
+    s = ObjectStoreClient.create(name, 32 * MB, table_cap=1024)
+    yield s
+    s.close()
+
+
+def test_put_get_roundtrip(store):
+    oid = os.urandom(16)
+    arr = np.arange(10000, dtype=np.float64)
+    buf = store.create_object(oid, arr.nbytes, 3)
+    np.frombuffer(buf.data, dtype=np.float64)[:] = arr
+    buf.meta[:] = b"abc"
+    buf.seal()
+
+    got = store.get(oid)
+    out = np.frombuffer(got.data, dtype=np.float64)
+    np.testing.assert_array_equal(out, arr)
+    assert got.metadata == b"abc"
+    # zero-copy: same memory, not a copy
+    assert out.base is not None
+
+
+def test_get_absent_and_unsealed(store):
+    assert store.get(os.urandom(16)) is None
+    oid = os.urandom(16)
+    buf = store.create_object(oid, 100)
+    assert store.get(oid) is None  # unsealed not readable
+    assert not store.contains(oid)
+    buf.seal()
+    assert store.contains(oid)
+
+
+def test_double_create_raises(store):
+    oid = os.urandom(16)
+    store.put_bytes(oid, b"x")
+    with pytest.raises(ObjectExistsError):
+        store.create_object(oid, 10)
+
+
+def test_refcount_blocks_delete(store):
+    oid = os.urandom(16)
+    store.put_bytes(oid, b"payload")
+    got = store.get(oid)
+    assert not store.delete(oid)  # pinned by reader
+    got.release()
+    assert store.delete(oid)
+    assert store.get(oid) is None
+
+
+def test_eviction_lru(store):
+    first = os.urandom(16)
+    store.put_bytes(first, b"a" * MB)
+    # touch `first` so it's MRU, then fill the store
+    store.get(first).release()
+    ids = [os.urandom(16) for _ in range(40)]
+    for i in ids:
+        store.put_bytes(i, b"b" * MB)
+    # store only holds 32MB: early fill objects evicted, latest present
+    assert store.contains(ids[-1])
+    assert store.used_bytes() <= store.capacity()
+
+
+def test_pinned_never_evicted(store):
+    oid = os.urandom(16)
+    store.put_bytes(oid, b"a" * MB)
+    store.pin(oid)
+    for _ in range(40):
+        store.put_bytes(os.urandom(16), b"b" * MB)
+    assert store.contains(oid)
+    store.pin(oid, False)
+
+
+def test_too_large_raises(store):
+    with pytest.raises(StoreFullError):
+        store.create_object(os.urandom(16), 33 * MB)
+
+
+def test_put_bytes_chunked(store):
+    oid = os.urandom(16)
+    store.put_bytes(oid, [b"ab", b"cd", memoryview(b"ef")])
+    got = store.get(oid)
+    assert bytes(got.data) == b"abcdef"
+
+
+def _child_writer(name, oid):
+    c = ObjectStoreClient.attach(name)
+    arr = np.ones(1024, dtype=np.int32)
+    buf = c.create_object(oid, arr.nbytes)
+    np.frombuffer(buf.data, dtype=np.int32)[:] = arr
+    buf.seal()
+    c.close()
+
+
+def test_cross_process(store):
+    oid = os.urandom(16)
+    p = mp.get_context("spawn").Process(
+        target=_child_writer, args=(store.name, oid)
+    )
+    p.start()
+    p.join(30)
+    assert p.exitcode == 0
+    got = store.get(oid)
+    assert np.frombuffer(got.data, dtype=np.int32).sum() == 1024
+
+
+def _child_dier(name, oid):
+    c = ObjectStoreClient.attach(name)
+    c.create_object(oid, 4096)
+    os._exit(1)  # die with the object unsealed
+
+
+def test_unsealed_writer_crash_abortable(store):
+    oid = os.urandom(16)
+    p = mp.get_context("spawn").Process(
+        target=_child_dier, args=(store.name, oid)
+    )
+    p.start()
+    p.join(30)
+    assert not store.contains(oid)
+    store.abort(oid)  # node agent cleanup path
+    # slot is reusable afterwards
+    store.put_bytes(oid, b"again")
+    assert store.contains(oid)
+
+
+# ---- IDs ----
+
+def test_id_derivation_deterministic():
+    job = JobID.from_random()
+    t1 = TaskID.for_task(job, None, 1)
+    t2 = TaskID.for_task(job, None, 1)
+    assert t1 == t2
+    assert TaskID.for_task(job, None, 2) != t1
+    o1 = ObjectID.for_task_return(t1, 0)
+    assert o1 == ObjectID.for_task_return(t1, 0)
+    assert o1 != ObjectID.for_task_return(t1, 1)
+    a = ActorID.from_random()
+    assert TaskID.for_actor_task(a, 5) == TaskID.for_actor_task(a, 5)
+
+
+def test_id_roundtrip():
+    i = ObjectID.from_random()
+    assert ObjectID.from_hex(i.hex()) == i
+    assert not i.is_nil()
+    assert ObjectID.nil().is_nil()
+
+
+# ---- serialization ----
+
+def test_serialization_oob_buffers():
+    arr = np.arange(100000, dtype=np.float32)
+    obj = {"a": arr, "b": [1, 2, "three"]}
+    meta, bufs = serialization.dumps_oob(obj)
+    # big array went out-of-band, not into the pickle stream
+    assert len(meta) < 10000
+    assert sum(len(memoryview(b)) for b in bufs) >= arr.nbytes
+    back = serialization.loads_oob(meta, bufs)
+    np.testing.assert_array_equal(back["a"], arr)
+    assert back["b"] == obj["b"]
+
+
+# ---- rpc ----
+
+def test_rpc_roundtrip_and_push():
+    io = rpc.EventLoopThread("test-io")
+
+    server = rpc.RpcServer()
+
+    async def echo(conn, payload):
+        return {"echo": payload}
+
+    async def boom(conn, payload):
+        raise ValueError("kapow")
+
+    server.handlers["echo"] = echo
+    server.handlers["boom"] = boom
+    port = io.run(server.start())
+
+    client = rpc.SyncRpcClient("127.0.0.1", port, io)
+    assert client.call("echo", [1, "x", b"bin"]) == {"echo": [1, "x", b"bin"]}
+
+    with pytest.raises(rpc.RpcError, match="kapow"):
+        client.call("boom")
+
+    # server push
+    got = []
+    client.on_push("chan", got.append)
+    io.run(_push_all(server, "chan", {"k": 1}))
+    deadline = __import__("time").time() + 5
+    while not got and __import__("time").time() < deadline:
+        __import__("time").sleep(0.01)
+    assert got == [{"k": 1}]
+
+    client.close()
+    io.run(server.stop())
+    io.stop()
+
+
+async def _push_all(server, chan, payload):
+    for conn in server.conns:
+        conn.push(chan, payload)
