@@ -28,10 +28,19 @@ _RUNTIME_API = (
     "cancel",
     "get_actor",
     "method",
+    "free",
     "available_resources",
     "cluster_resources",
+    "nodes",
+    "placement_group",
+    "remove_placement_group",
+    "PlacementGroup",
     "ObjectRef",
     "ActorHandle",
+    "RayTaskError",
+    "RayActorError",
+    "GetTimeoutError",
+    "ObjectLostError",
 )
 
 

@@ -130,16 +130,18 @@ class RpcServer:
         return self.port
 
     async def stop(self):
-        if self._server is not None:
-            self._server.close()
-            try:
-                await self._server.wait_closed()
-            except Exception:
-                pass
+        # Close live connections BEFORE wait_closed(): since 3.12,
+        # wait_closed blocks until every connection handler returns.
         for conn in list(self.conns):
             try:
                 conn.writer.close()
             except Exception:
+                pass
+        if self._server is not None:
+            self._server.close()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), timeout=2)
+            except (Exception, asyncio.TimeoutError):
                 pass
 
     async def _handle_conn(self, reader, writer):
@@ -349,11 +351,23 @@ class SyncRpcClient:
     def oneway(self, method: str, payload: Any = None):
         return self.io.run(self.client.oneway(method, payload))
 
+    def fire(self, method: str, payload: Any = None):
+        """Fire-and-forget; safe from any thread including the IO loop."""
+        if threading.current_thread() is self.io.thread:
+            asyncio.ensure_future(self.client.oneway(method, payload))
+        else:
+            self.io.submit(self.client.oneway(method, payload))
+
     def on_push(self, channel: str, fn):
         self.client.on_push(channel, fn)
 
     def close(self):
+        # Safe from any thread, including the IO loop itself (push
+        # callbacks): never block the loop waiting on its own work.
+        if threading.current_thread() is self.io.thread:
+            asyncio.ensure_future(self.client.close())
+            return
         try:
-            self.io.run(self.client.close())
+            self.io.run(self.client.close(), timeout=5)
         except Exception:
             pass

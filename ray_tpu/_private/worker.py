@@ -1,0 +1,714 @@
+"""CoreWorker: the library linked into every driver and executor.
+
+Analog of the reference core-worker (`src/ray/core_worker/core_worker.h:284`
++ the Cython binding `_raylet.pyx`): owns task submission, the in-process
+memory store for small results, object put/get/wait, actor handles and
+per-actor ordered submission queues, retries, and the worker's own RPC
+server (results are pushed owner-directly, as in the reference's
+direct task/actor transports, `transport/direct_task_transport.h:75`).
+
+Ownership model (reference reference_count.h:61, simplified): the worker
+that creates a ref (task submission or put) is its owner; small values live
+in the owner's memory store and are served to borrowers via the owner's RPC;
+large values live in the node shm store with locations tracked by the
+control-plane directory. Full borrower-count GC is future work — objects are
+freed on owner ref-drop or job end.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+import traceback
+from typing import Any
+
+from ray_tpu._private import rpc, serialization
+from ray_tpu._private.ids import (
+    ActorID,
+    JobID,
+    ObjectID,
+    TaskID,
+    WorkerID,
+    _Counter,
+)
+from ray_tpu._private.rpc import AsyncRpcClient, EventLoopThread, RpcServer
+from ray_tpu.core.object_store import ObjectStoreClient, StoreFullError
+
+logger = logging.getLogger(__name__)
+
+INLINE_MAX = 100 * 1024  # results/args under this ride inline; over → shm
+FUNC_NS = "funcs"
+
+
+class RayTaskError(Exception):
+    """A task raised; carries the remote traceback (reference RayTaskError)."""
+
+    def __init__(self, message: str, cause: Exception | None = None):
+        super().__init__(message)
+        self.cause = cause
+
+
+class RayActorError(Exception):
+    pass
+
+
+class ObjectLostError(Exception):
+    pass
+
+
+class GetTimeoutError(Exception):
+    pass
+
+
+class _ResultEntry:
+    """One object's owner-side state."""
+
+    __slots__ = ("event", "payload", "error", "in_plasma", "size", "spec")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.payload = None     # serialized [meta, bufs] when inline
+        self.error = None       # serialized exception payload
+        self.in_plasma = False
+        self.size = 0
+        self.spec = None        # producing TaskSpec (lineage / retries)
+
+    @property
+    def ready(self):
+        return self.event.is_set()
+
+
+class CoreWorker:
+    """One per process (driver or executor)."""
+
+    def __init__(self, *, head_addr: str, head_port: int,
+                 agent_addr: str, agent_port: int, store_name: str,
+                 node_id: bytes, job_id: bytes,
+                 worker_id: bytes | None = None, is_driver: bool = False):
+        self.worker_id = worker_id or WorkerID.from_random().binary()
+        self.job_id = job_id
+        self.node_id = node_id
+        self.is_driver = is_driver
+        self.io = EventLoopThread("ray_tpu-worker-io")
+        self.head = rpc.SyncRpcClient(head_addr, head_port, self.io)
+        self.agent = rpc.SyncRpcClient(agent_addr, agent_port, self.io)
+        self.store = ObjectStoreClient.attach(store_name)
+        self.memory: dict[bytes, _ResultEntry] = {}
+        self._mem_lock = threading.Lock()
+        self.task_counter = _Counter()
+        self.put_counter = _Counter()
+        self._func_cache: dict[bytes, Any] = {}
+        self._exported_funcs: set[bytes] = set()
+        # actor bookkeeping (owner side)
+        self._actor_info: dict[bytes, dict] = {}
+        self._actor_clients: dict[bytes, rpc.SyncRpcClient] = {}
+        self._actor_seq: dict[bytes, _Counter] = {}
+        self._actor_pending: dict[bytes, set[bytes]] = {}  # aid → task_ids
+        self._peer_clients: dict[tuple, rpc.SyncRpcClient] = {}
+        self._lock = threading.Lock()
+
+        # the worker's own RPC server (owner endpoint + executor endpoint)
+        self.server = RpcServer("127.0.0.1", 0)
+        self._install_routes()
+        self.port = self.io.run(self.server.start())
+        self.addr = "127.0.0.1"
+        self.head.call("register_worker", {
+            "worker_id": self.worker_id, "node_id": node_id,
+            "addr": self.addr, "port": self.port, "job_id": job_id,
+        })
+        self.head.on_push("actor_update", self._on_actor_update)
+        self.head.call("subscribe", {"channel": "actor_update"})
+
+    # ------------- helpers -------------
+
+    @property
+    def owner_address(self) -> dict:
+        return {"worker_id": self.worker_id, "addr": self.addr,
+                "port": self.port}
+
+    def _install_routes(self):
+        for name in dir(self):
+            if name.startswith("rpc_"):
+                self.server.handlers[name[4:]] = getattr(self, name)
+
+    def _entry(self, oid: bytes) -> _ResultEntry:
+        with self._mem_lock:
+            e = self.memory.get(oid)
+            if e is None:
+                e = self.memory[oid] = _ResultEntry()
+            return e
+
+    def shutdown(self):
+        try:
+            self.io.run(self.server.stop(), timeout=5)
+        except Exception:
+            pass
+        try:
+            self.head.close()
+            self.agent.close()
+            for c in self._actor_clients.values():
+                c.close()
+            for c in self._peer_clients.values():
+                c.close()
+        except Exception:
+            pass
+        self.io.stop()
+        self.store.close()
+
+    # ------------- owner-side RPC (results pushed to us) -------------
+
+    async def rpc_push_result(self, conn, p):
+        """An executor finished a task we own (or serves a borrowed get)."""
+        oid = p["object_id"]
+        e = self._entry(oid)
+        if p.get("error") is not None:
+            e.error = p["error"]
+        elif p.get("in_plasma"):
+            e.in_plasma = True
+            e.size = p.get("size", 0)
+        else:
+            e.payload = p["payload"]
+        e.event.set()
+        return True
+
+    async def rpc_task_failed(self, conn, p):
+        """Node agent reports a task's worker died → retry or error out."""
+        threading.Thread(
+            target=self._handle_task_failed, args=(p,), daemon=True
+        ).start()
+        return True
+
+    def _handle_task_failed(self, p):
+        tid = p["task_id"]
+        spec = None
+        with self._mem_lock:
+            for e in self.memory.values():
+                if e.spec is not None and e.spec["task_id"] == tid:
+                    spec = e.spec
+                    break
+        if spec is None:
+            return
+        if p.get("retriable", True) and spec.get("retries_left", 0) > 0:
+            spec["retries_left"] -= 1
+            logger.warning("retrying task %s (%s left): %s", tid.hex()[:8],
+                           spec["retries_left"], p.get("reason"))
+            try:
+                self.agent.call("submit_task", spec)
+                return
+            except (rpc.ConnectionLost, rpc.RpcError):
+                pass
+        err = serialization.pack_payload(
+            RayTaskError(f"task failed: {p.get('reason', 'worker died')}")
+        )
+        for i in range(spec.get("num_returns", 1)):
+            oid = ObjectID.for_task_return(
+                TaskID(spec["task_id"]), i
+            ).binary()
+            e = self._entry(oid)
+            e.error = err
+            e.event.set()
+
+    async def rpc_get_object(self, conn, p):
+        """A borrower asks us (the owner) for a small object's value."""
+        oid = p["object_id"]
+        e = self.memory.get(oid)
+        if e is None or not e.ready:
+            return None
+        if e.error is not None:
+            return {"error": e.error}
+        if e.in_plasma:
+            return {"in_plasma": True, "size": e.size}
+        return {"payload": e.payload}
+
+    def _on_actor_update(self, view: dict):
+        aid = view["actor_id"]
+        self._actor_info[aid] = view
+        if view["state"] == "DEAD":
+            old = self._actor_clients.pop(aid, None)
+            if old is not None:
+                old.close()
+            self._fail_pending_actor_tasks(
+                aid, view.get("death_reason") or "actor died"
+            )
+        elif view["state"] == "RESTARTING":
+            old = self._actor_clients.pop(aid, None)
+            if old is not None:
+                old.close()
+
+    def _fail_pending_actor_tasks(self, aid: bytes, reason: str):
+        pend = self._actor_pending.get(aid, set())
+        err = serialization.pack_payload(RayActorError(reason))
+        for tid in list(pend):
+            oid = ObjectID.for_task_return(TaskID(tid), 0).binary()
+            e = self._entry(oid)
+            if not e.ready:
+                e.error = err
+                e.event.set()
+        pend.clear()
+
+    # ------------- function export -------------
+
+    def export_function(self, func) -> bytes:
+        import hashlib
+
+        blob = serialization.pack_payload(func)
+        meta, bufs = blob
+        h = hashlib.blake2b(digest_size=16)
+        h.update(meta)
+        for b in bufs:
+            h.update(b)
+        func_id = h.digest()
+        if func_id not in self._exported_funcs:
+            self.head.call("kv_put", {
+                "ns": FUNC_NS, "key": func_id, "value": meta,
+            })
+            # store buffers alongside (rare for functions to have any)
+            if bufs:
+                for i, b in enumerate(bufs):
+                    self.head.call("kv_put", {
+                        "ns": FUNC_NS, "key": func_id + b"/%d" % i,
+                        "value": bytes(b),
+                    })
+                self.head.call("kv_put", {
+                    "ns": FUNC_NS, "key": func_id + b"/n",
+                    "value": str(len(bufs)).encode(),
+                })
+            self._exported_funcs.add(func_id)
+        return func_id
+
+    def load_function(self, func_id: bytes):
+        fn = self._func_cache.get(func_id)
+        if fn is not None:
+            return fn
+        meta = self.head.call("kv_get", {"ns": FUNC_NS, "key": func_id})
+        if meta is None:
+            raise RayTaskError(f"function {func_id.hex()} not found in KV")
+        nbuf = self.head.call("kv_get", {"ns": FUNC_NS, "key": func_id + b"/n"})
+        bufs = []
+        if nbuf is not None:
+            for i in range(int(nbuf)):
+                bufs.append(self.head.call(
+                    "kv_get", {"ns": FUNC_NS, "key": func_id + b"/%d" % i}
+                ))
+        fn = serialization.unpack_payload([meta, bufs])
+        self._func_cache[func_id] = fn
+        return fn
+
+    # ------------- put / get / wait -------------
+
+    def put(self, value) -> bytes:
+        """Store a value; returns object id (we are the owner)."""
+        oid = ObjectID.for_put(
+            WorkerID(self.worker_id), self.put_counter.next()
+        ).binary()
+        payload = serialization.pack_payload(value)
+        size = len(payload[0]) + sum(len(b) for b in payload[1])
+        e = self._entry(oid)
+        if size <= INLINE_MAX:
+            e.payload = payload
+        else:
+            self._put_plasma(oid, payload)
+            e.in_plasma = True
+            e.size = size
+        e.event.set()
+        return oid
+
+    def _put_plasma(self, oid: bytes, payload):
+        meta, bufs = payload
+        # layout: [4-byte meta len][meta][buffers...]; buffer table in object
+        # metadata so deserialize can slice zero-copy.
+        import struct
+
+        sizes = [len(meta)] + [len(b) for b in bufs]
+        table = struct.pack(f"<I{len(sizes)}Q", len(sizes), *sizes)
+        total = sum(sizes)
+        try:
+            wbuf = self.store.create_object(oid, total, len(table))
+        except StoreFullError:
+            self.store.evict(total)
+            wbuf = self.store.create_object(oid, total, len(table))
+        off = 0
+        for part in [meta] + list(bufs):
+            n = len(part)
+            wbuf.data[off:off + n] = part
+            off += n
+        wbuf.meta[:] = table
+        wbuf.seal()
+        self.agent.call("object_sealed", {
+            "object_id": oid, "owner": self.owner_address, "size": total,
+        })
+
+    def _read_plasma(self, oid: bytes):
+        buf = self.store.get(oid)
+        if buf is None:
+            return None
+        import struct
+
+        (n,) = struct.unpack_from("<I", buf.metadata, 0)
+        sizes = struct.unpack_from(f"<{n}Q", buf.metadata, 4)
+        parts = []
+        off = 0
+        for s in sizes:
+            parts.append(buf.data[off:off + s])
+            off += s
+        value = serialization.loads_oob(parts[0], parts[1:])
+        # Zero-copy: numpy arrays in `value` view the store segment directly.
+        # The ObjectBuffer's refcount pin must outlive every such array, so
+        # each array's weakref-finalizer holds a strong ref to `buf`; when
+        # the last array dies, buf is collected and the store ref released.
+        if parts[1:]:
+            _pin_buffers_to_arrays(value, buf)
+        return value
+
+    def get(self, object_ids: list[bytes], timeout: float | None = None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        return [self._get_one(oid, deadline) for oid in object_ids]
+
+    def _get_one(self, oid: bytes, deadline):
+        e = self._entry(oid)
+        while True:
+            if e.ready:
+                if e.error is not None:
+                    err = serialization.unpack_payload(e.error)
+                    if isinstance(err, Exception):
+                        raise err
+                    raise RayTaskError(str(err))
+                if e.in_plasma:
+                    return self._fetch_plasma(oid, deadline)
+                return serialization.unpack_payload(e.payload)
+            # Not resolved here: maybe it's a borrowed ref → ask around.
+            if self._try_resolve_remote(oid):
+                continue
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                raise GetTimeoutError(f"get timed out on {oid.hex()[:12]}")
+            e.event.wait(timeout=0.1 if remaining is None
+                         else min(0.1, remaining))
+
+    def _fetch_plasma(self, oid: bytes, deadline):
+        while True:
+            value = self._read_plasma(oid)
+            if value is not None:
+                return value
+            timeout = 60.0 if deadline is None else max(
+                0.1, deadline - time.monotonic())
+            ok = self.agent.call("fetch_object", {
+                "object_id": oid, "timeout": min(timeout, 60.0),
+            })
+            if not ok:
+                if deadline is not None and time.monotonic() > deadline:
+                    raise GetTimeoutError(oid.hex())
+                # owner may still be computing / object lost → keep trying;
+                # lineage reconstruction hook lands here later.
+                e = self.memory.get(oid)
+                if e is not None and e.spec is not None:
+                    raise ObjectLostError(
+                        f"object {oid.hex()[:12]} lost and reconstruction "
+                        "not yet enabled"
+                    )
+                time.sleep(0.1)
+
+    def _try_resolve_remote(self, oid: bytes) -> bool:
+        """Resolve a ref we don't own: directory first, then owner."""
+        info = None
+        try:
+            info = self.head.call("object_locations", {"object_id": oid})
+        except (rpc.ConnectionLost, rpc.RpcError):
+            return False
+        e = self._entry(oid)
+        if info and info.get("locations"):
+            if not e.ready:
+                e.in_plasma = True
+                e.event.set()
+            return True
+        owner = (info or {}).get("owner")
+        if owner and owner["worker_id"] != self.worker_id:
+            cli = self._peer(owner)
+            if cli is not None:
+                try:
+                    res = cli.call("get_object", {"object_id": oid})
+                except (rpc.ConnectionLost, rpc.RpcError):
+                    res = None
+                if res:
+                    if res.get("error") is not None:
+                        e.error = res["error"]
+                    elif res.get("in_plasma"):
+                        e.in_plasma = True
+                        e.size = res.get("size", 0)
+                    else:
+                        e.payload = res["payload"]
+                    e.event.set()
+                    return True
+        return False
+
+    def _peer(self, owner: dict) -> rpc.SyncRpcClient | None:
+        key = (owner["addr"], owner["port"])
+        cli = self._peer_clients.get(key)
+        if cli is not None:
+            return cli
+        try:
+            cli = rpc.SyncRpcClient(owner["addr"], owner["port"], self.io)
+        except rpc.ConnectionLost:
+            return None
+        self._peer_clients[key] = cli
+        return cli
+
+    def wait(self, object_ids: list[bytes], num_returns: int,
+             timeout: float | None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ready: list[bytes] = []
+        pending = list(object_ids)
+        while True:
+            still = []
+            for oid in pending:
+                e = self._entry(oid)
+                if not e.ready:
+                    self._try_resolve_remote(oid)
+                if e.ready:
+                    ready.append(oid)
+                else:
+                    still.append(oid)
+            pending = still
+            if len(ready) >= num_returns or not pending:
+                return ready, pending
+            if deadline is not None and time.monotonic() >= deadline:
+                return ready, pending
+            time.sleep(0.01)
+
+    def free(self, object_ids: list[bytes]):
+        plasma = []
+        with self._mem_lock:
+            for oid in object_ids:
+                e = self.memory.pop(oid, None)
+                if e is not None and e.in_plasma:
+                    plasma.append(oid)
+        if plasma:
+            try:
+                self.agent.call("free_objects", {"object_ids": plasma})
+                for oid in plasma:
+                    self.head.call("free_object", {"object_id": oid})
+            except (rpc.ConnectionLost, rpc.RpcError):
+                pass
+
+    # ------------- task submission -------------
+
+    def submit_task(self, func, args: tuple, kwargs: dict, *,
+                    num_returns: int = 1, resources: dict | None = None,
+                    retries: int = 3, pg_id: bytes | None = None,
+                    bundle_index: int = -1, bundle_nodes: list | None = None,
+                    scheduling_strategy=None, name: str = "") -> list[bytes]:
+        func_id = self.export_function(func)
+        # parent chain: drivers are roots; executor-submitted tasks chain
+        # through their own worker ids via the counter namespace
+        task_id = TaskID.for_task(
+            JobID(self.job_id), TaskID(b"\x00" * 8 + self.worker_id[:8]),
+            self.task_counter.next(),
+        ).binary()
+        args_spec, deps, inline_values = self._pack_args(args, kwargs)
+        spec = {
+            "task_id": task_id,
+            "job_id": self.job_id,
+            "func_id": func_id,
+            "name": name or getattr(func, "__name__", "task"),
+            "args": args_spec,
+            "inline_values": inline_values,
+            "num_returns": num_returns,
+            "resources": resources or {"CPU": 1.0},
+            "owner": self.owner_address,
+            "deps": deps,
+            "retries_left": retries,
+        }
+        if pg_id is not None:
+            spec["pg_id"] = pg_id
+            spec["bundle_index"] = bundle_index
+            spec["bundle_nodes"] = bundle_nodes or []
+        if scheduling_strategy is not None:
+            spec["scheduling_strategy"] = scheduling_strategy
+        return_ids = [
+            ObjectID.for_task_return(TaskID(task_id), i).binary()
+            for i in range(num_returns)
+        ]
+        for oid in return_ids:
+            self._entry(oid).spec = spec
+        self.agent.call("submit_task", spec)
+        return return_ids
+
+    def _pack_args(self, args, kwargs):
+        """Serialize args; extract refs as deps; inline owned small values.
+
+        Returns (args_payload, plasma_deps, inline_values{oid: payload}).
+        The agent stages plasma deps locally before dispatch; inline values
+        travel in the spec (reference: dependency resolver inlining,
+        transport/dependency_resolver.cc).
+        """
+        meta, bufs, refs = serialization.serialize((args, kwargs))
+        payload = [meta, [bytes(b.raw()) for b in bufs]]
+        deps: list[bytes] = []
+        inline_values: dict[bytes, list] = {}
+        for ref in refs:
+            oid = ref.binary()
+            e = self.memory.get(oid)
+            if e is not None and e.ready and not e.in_plasma:
+                if e.error is None:
+                    inline_values[oid] = e.payload
+                else:
+                    inline_values[oid] = ["__error__", e.error]
+            elif e is not None and not e.ready:
+                # pending result we own: executor will pull from us on demand
+                inline_values[oid] = ["__owner__", self.owner_address]
+                deps_marker = None  # noqa: F841 — documents intent
+            else:
+                deps.append(oid)
+        size = len(payload[0]) + sum(len(b) for b in payload[1])
+        if size > INLINE_MAX:
+            # big args → plasma object, executor reads locally after staging
+            args_oid = ObjectID.for_put(
+                WorkerID(self.worker_id), self.put_counter.next()
+            ).binary()
+            self._put_plasma(args_oid, payload)
+            e = self._entry(args_oid)
+            e.in_plasma = True
+            e.event.set()
+            deps.append(args_oid)
+            return {"args_oid": args_oid}, deps, inline_values
+        return {"payload": payload}, deps, inline_values
+
+    # ------------- actor submission (owner side) -------------
+
+    def register_actor(self, *, actor_id: bytes, cls, args, kwargs,
+                       name=None, namespace="default", detached=False,
+                       max_restarts=0, resources=None, pg_id=None,
+                       bundle_index=-1, max_concurrency=1,
+                       get_if_exists=False) -> dict:
+        spec = serialization.pack_payload((cls, args, kwargs))
+        reply = self.head.call("register_actor", {
+            "actor_id": actor_id, "job_id": self.job_id,
+            "name": name, "namespace": namespace, "detached": detached,
+            "max_restarts": max_restarts,
+            "resources": resources or {"CPU": 1.0},
+            "spec": spec, "owner_addr": self.owner_address,
+            "pg_id": pg_id, "bundle_index": bundle_index,
+            "max_concurrency": max_concurrency,
+            "get_if_exists": get_if_exists,
+        })
+        return reply
+
+    def _actor_client(self, actor_id: bytes,
+                      timeout: float = 60.0) -> rpc.SyncRpcClient:
+        cli = self._actor_clients.get(actor_id)
+        if cli is not None:
+            return cli
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            info = self._actor_info.get(actor_id)
+            if info is None or info["state"] not in ("ALIVE", "DEAD"):
+                info = self.head.call("wait_actor_alive", {
+                    "actor_id": actor_id,
+                    "timeout": max(0.1, deadline - time.monotonic()),
+                })
+                if info is not None:
+                    self._actor_info[actor_id] = info
+            if info is None:
+                raise RayActorError(f"actor {actor_id.hex()[:12]} unknown")
+            if info["state"] == "DEAD":
+                raise RayActorError(
+                    f"actor is dead: {info.get('death_reason')}"
+                )
+            if info["state"] == "ALIVE" and info.get("worker_addr"):
+                addr, port = info["worker_addr"]
+                try:
+                    cli = rpc.SyncRpcClient(addr, port, self.io)
+                except rpc.ConnectionLost:
+                    time.sleep(0.1)
+                    continue
+                self._actor_clients[actor_id] = cli
+                return cli
+            time.sleep(0.05)
+        raise RayActorError(
+            f"timed out waiting for actor {actor_id.hex()[:12]}"
+        )
+
+    def submit_actor_task(self, actor_id: bytes, method_name: str,
+                          args, kwargs, *, num_returns: int = 1) -> list[bytes]:
+        seq = self._actor_seq.setdefault(actor_id, _Counter()).next()
+        task_id = TaskID.for_actor_task(ActorID(actor_id), seq).binary()
+        args_spec, deps, inline_values = self._pack_args(args, kwargs)
+        call = {
+            "task_id": task_id,
+            "actor_id": actor_id,
+            "method": method_name,
+            "args": args_spec,
+            "inline_values": inline_values,
+            "deps": deps,
+            "num_returns": num_returns,
+            "owner": self.owner_address,
+            "seq": seq,
+        }
+        return_ids = [
+            ObjectID.for_task_return(TaskID(task_id), i).binary()
+            for i in range(num_returns)
+        ]
+        for oid in return_ids:
+            self._entry(oid)
+        self._actor_pending.setdefault(actor_id, set()).add(task_id)
+        self._send_actor_call(actor_id, call)
+        return return_ids
+
+    def _send_actor_call(self, actor_id: bytes, call: dict):
+        try:
+            cli = self._actor_client(actor_id)
+            cli.oneway("actor_call", call)
+        except (rpc.ConnectionLost, rpc.RpcError, RayActorError) as e:
+            err = serialization.pack_payload(
+                e if isinstance(e, RayActorError) else RayActorError(str(e))
+            )
+            for i in range(call["num_returns"]):
+                oid = ObjectID.for_task_return(
+                    TaskID(call["task_id"]), i
+                ).binary()
+                entry = self._entry(oid)
+                entry.error = err
+                entry.event.set()
+            self._actor_pending.get(actor_id, set()).discard(call["task_id"])
+
+    def actor_task_finished(self, actor_id: bytes, task_id: bytes):
+        self._actor_pending.get(actor_id, set()).discard(task_id)
+
+    def kill_actor(self, actor_id: bytes, no_restart: bool = True,
+                   blocking: bool = True):
+        msg = {"actor_id": actor_id, "no_restart": no_restart}
+        if blocking and threading.current_thread() is not self.io.thread:
+            self.head.call("kill_actor", msg)
+        else:
+            self.head.fire("kill_actor", msg)
+
+
+def _noop(buf):
+    pass
+
+
+def _pin_buffers_to_arrays(value, buf, depth: int = 0):
+    """Attach `buf` to the lifetime of every zero-copy ndarray in `value`."""
+    import weakref
+
+    import numpy as np
+
+    if depth > 4:
+        return
+    if isinstance(value, np.ndarray):
+        if value.base is not None:  # a view → backed by the store segment
+            weakref.finalize(value, _noop, buf)
+        return
+    if isinstance(value, dict):
+        for v in value.values():
+            _pin_buffers_to_arrays(v, buf, depth + 1)
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            _pin_buffers_to_arrays(v, buf, depth + 1)
+    else:
+        try:
+            weakref.finalize(value, _noop, buf)
+        except TypeError:
+            pass  # immutable scalar-like: data was copied by pickle anyway

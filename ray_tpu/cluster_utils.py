@@ -1,0 +1,95 @@
+"""In-process multi-node cluster for tests.
+
+Analog of reference `python/ray/cluster_utils.py:99 Cluster` — the backbone
+of the reference's multi-node test strategy (SURVEY.md §4): one control
+plane plus N node agents with fake resources, all in this process (agents
+on a shared background event loop; executors are real subprocesses), so
+scheduling/spillback/failure paths run without real hosts.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ray_tpu._private import api
+from ray_tpu._private.ids import JobID
+from ray_tpu._private.rpc import EventLoopThread
+from ray_tpu._private.worker import CoreWorker
+
+
+class Cluster:
+    def __init__(self, *, head_resources: dict | None = None,
+                 store_capacity: int = 256 * 1024 * 1024,
+                 heartbeat_timeout_s: float = 3.0):
+        from ray_tpu.core.control_plane import ControlPlane
+        from ray_tpu.core.node_agent import NodeAgent
+
+        self.io = EventLoopThread("ray_tpu-test-cluster")
+        self.session_id = os.urandom(4).hex()
+        self.store_capacity = store_capacity
+        self.cp = ControlPlane(heartbeat_timeout_s=heartbeat_timeout_s)
+        self.head_port = self.io.run(self.cp.start())
+        self.agents: list = []
+        self.head_agent = self.add_node(
+            resources=head_resources or {"CPU": 4, "memory": 4 * 2**30}
+        )
+        self._driver: CoreWorker | None = None
+
+    def add_node(self, *, resources: dict | None = None):
+        from ray_tpu.core.node_agent import NodeAgent
+
+        agent = NodeAgent(
+            "127.0.0.1", self.head_port,
+            resources=resources or {"CPU": 4, "memory": 4 * 2**30},
+            store_capacity=self.store_capacity,
+            session_id=f"{self.session_id}{len(self.agents)}",
+        )
+        self.io.run(agent.start())
+        self.agents.append(agent)
+        return agent
+
+    def remove_node(self, agent):
+        """Simulates node death (reference NodeKiller chaos analog)."""
+        self.agents.remove(agent)
+        self.io.run(agent.stop(), timeout=10)
+
+    def connect(self, namespace: str = "default") -> CoreWorker:
+        """Attach a driver to the head node and install it globally."""
+        agent = self.head_agent
+        worker = CoreWorker(
+            head_addr="127.0.0.1", head_port=self.head_port,
+            agent_addr="127.0.0.1", agent_port=agent.port,
+            store_name=agent.store_name, node_id=agent.node_id,
+            job_id=JobID.from_random().binary(), is_driver=True,
+        )
+        worker.namespace = namespace
+        worker.head.call("register_job", {
+            "job_id": worker.job_id,
+            "driver_addr": [worker.addr, worker.port],
+        })
+        api._set_global_worker(worker)
+        self._driver = worker
+        return worker
+
+    def shutdown(self):
+        if self._driver is not None:
+            try:
+                self._driver.head.call(
+                    "finish_job", {"job_id": self._driver.job_id}
+                )
+            except Exception:
+                pass
+            self._driver.shutdown()
+            api._set_global_worker(None)
+            self._driver = None
+        for agent in list(self.agents):
+            try:
+                self.io.run(agent.stop(), timeout=10)
+            except Exception:
+                pass
+        self.agents.clear()
+        try:
+            self.io.run(self.cp.stop(), timeout=10)
+        except Exception:
+            pass
+        self.io.stop()

@@ -1,0 +1,36 @@
+"""Collective communication: group API over XLA collectives + a CPU backend.
+
+Reference analog: `ray.util.collective` (SURVEY.md §2.8,
+python/ray/util/collective/collective.py — init_collective_group:120,
+allreduce:258, barrier:298, broadcast:373, allgather:423, reducescatter:472,
+send:531/recv:594). The reference's NCCL backend has **no TPU analog by
+design**: inside a mesh, the XLA compiler *is* the collective library —
+`mesh_allreduce` etc. lower to psum/all-gather over ICI via shard_map.
+Across processes/hosts (the gloo-path analog), the `cpu` backend runs
+ring/tree collectives over the framework's TCP RPC with rendezvous through
+the control-plane KV (mirroring gloo_util.py:271 RayInternalKvStore).
+"""
+
+from ray_tpu.collective.collective import (  # noqa: F401
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    create_collective_group,
+    destroy_collective_group,
+    get_rank,
+    get_collective_group_size,
+    init_collective_group,
+    recv,
+    reduce,
+    reducescatter,
+    send,
+)
+from ray_tpu.collective.mesh_ops import (  # noqa: F401
+    mesh_allgather,
+    mesh_allreduce,
+    mesh_all_to_all,
+    mesh_broadcast,
+    mesh_ppermute,
+    mesh_reducescatter,
+)

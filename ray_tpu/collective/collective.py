@@ -1,0 +1,301 @@
+"""Process-group collectives with control-plane-KV rendezvous.
+
+Reference analog: `python/ray/util/collective/collective.py` (GroupManager:40,
+init_collective_group:120, allreduce:258, …). Backend mapping:
+
+- reference NCCL backend → **not needed on TPU**: intra-mesh tensors use the
+  compiler-native ops in `mesh_ops.py` (psum over ICI).
+- reference Gloo backend (CPU, Ray-KV rendezvous, gloo_util.py:271) → the
+  `cpu` backend here: host-memory ring/tree collectives among worker
+  processes over the framework RPC, rendezvous via control-plane KV. This is
+  the DCN path — cross-host coordination where no shared mesh exists.
+
+Tensors are numpy arrays or host-convertible (jax arrays are converted on
+the way in and back on the way out, like the reference's gloo path).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+import numpy as np
+
+from ray_tpu._private import serialization
+
+KV_NS = "collective"
+
+
+class _Mailbox:
+    """Per-process inbox for collective messages, keyed (group, seq, src)."""
+
+    def __init__(self):
+        self.msgs: dict[tuple, Any] = {}
+        self.cond = threading.Condition()
+
+    def put(self, key: tuple, value):
+        with self.cond:
+            self.msgs[key] = value
+            self.cond.notify_all()
+
+    def take(self, key: tuple, timeout: float = 120.0):
+        deadline = time.monotonic() + timeout
+        with self.cond:
+            while key not in self.msgs:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(f"collective wait timed out on {key}")
+                self.cond.wait(timeout=min(remaining, 1.0))
+            return self.msgs.pop(key)
+
+
+class Group:
+    """One rank's view of a collective group (reference BaseGroup)."""
+
+    def __init__(self, name: str, world_size: int, rank: int, worker):
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        self.worker = worker
+        self.seq = 0  # lockstep counter: every rank runs collectives in the
+        # same order, so it advances identically group-wide
+        self.p2p_send: dict[int, int] = {}  # dst → count (independent pairs)
+        self.p2p_recv: dict[int, int] = {}  # src → count
+        self.peers: dict[int, dict] = {}  # rank → owner addr dict
+
+    def _next_seq(self) -> int:
+        self.seq += 1
+        return self.seq
+
+    def _send_to(self, dst_rank: int, seq: int, tag: str, array):
+        peer = self.peers[dst_rank]
+        cli = self.worker._peer(peer)
+        if cli is None:
+            raise ConnectionError(f"cannot reach rank {dst_rank}")
+        payload = serialization.pack_payload(np.asarray(array))
+        cli.call("coll_msg", {
+            "group": self.name, "seq": seq, "src": self.rank, "tag": tag,
+            "payload": payload,
+        })
+
+    def _recv_from(self, src_rank: int, seq: int, tag: str, timeout=120.0):
+        box = _mailbox()
+        msg = box.take((self.name, seq, src_rank, tag), timeout)
+        return serialization.unpack_payload(msg)
+
+
+_groups: dict[str, Group] = {}
+_box: _Mailbox | None = None
+_lock = threading.Lock()
+
+
+def _mailbox() -> _Mailbox:
+    global _box
+    with _lock:
+        if _box is None:
+            _box = _Mailbox()
+        return _box
+
+
+async def _rpc_coll_msg(conn, p):
+    _mailbox().put((p["group"], p["seq"], p["src"], p["tag"]), p["payload"])
+    return True
+
+
+def _install_route(worker):
+    if "coll_msg" not in worker.server.handlers:
+        worker.server.handlers["coll_msg"] = _rpc_coll_msg
+
+
+def init_collective_group(world_size: int, rank: int,
+                          backend: str = "cpu",
+                          group_name: str = "default",
+                          timeout: float = 120.0) -> Group:
+    """Rendezvous through the control-plane KV (reference
+    collective.py:120 + gloo_util.py RayInternalKvStore pattern)."""
+    from ray_tpu._private.api import _get_worker
+
+    import msgpack
+
+    w = _get_worker()
+    _install_route(w)
+    me = w.owner_address
+    w.head.call("kv_put", {
+        "ns": KV_NS,
+        "key": f"{group_name}/{rank}".encode(),
+        "value": msgpack.packb(me),
+    })
+    group = Group(group_name, world_size, rank, w)
+    deadline = time.monotonic() + timeout
+    while len(group.peers) < world_size:
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"collective rendezvous: {len(group.peers)}/{world_size} "
+                f"ranks after {timeout}s"
+            )
+        for r in range(world_size):
+            if r in group.peers:
+                continue
+            raw = w.head.call("kv_get", {
+                "ns": KV_NS, "key": f"{group_name}/{r}".encode(),
+            })
+            if raw is not None:
+                group.peers[r] = msgpack.unpackb(raw)
+        if len(group.peers) < world_size:
+            time.sleep(0.05)
+    _groups[group_name] = group
+    return group
+
+
+def create_collective_group(actors, world_size: int, ranks: list[int],
+                            backend: str = "cpu",
+                            group_name: str = "default"):
+    """Driver-side declaration (reference collective.py:151): tell each
+    actor to init its rank. Actors must expose the init hook — inherit
+    `CollectiveActorMixin` or define `__ray_tpu_init_collective__`."""
+    from ray_tpu._private.api import get as _get
+
+    refs = [
+        a.__ray_tpu_init_collective__.remote(world_size, r, backend,
+                                             group_name)
+        for a, r in zip(actors, ranks)
+    ]
+    return _get(refs)
+
+
+class CollectiveActorMixin:
+    """Inherit in actor classes to enable `create_collective_group`."""
+
+    def __ray_tpu_init_collective__(self, world_size, rank, backend,
+                                    group_name):
+        init_collective_group(world_size, rank, backend, group_name)
+        return rank
+
+
+def destroy_collective_group(group_name: str = "default"):
+    _groups.pop(group_name, None)
+
+
+def get_rank(group_name: str = "default") -> int:
+    g = _groups.get(group_name)
+    return -1 if g is None else g.rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    g = _groups.get(group_name)
+    return -1 if g is None else g.world_size
+
+
+def _group(name: str) -> Group:
+    g = _groups.get(name)
+    if g is None:
+        raise RuntimeError(
+            f"collective group '{name}' not initialized in this process"
+        )
+    return g
+
+
+_REDUCE = {
+    "sum": lambda arrs: np.sum(arrs, axis=0),
+    "prod": lambda arrs: np.prod(arrs, axis=0),
+    "max": lambda arrs: np.max(arrs, axis=0),
+    "min": lambda arrs: np.min(arrs, axis=0),
+    "mean": lambda arrs: np.mean(arrs, axis=0),
+}
+
+
+def _to_numpy(tensor):
+    if isinstance(tensor, np.ndarray):
+        return tensor
+    return np.asarray(tensor)  # jax arrays device→host here
+
+
+def allreduce(tensor, group_name: str = "default", op: str = "sum"):
+    """Tree allreduce via rank 0 (reference collective.py:258)."""
+    g = _group(group_name)
+    seq = g._next_seq()
+    arr = _to_numpy(tensor)
+    if g.world_size == 1:
+        return arr
+    if g.rank == 0:
+        parts = [arr] + [
+            g._recv_from(r, seq, "ar-up") for r in range(1, g.world_size)
+        ]
+        out = _REDUCE[op](np.stack([np.asarray(p) for p in parts]))
+        for r in range(1, g.world_size):
+            g._send_to(r, seq, "ar-down", out)
+        return out
+    g._send_to(0, seq, "ar-up", arr)
+    return np.asarray(g._recv_from(0, seq, "ar-down"))
+
+
+def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
+           op: str = "sum"):
+    g = _group(group_name)
+    seq = g._next_seq()
+    arr = _to_numpy(tensor)
+    if g.rank == dst_rank:
+        parts = [arr] + [
+            g._recv_from(r, seq, "red")
+            for r in range(g.world_size) if r != dst_rank
+        ]
+        return _REDUCE[op](np.stack([np.asarray(p) for p in parts]))
+    g._send_to(dst_rank, seq, "red", arr)
+    return arr
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    g = _group(group_name)
+    seq = g._next_seq()
+    if g.rank == src_rank:
+        arr = _to_numpy(tensor)
+        for r in range(g.world_size):
+            if r != src_rank:
+                g._send_to(r, seq, "bc", arr)
+        return arr
+    return np.asarray(g._recv_from(src_rank, seq, "bc"))
+
+
+def allgather(tensor, group_name: str = "default") -> list:
+    g = _group(group_name)
+    seq = g._next_seq()
+    arr = _to_numpy(tensor)
+    if g.world_size == 1:
+        return [arr]
+    if g.rank == 0:
+        parts = [arr] + [
+            g._recv_from(r, seq, "ag-up") for r in range(1, g.world_size)
+        ]
+        parts = [np.asarray(p) for p in parts]
+        stacked = np.stack(parts)
+        for r in range(1, g.world_size):
+            g._send_to(r, seq, "ag-down", stacked)
+        return parts
+    g._send_to(0, seq, "ag-up", arr)
+    return list(np.asarray(g._recv_from(0, seq, "ag-down")))
+
+
+def reducescatter(tensor, group_name: str = "default", op: str = "sum"):
+    g = _group(group_name)
+    out = allreduce(tensor, group_name, op)
+    shards = np.array_split(out, g.world_size, axis=0)
+    return shards[g.rank]
+
+
+def barrier(group_name: str = "default"):
+    allreduce(np.zeros(1), group_name)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default"):
+    """P2P send (reference collective.py:531); ordered per (src,dst) pair."""
+    g = _group(group_name)
+    g.p2p_send[dst_rank] = seq = g.p2p_send.get(dst_rank, 0) + 1
+    g._send_to(dst_rank, seq, "p2p", _to_numpy(tensor))
+
+
+def recv(src_rank: int, group_name: str = "default", timeout: float = 120.0):
+    """P2P recv (reference collective.py:594)."""
+    g = _group(group_name)
+    g.p2p_recv[src_rank] = seq = g.p2p_recv.get(src_rank, 0) + 1
+    return np.asarray(g._recv_from(src_rank, seq, "p2p", timeout))

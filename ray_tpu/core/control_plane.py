@@ -1,0 +1,766 @@
+"""Control plane: the cluster's source of truth (GCS-server equivalent).
+
+One asyncio process/thread on the head node composing the managers the
+reference GCS composes in `gcs_server.cc:124 DoStart` (SURVEY.md §2.2):
+
+- KvManager           — namespaced internal KV (gcs_kv_manager.h:31); also the
+                        collective-rendezvous store and function-export table.
+- NodeManager         — node registry, heartbeat-based failure detection
+                        (gcs_node_manager.h:42 + gcs_health_check_manager.h:39).
+- ResourceManager     — cluster resource view from node load reports, pushed
+                        back to all node agents (gcs_resource_manager.h:55 +
+                        ray_syncer.h:86 rebroadcast role).
+- ActorManager        — actor registry + scheduling + restarts up to
+                        max_restarts, named actors (gcs_actor_manager.h:281).
+- JobManager          — job table, driver lifetime (gcs_job_manager.h:39).
+- PlacementGroupManager — 2-phase PREPARE/COMMIT bundle reservation
+                        (gcs_placement_group_scheduler.h:265).
+- ObjectDirectory     — object locations + owner addresses. The reference
+                        resolves locations via owners (ownership_based_
+                        object_directory.h); centralizing the directory here
+                        removes a hop and is the right call at TPU-pod scale
+                        (hundreds of hosts, not 2k heterogeneous nodes).
+- Publisher           — push-based pubsub over server connections
+                        (pubsub/publisher.h:307; push replaces long-poll).
+
+TPU-first resources: nodes report {"CPU": n, "TPU": chips, "tpu-slice:<topo>": 1,
+"memory": bytes, custom...}; placement bundles over TPU map to ICI sub-meshes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any
+
+from ray_tpu._private import rpc
+from ray_tpu._private.rpc import RpcServer, ServerConn
+
+logger = logging.getLogger(__name__)
+
+# Actor lifecycle states (reference: gcs.proto ActorTableData.ActorState).
+PENDING, ALIVE, RESTARTING, DEAD = "PENDING", "ALIVE", "RESTARTING", "DEAD"
+
+
+class Publisher:
+    """Channel → subscribed connections; push on publish."""
+
+    def __init__(self):
+        self.subs: dict[str, set[ServerConn]] = {}
+
+    def subscribe(self, channel: str, conn: ServerConn):
+        self.subs.setdefault(channel, set()).add(conn)
+
+    def unsubscribe_conn(self, conn: ServerConn):
+        for subs in self.subs.values():
+            subs.discard(conn)
+
+    def publish(self, channel: str, payload: Any):
+        for conn in list(self.subs.get(channel, ())):
+            conn.push(channel, payload)
+
+
+class KvManager:
+    """Namespaced KV (reference gcs_kv_manager.h:31)."""
+
+    def __init__(self):
+        self.data: dict[tuple[str, bytes], bytes] = {}
+
+    def put(self, ns: str, key: bytes, value: bytes, overwrite=True) -> bool:
+        k = (ns, key)
+        if not overwrite and k in self.data:
+            return False
+        self.data[k] = value
+        return True
+
+    def get(self, ns: str, key: bytes):
+        return self.data.get((ns, key))
+
+    def delete(self, ns: str, key: bytes) -> bool:
+        return self.data.pop((ns, key), None) is not None
+
+    def keys(self, ns: str, prefix: bytes) -> list[bytes]:
+        return [
+            k for (n, k) in self.data if n == ns and k.startswith(prefix)
+        ]
+
+
+class NodeInfo:
+    def __init__(self, node_id: bytes, addr: str, port: int, resources: dict,
+                 labels: dict | None = None):
+        self.node_id = node_id
+        self.addr = addr
+        self.port = port
+        self.resources_total = dict(resources)
+        self.resources_available = dict(resources)
+        self.labels = labels or {}
+        self.alive = True
+        self.last_heartbeat = time.monotonic()
+
+    def view(self) -> dict:
+        return {
+            "node_id": self.node_id,
+            "addr": self.addr,
+            "port": self.port,
+            "resources_total": self.resources_total,
+            "resources_available": self.resources_available,
+            "labels": self.labels,
+            "alive": self.alive,
+        }
+
+
+class ControlPlane:
+    """Composition root — all RPC services of the head node."""
+
+    HEARTBEAT_TIMEOUT_S = 10.0
+
+    def __init__(self, host="127.0.0.1", port=0,
+                 heartbeat_timeout_s: float | None = None):
+        self.server = RpcServer(host, port)
+        self.kv = KvManager()
+        self.pub = Publisher()
+        self.nodes: dict[bytes, NodeInfo] = {}
+        self.node_conns: dict[bytes, ServerConn] = {}
+        self.actors: dict[bytes, dict] = {}
+        self.named_actors: dict[tuple[str, str], bytes] = {}  # (ns,name)→id
+        self.jobs: dict[bytes, dict] = {}
+        self.pgs: dict[bytes, dict] = {}
+        self.workers: dict[bytes, dict] = {}
+        # object directory: oid → {"locations": set[node_id], "owner": addr,
+        #                          "size": int, "spilled": url|None}
+        self.objects: dict[bytes, dict] = {}
+        self.object_waiters: dict[bytes, list[asyncio.Event]] = {}
+        self._agent_clients: dict[bytes, rpc.AsyncRpcClient] = {}
+        if heartbeat_timeout_s is not None:
+            self.HEARTBEAT_TIMEOUT_S = heartbeat_timeout_s
+        self._install_routes()
+        self._bg: list[asyncio.Task] = []
+
+    # ---------------- lifecycle ----------------
+
+    async def start(self) -> int:
+        port = await self.server.start()
+        self.server.on_disconnect = self._on_disconnect
+        self._bg.append(asyncio.ensure_future(self._health_loop()))
+        return port
+
+    async def stop(self):
+        for t in self._bg:
+            t.cancel()
+        for c in self._agent_clients.values():
+            await c.close()
+        await self.server.stop()
+
+    async def _agent(self, node_id: bytes) -> rpc.AsyncRpcClient | None:
+        """Client connection to a node agent (for actor/PG placement RPCs)."""
+        cli = self._agent_clients.get(node_id)
+        if cli is not None and not cli.closed:
+            return cli
+        node = self.nodes.get(node_id)
+        if node is None or not node.alive:
+            return None
+        cli = rpc.AsyncRpcClient(node.addr, node.port)
+        try:
+            await cli.connect(retries=3)
+        except rpc.ConnectionLost:
+            return None
+        self._agent_clients[node_id] = cli
+        return cli
+
+    # ---------------- routes ----------------
+
+    def _install_routes(self):
+        h = self.server.handlers
+        for name in dir(self):
+            if name.startswith("rpc_"):
+                h[name[4:]] = getattr(self, name)
+
+    # -- kv --
+    async def rpc_kv_put(self, conn, p):
+        return self.kv.put(p["ns"], p["key"], p["value"],
+                           p.get("overwrite", True))
+
+    async def rpc_kv_get(self, conn, p):
+        return self.kv.get(p["ns"], p["key"])
+
+    async def rpc_kv_del(self, conn, p):
+        return self.kv.delete(p["ns"], p["key"])
+
+    async def rpc_kv_keys(self, conn, p):
+        return self.kv.keys(p["ns"], p.get("prefix", b""))
+
+    # -- pubsub --
+    async def rpc_subscribe(self, conn, p):
+        self.pub.subscribe(p["channel"], conn)
+        return True
+
+    # -- log routing: agents forward worker stdout/err; drivers subscribe
+    #    to the "logs" channel (reference _private/log_monitor.py role) --
+    async def rpc_worker_log(self, conn, p):
+        self.pub.publish("logs", p)
+        return True
+
+    # -- nodes --
+    async def rpc_register_node(self, conn, p):
+        info = NodeInfo(p["node_id"], p["addr"], p["port"], p["resources"],
+                        p.get("labels"))
+        self.nodes[p["node_id"]] = info
+        self.node_conns[p["node_id"]] = conn
+        conn.state["node_id"] = p["node_id"]
+        logger.info("node %s registered (%s)", p["node_id"].hex()[:8],
+                    p["resources"])
+        self.pub.publish("node_added", info.view())
+        return {"nodes": [n.view() for n in self.nodes.values()]}
+
+    async def rpc_heartbeat(self, conn, p):
+        node = self.nodes.get(p["node_id"])
+        if node is None:
+            return {"unknown": True}  # tell agent to re-register
+        node.last_heartbeat = time.monotonic()
+        if "resources_available" in p:
+            node.resources_available = p["resources_available"]
+        return {"ok": True}
+
+    async def rpc_get_cluster_view(self, conn, p):
+        return {"nodes": [n.view() for n in self.nodes.values()]}
+
+    async def rpc_drain_node(self, conn, p):
+        await self._mark_node_dead(p["node_id"], "drained")
+        return True
+
+    # -- workers (driver + executors register their direct-RPC address) --
+    async def rpc_register_worker(self, conn, p):
+        self.workers[p["worker_id"]] = {
+            "worker_id": p["worker_id"],
+            "node_id": p.get("node_id"),
+            "addr": p["addr"],
+            "port": p["port"],
+            "job_id": p.get("job_id"),
+        }
+        return True
+
+    async def rpc_get_worker(self, conn, p):
+        return self.workers.get(p["worker_id"])
+
+    # -- jobs --
+    async def rpc_register_job(self, conn, p):
+        self.jobs[p["job_id"]] = {
+            "job_id": p["job_id"],
+            "driver_addr": p.get("driver_addr"),
+            "start_time": time.time(),
+            "alive": True,
+        }
+        conn.state["job_id"] = p["job_id"]
+        conn.state["is_driver"] = True
+        return True
+
+    async def rpc_finish_job(self, conn, p):
+        await self._finish_job(p["job_id"])
+        return True
+
+    async def _finish_job(self, job_id: bytes):
+        job = self.jobs.get(job_id)
+        if job is None or not job["alive"]:
+            return
+        job["alive"] = False
+        job["end_time"] = time.time()
+        # Kill the job's non-detached actors (reference: GcsActorManager
+        # OnJobFinished).
+        for aid, a in list(self.actors.items()):
+            if a["job_id"] == job_id and not a.get("detached") \
+                    and a["state"] != DEAD:
+                await self._kill_actor(aid, no_restart=True,
+                                       reason="job finished")
+        self.pub.publish("job_finished", {"job_id": job_id})
+
+    async def rpc_list_jobs(self, conn, p):
+        return list(self.jobs.values())
+
+    # -- actors --
+    async def rpc_register_actor(self, conn, p):
+        """Register + schedule an actor. Returns when placement is decided
+        (worker spawn happens async on the node agent)."""
+        aid = p["actor_id"]
+        name = p.get("name")
+        ns = p.get("namespace", "default")
+        if name:
+            key = (ns, name)
+            if key in self.named_actors:
+                existing = self.actors.get(self.named_actors[key])
+                if existing is not None and existing["state"] != DEAD:
+                    if p.get("get_if_exists"):
+                        return {"actor_id": self.named_actors[key],
+                                "existing": True}
+                    raise rpc.RpcError(f"actor name '{name}' already taken")
+            self.named_actors[key] = aid
+        actor = {
+            "actor_id": aid,
+            "job_id": p["job_id"],
+            "name": name,
+            "namespace": ns,
+            "state": PENDING,
+            "detached": p.get("detached", False),
+            "max_restarts": p.get("max_restarts", 0),
+            "num_restarts": 0,
+            "resources": p.get("resources", {"CPU": 1}),
+            "spec": p["spec"],  # serialized creation payload for the worker
+            "owner_addr": p.get("owner_addr"),
+            "node_id": None,
+            "worker_addr": None,
+            "pg_id": p.get("pg_id"),
+            "bundle_index": p.get("bundle_index", -1),
+            "max_concurrency": p.get("max_concurrency", 1),
+            "death_reason": None,
+        }
+        self.actors[aid] = actor
+        await self._schedule_actor(actor)
+        return {"actor_id": aid, "existing": False}
+
+    async def _schedule_actor(self, actor: dict):
+        """Pick a node with free resources and ask its agent to start the
+        actor worker (reference gcs_actor_scheduler.h:349 ScheduleByGcs)."""
+        need = actor["resources"]
+        pg = self.pgs.get(actor["pg_id"]) if actor.get("pg_id") else None
+        candidates = []
+        for node in self.nodes.values():
+            if not node.alive:
+                continue
+            if pg is not None:
+                # actor must land on its bundle's node
+                bidx = actor["bundle_index"]
+                placed = pg["bundle_nodes"]
+                if bidx >= 0:
+                    if placed[bidx] != node.node_id:
+                        continue
+                elif node.node_id not in placed:
+                    continue
+            if all(node.resources_available.get(r, 0) >= v
+                   for r, v in need.items()):
+                candidates.append(node)
+        if not candidates:
+            # stays PENDING; retried when resources free up / nodes join
+            return
+        # least-loaded first (most available CPU) — reference hybrid policy's
+        # utilization score, simplified
+        node = max(candidates,
+                   key=lambda n: n.resources_available.get("CPU", 0))
+        agent = await self._agent(node.node_id)
+        if agent is None:
+            return
+        for r, v in need.items():
+            node.resources_available[r] = node.resources_available.get(r, 0) - v
+        actor["node_id"] = node.node_id
+        try:
+            await agent.call("start_actor", {
+                "actor_id": actor["actor_id"],
+                "job_id": actor["job_id"],
+                "spec": actor["spec"],
+                "resources": need,
+                "max_concurrency": actor["max_concurrency"],
+            })
+        except rpc.RpcError as e:
+            logger.warning("start_actor failed on %s: %s",
+                           node.node_id.hex()[:8], e)
+            for r, v in need.items():
+                node.resources_available[r] += v
+            actor["node_id"] = None
+
+    async def rpc_actor_started(self, conn, p):
+        """Node agent reports the actor worker is up and serving."""
+        actor = self.actors.get(p["actor_id"])
+        if actor is None:
+            return False
+        actor["state"] = ALIVE
+        actor["worker_addr"] = (p["addr"], p["port"])
+        actor["worker_id"] = p.get("worker_id")
+        self.pub.publish("actor_update", self._actor_view(actor))
+        return True
+
+    async def rpc_actor_failed(self, conn, p):
+        await self._on_actor_failed(p["actor_id"], p.get("reason", "died"))
+        return True
+
+    async def _on_actor_failed(self, aid: bytes, reason: str):
+        actor = self.actors.get(aid)
+        if actor is None or actor["state"] == DEAD:
+            return
+        self._release_actor_resources(actor)
+        if actor["num_restarts"] < actor["max_restarts"]:
+            actor["num_restarts"] += 1
+            actor["state"] = RESTARTING
+            actor["worker_addr"] = None
+            self.pub.publish("actor_update", self._actor_view(actor))
+            await self._schedule_actor(actor)
+        else:
+            actor["state"] = DEAD
+            actor["death_reason"] = reason
+            actor["worker_addr"] = None
+            self.pub.publish("actor_update", self._actor_view(actor))
+
+    def _release_actor_resources(self, actor):
+        node = self.nodes.get(actor["node_id"]) if actor["node_id"] else None
+        if node is not None and node.alive:
+            for r, v in actor["resources"].items():
+                node.resources_available[r] = (
+                    node.resources_available.get(r, 0) + v
+                )
+        actor["node_id"] = None
+
+    def _actor_view(self, actor: dict) -> dict:
+        return {k: actor[k] for k in (
+            "actor_id", "state", "name", "namespace", "worker_addr",
+            "node_id", "num_restarts", "death_reason", "job_id",
+        )}
+
+    async def rpc_get_actor(self, conn, p):
+        if "actor_id" in p:
+            a = self.actors.get(p["actor_id"])
+        else:
+            aid = self.named_actors.get(
+                (p.get("namespace", "default"), p["name"])
+            )
+            a = self.actors.get(aid) if aid else None
+        return self._actor_view(a) if a else None
+
+    async def rpc_wait_actor_alive(self, conn, p):
+        """Block until actor is ALIVE or DEAD (bounded by timeout)."""
+        deadline = time.monotonic() + p.get("timeout", 60.0)
+        while time.monotonic() < deadline:
+            a = self.actors.get(p["actor_id"])
+            if a is None:
+                return None
+            if a["state"] in (ALIVE, DEAD):
+                return self._actor_view(a)
+            # actors stuck PENDING get re-scheduled as resources change
+            if a["state"] in (PENDING, RESTARTING) and a["node_id"] is None:
+                await self._schedule_actor(a)
+            await asyncio.sleep(0.05)
+        a = self.actors.get(p["actor_id"])
+        return self._actor_view(a) if a else None
+
+    async def rpc_list_actors(self, conn, p):
+        return [self._actor_view(a) for a in self.actors.values()]
+
+    async def rpc_kill_actor(self, conn, p):
+        await self._kill_actor(p["actor_id"], p.get("no_restart", True),
+                               p.get("reason", "ray_tpu.kill"))
+        return True
+
+    async def _kill_actor(self, aid: bytes, no_restart: bool, reason: str):
+        actor = self.actors.get(aid)
+        if actor is None:
+            return
+        node_id = actor["node_id"]
+        if no_restart:
+            actor["max_restarts"] = actor["num_restarts"]  # no more restarts
+        if node_id:
+            agent = await self._agent(node_id)
+            if agent is not None:
+                try:
+                    await agent.call("kill_actor_worker",
+                                     {"actor_id": aid, "reason": reason})
+                    return  # agent reports actor_failed → restart logic
+                except rpc.RpcError:
+                    pass
+        await self._on_actor_failed(aid, reason)
+
+    # -- placement groups --
+    async def rpc_create_pg(self, conn, p):
+        """2-phase bundle reservation (reference
+        gcs_placement_group_scheduler.h:265, SURVEY §8)."""
+        pgid = p["pg_id"]
+        bundles: list[dict] = p["bundles"]
+        strategy = p.get("strategy", "PACK")
+        plan = self._plan_bundles(bundles, strategy)
+        if plan is None:
+            self.pgs[pgid] = {"pg_id": pgid, "state": "PENDING",
+                              "bundles": bundles, "strategy": strategy,
+                              "bundle_nodes": [], "job_id": p.get("job_id")}
+            return {"state": "PENDING"}
+        # PREPARE on all target agents
+        prepared = []
+        ok = True
+        for bidx, node_id in enumerate(plan):
+            agent = await self._agent(node_id)
+            if agent is None:
+                ok = False
+                break
+            try:
+                res = await agent.call("prepare_bundle", {
+                    "pg_id": pgid, "bundle_index": bidx,
+                    "resources": bundles[bidx],
+                })
+                if not res:
+                    ok = False
+                    break
+                prepared.append((bidx, node_id, agent))
+            except rpc.RpcError:
+                ok = False
+                break
+        if not ok:
+            for bidx, node_id, agent in prepared:
+                try:
+                    await agent.call("cancel_bundle",
+                                     {"pg_id": pgid, "bundle_index": bidx})
+                except rpc.RpcError:
+                    pass
+            self.pgs[pgid] = {"pg_id": pgid, "state": "PENDING",
+                              "bundles": bundles, "strategy": strategy,
+                              "bundle_nodes": [], "job_id": p.get("job_id")}
+            return {"state": "PENDING"}
+        # COMMIT everywhere
+        for bidx, node_id, agent in prepared:
+            await agent.call("commit_bundle",
+                             {"pg_id": pgid, "bundle_index": bidx})
+            node = self.nodes[node_id]
+            for r, v in bundles[bidx].items():
+                node.resources_available[r] = (
+                    node.resources_available.get(r, 0) - v
+                )
+        self.pgs[pgid] = {
+            "pg_id": pgid, "state": "CREATED", "bundles": bundles,
+            "strategy": strategy, "bundle_nodes": plan,
+            "job_id": p.get("job_id"),
+        }
+        self.pub.publish("pg_update", {"pg_id": pgid, "state": "CREATED"})
+        return {"state": "CREATED", "bundle_nodes": plan}
+
+    def _plan_bundles(self, bundles, strategy) -> list[bytes] | None:
+        """Choose a node per bundle (reference bundle_scheduling_policy.cc
+        PACK/SPREAD/STRICT_*)."""
+        alive = [n for n in self.nodes.values() if n.alive]
+        if not alive:
+            return None
+        avail = {n.node_id: dict(n.resources_available) for n in alive}
+
+        def fits(nid, need):
+            return all(avail[nid].get(r, 0) >= v for r, v in need.items())
+
+        def take(nid, need):
+            for r, v in need.items():
+                avail[nid][r] -= v
+
+        plan: list[bytes] = []
+        if strategy in ("PACK", "STRICT_PACK"):
+            # try to fit all bundles on one node first
+            for n in alive:
+                trial = dict(avail[n.node_id])
+                ok = True
+                for b in bundles:
+                    if all(trial.get(r, 0) >= v for r, v in b.items()):
+                        for r, v in b.items():
+                            trial[r] -= v
+                    else:
+                        ok = False
+                        break
+                if ok:
+                    return [n.node_id] * len(bundles)
+            if strategy == "STRICT_PACK":
+                return None
+            # PACK soft-fallback: greedy fill
+            for b in bundles:
+                placed = None
+                for n in alive:
+                    if fits(n.node_id, b):
+                        take(n.node_id, b)
+                        placed = n.node_id
+                        break
+                if placed is None:
+                    return None
+                plan.append(placed)
+            return plan
+        # SPREAD / STRICT_SPREAD: round-robin distinct nodes
+        used_nodes: set[bytes] = set()
+        order = sorted(alive, key=lambda n: -n.resources_available.get("CPU", 0))
+        for b in bundles:
+            placed = None
+            for n in order:
+                if strategy == "STRICT_SPREAD" and n.node_id in used_nodes:
+                    continue
+                if fits(n.node_id, b):
+                    take(n.node_id, b)
+                    placed = n.node_id
+                    used_nodes.add(n.node_id)
+                    break
+            if placed is None:
+                return None
+            plan.append(placed)
+        return plan
+
+    async def rpc_remove_pg(self, conn, p):
+        pg = self.pgs.pop(p["pg_id"], None)
+        if pg is None:
+            return False
+        for bidx, node_id in enumerate(pg.get("bundle_nodes", [])):
+            agent = await self._agent(node_id)
+            if agent is not None:
+                try:
+                    await agent.call("return_bundle", {
+                        "pg_id": pg["pg_id"], "bundle_index": bidx,
+                    })
+                except rpc.RpcError:
+                    pass
+            node = self.nodes.get(node_id)
+            if node is not None and node.alive:
+                for r, v in pg["bundles"][bidx].items():
+                    node.resources_available[r] = (
+                        node.resources_available.get(r, 0) + v
+                    )
+        return True
+
+    async def rpc_get_pg(self, conn, p):
+        pg = self.pgs.get(p["pg_id"])
+        if pg is None:
+            return None
+        return {k: pg[k] for k in
+                ("pg_id", "state", "bundles", "strategy", "bundle_nodes")}
+
+    async def rpc_wait_pg_ready(self, conn, p):
+        deadline = time.monotonic() + p.get("timeout", 60.0)
+        while time.monotonic() < deadline:
+            pg = self.pgs.get(p["pg_id"])
+            if pg is None:
+                return None
+            if pg["state"] == "CREATED":
+                return {"state": "CREATED",
+                        "bundle_nodes": pg["bundle_nodes"]}
+            # retry placement as cluster changes
+            plan = self._plan_bundles(pg["bundles"], pg["strategy"])
+            if plan is not None:
+                res = await self.rpc_create_pg(None, {
+                    "pg_id": pg["pg_id"], "bundles": pg["bundles"],
+                    "strategy": pg["strategy"], "job_id": pg.get("job_id"),
+                })
+                if res["state"] == "CREATED":
+                    return res
+            await asyncio.sleep(0.1)
+        return {"state": "PENDING"}
+
+    async def rpc_list_pgs(self, conn, p):
+        return [{k: pg[k] for k in
+                 ("pg_id", "state", "bundles", "strategy", "bundle_nodes")}
+                for pg in self.pgs.values()]
+
+    # -- object directory --
+    async def rpc_object_add_location(self, conn, p):
+        oid = p["object_id"]
+        entry = self.objects.setdefault(
+            oid, {"locations": set(), "owner": None, "size": 0,
+                  "spilled": None}
+        )
+        entry["locations"].add(p["node_id"])
+        if p.get("owner"):
+            entry["owner"] = p["owner"]
+        if p.get("size"):
+            entry["size"] = p["size"]
+        for ev in self.object_waiters.pop(oid, []):
+            ev.set()
+        return True
+
+    async def rpc_object_remove_location(self, conn, p):
+        entry = self.objects.get(p["object_id"])
+        if entry:
+            entry["locations"].discard(p["node_id"])
+        return True
+
+    async def rpc_object_locations(self, conn, p):
+        entry = self.objects.get(p["object_id"])
+        if entry is None:
+            return None
+        return {"locations": list(entry["locations"]),
+                "owner": entry["owner"], "size": entry["size"],
+                "spilled": entry["spilled"]}
+
+    async def rpc_object_wait_location(self, conn, p):
+        """Long-poll until the object has at least one location."""
+        oid = p["object_id"]
+        deadline = time.monotonic() + p.get("timeout", 60.0)
+        while time.monotonic() < deadline:
+            entry = self.objects.get(oid)
+            if entry and (entry["locations"] or entry["spilled"]):
+                return {"locations": list(entry["locations"]),
+                        "owner": entry["owner"], "size": entry["size"],
+                        "spilled": entry["spilled"]}
+            ev = asyncio.Event()
+            self.object_waiters.setdefault(oid, []).append(ev)
+            try:
+                await asyncio.wait_for(
+                    ev.wait(), timeout=max(0.0, deadline - time.monotonic())
+                )
+            except asyncio.TimeoutError:
+                return None
+        return None
+
+    async def rpc_object_spilled(self, conn, p):
+        entry = self.objects.setdefault(
+            p["object_id"],
+            {"locations": set(), "owner": None, "size": 0, "spilled": None},
+        )
+        entry["spilled"] = p["url"]
+        for ev in self.object_waiters.pop(p["object_id"], []):
+            ev.set()
+        return True
+
+    async def rpc_free_object(self, conn, p):
+        self.objects.pop(p["object_id"], None)
+        return True
+
+    # ---------------- failure detection ----------------
+
+    async def _health_loop(self):
+        while True:
+            await asyncio.sleep(self.HEARTBEAT_TIMEOUT_S / 4)
+            now = time.monotonic()
+            for node in list(self.nodes.values()):
+                if node.alive and (
+                    now - node.last_heartbeat > self.HEARTBEAT_TIMEOUT_S
+                ):
+                    await self._mark_node_dead(node.node_id,
+                                               "heartbeat timeout")
+            # keep retrying pending actors (resources may have freed)
+            for a in self.actors.values():
+                if a["state"] in (PENDING, RESTARTING) and a["node_id"] is None:
+                    await self._schedule_actor(a)
+
+    async def _mark_node_dead(self, node_id: bytes, reason: str):
+        node = self.nodes.get(node_id)
+        if node is None or not node.alive:
+            return
+        node.alive = False
+        logger.warning("node %s dead: %s", node_id.hex()[:8], reason)
+        cli = self._agent_clients.pop(node_id, None)
+        if cli is not None:
+            await cli.close()
+        # objects on that node are gone
+        for oid, entry in self.objects.items():
+            entry["locations"].discard(node_id)
+        # actors on that node fail (maybe restart elsewhere)
+        for aid, a in list(self.actors.items()):
+            if a["node_id"] == node_id and a["state"] in (ALIVE, PENDING,
+                                                          RESTARTING):
+                await self._on_actor_failed(aid, f"node died: {reason}")
+        self.pub.publish("node_dead",
+                         {"node_id": node_id, "reason": reason})
+
+    async def _on_disconnect(self, conn: ServerConn):
+        self.pub.unsubscribe_conn(conn)
+        node_id = conn.state.get("node_id")
+        if node_id is not None:
+            await self._mark_node_dead(node_id, "connection lost")
+        if conn.state.get("is_driver"):
+            job_id = conn.state.get("job_id")
+            if job_id:
+                await self._finish_job(job_id)
+
+
+def run_control_plane(host: str, port: int, ready_queue=None):
+    """Entry point when the control plane runs as its own process."""
+    async def _main():
+        cp = ControlPlane(host, port)
+        actual_port = await cp.start()
+        if ready_queue is not None:
+            ready_queue.put(actual_port)
+        await asyncio.Event().wait()  # serve forever
+
+    asyncio.run(_main())

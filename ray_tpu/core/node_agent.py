@@ -1,0 +1,846 @@
+"""Node agent: per-node data plane (raylet equivalent, SURVEY.md §2.3).
+
+Composes, like the reference NodeManager (`node_manager.h:115`):
+- WorkerPool        — worker process lifecycle, reuse, idle cull
+                      (worker_pool.h:156); TPU-aware: workers holding TPU
+                      chips are never idle-culled (device init + compile
+                      cache are expensive to recreate).
+- ClusterTaskManager— local-vs-spill decision from the synced cluster view
+                      (cluster_task_manager.h:42); hybrid policy: prefer
+                      local while resources fit, else best remote node.
+- LocalTaskManager  — dependency staging → resource grant → dispatch to a
+                      leased worker (local_task_manager.h:58).
+- ObjectManager     — owns the node's shm store segment; chunked pulls from
+                      peer agents (object_manager.h:117 push/pull).
+- PlacementGroupResourceManager — 2-phase bundle PREPARE/COMMIT
+                      (placement_group_resource_manager.h).
+- MemoryMonitor     — node OOM watcher killing newest worker
+                      (memory_monitor.h:52).
+
+Resources are a flat {name: float} map; TPU chips appear as "TPU" plus
+slice-topology labels ("tpu-slice:v5e-8": 1) so gang placement can target
+whole ICI domains.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import subprocess
+import sys
+import time
+from collections import deque
+from typing import Any
+
+from ray_tpu._private import rpc
+from ray_tpu._private.rpc import AsyncRpcClient, RpcServer
+from ray_tpu.core.object_store import ObjectStoreClient
+
+logger = logging.getLogger(__name__)
+
+CHUNK = 4 * 1024 * 1024
+IDLE_CULL_S = 60.0
+SPILL_MAX = 2  # max times a task may be forwarded before it must run
+
+
+def detect_resources() -> dict:
+    import psutil
+
+    res = {"CPU": float(os.cpu_count() or 1),
+           "memory": float(psutil.virtual_memory().total)}
+    chips = os.environ.get("RAY_TPU_CHIPS")
+    if chips:
+        res["TPU"] = float(chips)
+        topo = os.environ.get("RAY_TPU_TOPOLOGY")
+        if topo:
+            res[f"tpu-slice:{topo}"] = 1.0
+    return res
+
+
+class WorkerHandle:
+    def __init__(self, worker_id: bytes, proc: subprocess.Popen):
+        self.worker_id = worker_id
+        self.proc = proc
+        self.addr: str | None = None
+        self.port: int | None = None
+        self.client: AsyncRpcClient | None = None
+        self.ready = asyncio.Event()
+        self.busy_task: bytes | None = None
+        self.actor_id: bytes | None = None
+        self.job_id: bytes | None = None
+        self.holds_tpu = False
+        self.idle_since = time.monotonic()
+        self.started_at = time.monotonic()
+        self.actor_resources: dict | None = None
+        self.actor_bundle = None
+
+    @property
+    def idle(self) -> bool:
+        return self.busy_task is None and self.actor_id is None
+
+
+class NodeAgent:
+    def __init__(self, head_addr: str, head_port: int, *,
+                 host: str = "127.0.0.1", port: int = 0,
+                 resources: dict | None = None,
+                 store_capacity: int = 512 * 1024 * 1024,
+                 session_id: str | None = None,
+                 node_id: bytes | None = None,
+                 labels: dict | None = None):
+        self.head_addr = head_addr
+        self.head_port = head_port
+        self.node_id = node_id or os.urandom(16)
+        self.resources_total = dict(resources or detect_resources())
+        self.resources_available = dict(self.resources_total)
+        self.labels = labels or {}
+        self.server = RpcServer(host, port)
+        self.host = host
+        self.session_id = session_id or os.urandom(4).hex()
+        self.store_name = (
+            f"/rtstore_{self.session_id}_{self.node_id.hex()[:8]}"
+        )
+        self.store = ObjectStoreClient.create(
+            self.store_name, store_capacity
+        )
+        self.head: AsyncRpcClient | None = None
+        self.workers: dict[bytes, WorkerHandle] = {}
+        self.task_queue: deque[dict] = deque()
+        self.running: dict[bytes, dict] = {}  # task_id → spec
+        self.cluster_view: dict[bytes, dict] = {}
+        self.bundles: dict[tuple[bytes, int], dict] = {}  # prepared/committed
+        self.bundle_available: dict[tuple[bytes, int], dict] = {}
+        self._peer_clients: dict[bytes, AsyncRpcClient] = {}
+        self._pulls_inflight: dict[bytes, asyncio.Future] = {}
+        self._bg: list[asyncio.Task] = []
+        self._install_routes()
+        self._dead = False
+
+    # ---------------- lifecycle ----------------
+
+    def _install_routes(self):
+        for name in dir(self):
+            if name.startswith("rpc_"):
+                self.server.handlers[name[4:]] = getattr(self, name)
+
+    async def start(self) -> int:
+        port = await self.server.start()
+        self.port = port
+        self.head = AsyncRpcClient(self.head_addr, self.head_port)
+        await self.head.connect()
+        self.head.on_push("node_dead", self._on_node_dead_push)
+        self.head.on_push("node_added", self._on_node_added_push)
+        reply = await self.head.call("register_node", {
+            "node_id": self.node_id, "addr": self.host, "port": port,
+            "resources": self.resources_total, "labels": self.labels,
+        })
+        for view in reply["nodes"]:
+            self.cluster_view[view["node_id"]] = view
+        self.head.on_push("job_finished", self._on_job_finished_push)
+        await self.head.call("subscribe", {"channel": "node_dead"})
+        await self.head.call("subscribe", {"channel": "node_added"})
+        await self.head.call("subscribe", {"channel": "job_finished"})
+        self._bg.append(asyncio.ensure_future(self._heartbeat_loop()))
+        self._bg.append(asyncio.ensure_future(self._reap_loop()))
+        self._bg.append(asyncio.ensure_future(self._dispatch_loop()))
+        logger.info("node agent %s up on %s:%s", self.node_id.hex()[:8],
+                    self.host, port)
+        return port
+
+    async def stop(self):
+        self._dead = True
+        for t in self._bg:
+            t.cancel()
+        for w in list(self.workers.values()):
+            self._kill_worker(w)
+        if self.head is not None:
+            await self.head.close()
+        for c in self._peer_clients.values():
+            await c.close()
+        await self.server.stop()
+        self.store.close()
+
+    def _on_node_dead_push(self, payload):
+        nid = payload["node_id"]
+        view = self.cluster_view.get(nid)
+        if view is not None:
+            view["alive"] = False
+        cli = self._peer_clients.pop(nid, None)
+        if cli is not None:
+            asyncio.ensure_future(cli.close())
+
+    def _on_node_added_push(self, payload):
+        self.cluster_view[payload["node_id"]] = payload
+
+    def _on_job_finished_push(self, payload):
+        """Reap this job's workers (reference: raylet kills job workers on
+        driver exit)."""
+        job_id = payload["job_id"]
+        for w in list(self.workers.values()):
+            if w.job_id == job_id and w.actor_id is None:
+                self._kill_worker(w)
+
+    async def _heartbeat_loop(self):
+        while not self._dead:
+            try:
+                reply = await self.head.call("heartbeat", {
+                    "node_id": self.node_id,
+                    "resources_available": self.resources_available,
+                })
+                if reply.get("unknown"):
+                    await self.head.call("register_node", {
+                        "node_id": self.node_id, "addr": self.host,
+                        "port": self.port,
+                        "resources": self.resources_total,
+                        "labels": self.labels,
+                    })
+                view = await self.head.call("get_cluster_view", {})
+                for v in view["nodes"]:
+                    self.cluster_view[v["node_id"]] = v
+            except (rpc.ConnectionLost, rpc.RpcError):
+                pass
+            await asyncio.sleep(1.0)
+
+    # ---------------- worker pool ----------------
+
+    async def _spawn_worker(self, job_id: bytes | None,
+                            holds_tpu: bool = False) -> WorkerHandle:
+        worker_id = os.urandom(16)
+        env = dict(os.environ)
+        env.update({
+            "RAY_TPU_HEAD": f"{self.head_addr}:{self.head_port}",
+            "RAY_TPU_AGENT": f"{self.host}:{self.port}",
+            "RAY_TPU_STORE": self.store_name,
+            "RAY_TPU_NODE_ID": self.node_id.hex(),
+            "RAY_TPU_WORKER_ID": worker_id.hex(),
+            "RAY_TPU_SESSION": self.session_id,
+        })
+        if job_id:
+            env["RAY_TPU_JOB_ID"] = job_id.hex()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.worker_proc"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+        handle = WorkerHandle(worker_id, proc)
+        handle.job_id = job_id
+        handle.holds_tpu = holds_tpu
+        self.workers[worker_id] = handle
+        asyncio.ensure_future(self._drain_worker_logs(handle))
+        return handle
+
+    async def _drain_worker_logs(self, w: WorkerHandle):
+        """Forward worker stdout/stderr lines to the head log channel."""
+        loop = asyncio.get_running_loop()
+
+        def _read(stream, kind):
+            for line in iter(stream.readline, b""):
+                text = line.decode(errors="replace").rstrip()
+                if text:
+                    loop.call_soon_threadsafe(
+                        self._publish_log, w.worker_id, kind, text
+                    )
+            stream.close()
+
+        for stream, kind in ((w.proc.stdout, "out"), (w.proc.stderr, "err")):
+            if stream is not None:
+                loop.run_in_executor(None, _read, stream, kind)
+
+    def _publish_log(self, worker_id: bytes, kind: str, text: str):
+        if self.head is not None and not self.head.closed:
+            asyncio.ensure_future(self._push_log(worker_id, kind, text))
+
+    async def _push_log(self, worker_id, kind, text):
+        try:
+            await self.head.oneway("worker_log", {
+                "worker_id": worker_id, "node_id": self.node_id,
+                "kind": kind, "line": text,
+            })
+        except Exception:
+            pass
+
+    async def rpc_register_executor(self, conn, p):
+        """A spawned worker process reports its direct-RPC address."""
+        w = self.workers.get(p["worker_id"])
+        if w is None:
+            return False
+        w.addr, w.port = p["addr"], p["port"]
+        w.client = AsyncRpcClient(w.addr, w.port)
+        await w.client.connect()
+        w.ready.set()
+        return True
+
+    async def _pop_worker(self, job_id: bytes | None,
+                          holds_tpu: bool = False) -> WorkerHandle:
+        """Idle worker of the same job, else spawn (worker_pool.h PopWorker)."""
+        for w in self.workers.values():
+            if w.idle and w.ready.is_set() and w.job_id == job_id \
+                    and w.proc.poll() is None:
+                w.idle_since = time.monotonic()
+                return w
+        w = await self._spawn_worker(job_id, holds_tpu)
+        await asyncio.wait_for(w.ready.wait(), timeout=60.0)
+        return w
+
+    def _kill_worker(self, w: WorkerHandle):
+        self.workers.pop(w.worker_id, None)
+        if w.client is not None:
+            asyncio.ensure_future(w.client.close())
+        if w.proc.poll() is None:
+            w.proc.terminate()
+
+            async def _escalate(proc=w.proc):
+                # don't block the event loop on proc.wait; SIGKILL after grace
+                await asyncio.sleep(2)
+                if proc.poll() is None:
+                    proc.kill()
+
+            try:
+                asyncio.ensure_future(_escalate())
+            except RuntimeError:  # no running loop (shutdown path)
+                try:
+                    w.proc.wait(timeout=2)
+                except subprocess.TimeoutExpired:
+                    w.proc.kill()
+
+    async def _reap_loop(self):
+        """Detect dead workers; cull long-idle non-TPU workers."""
+        while not self._dead:
+            await asyncio.sleep(0.2)
+            now = time.monotonic()
+            for w in list(self.workers.values()):
+                code = w.proc.poll()
+                if code is not None:
+                    await self._on_worker_death(w, code)
+                elif (w.idle and not w.holds_tpu and w.ready.is_set()
+                      and now - w.idle_since > IDLE_CULL_S):
+                    self._kill_worker(w)
+
+    async def _on_worker_death(self, w: WorkerHandle, code: int):
+        self.workers.pop(w.worker_id, None)
+        if w.actor_id is not None:
+            # actor process died → control plane decides restart
+            for r, v in (w.actor_resources or {}).items():
+                self._release(r, v, w.actor_bundle)
+            try:
+                await self.head.call("actor_failed", {
+                    "actor_id": w.actor_id,
+                    "reason": f"worker exited with code {code}",
+                })
+            except (rpc.ConnectionLost, rpc.RpcError):
+                pass
+        if w.busy_task is not None:
+            spec = self.running.pop(w.busy_task, None)
+            if spec is not None:
+                self._free_task_resources(spec)
+                await self._notify_task_failed(
+                    spec, f"worker died with exit code {code}"
+                )
+
+    async def _notify_task_failed(self, spec: dict, reason: str,
+                                  retriable: bool = True):
+        """Tell the owner so it can retry or raise (task_manager.h:174)."""
+        owner = spec.get("owner")
+        if not owner:
+            return
+        try:
+            cli = await self._peer_worker(owner)
+            if cli is not None:
+                await cli.oneway("task_failed", {
+                    "task_id": spec["task_id"], "reason": reason,
+                    "retriable": retriable,
+                })
+        except (rpc.ConnectionLost, rpc.RpcError, OSError):
+            pass
+
+    _worker_peer_clients: dict[tuple, AsyncRpcClient]
+
+    async def _peer_worker(self, owner: dict) -> AsyncRpcClient | None:
+        key = (owner["addr"], owner["port"])
+        cache = getattr(self, "_wpc", None)
+        if cache is None:
+            cache = self._wpc = {}
+        cli = cache.get(key)
+        if cli is not None and not cli.closed:
+            return cli
+        cli = AsyncRpcClient(owner["addr"], owner["port"])
+        try:
+            await cli.connect(retries=3)
+        except rpc.ConnectionLost:
+            return None
+        cache[key] = cli
+        return cli
+
+    # ---------------- resources ----------------
+
+    def _fits(self, need: dict, pool: dict) -> bool:
+        return all(pool.get(r, 0.0) >= v - 1e-9 for r, v in need.items())
+
+    def _take(self, need: dict, pool: dict):
+        for r, v in need.items():
+            pool[r] = pool.get(r, 0.0) - v
+
+    def _give(self, need: dict, pool: dict):
+        for r, v in need.items():
+            pool[r] = pool.get(r, 0.0) + v
+
+    def _task_pool(self, spec: dict) -> dict | None:
+        """Resource pool a task draws from: a PG bundle or the node pool."""
+        pgid = spec.get("pg_id")
+        if pgid:
+            key = (pgid, spec.get("bundle_index", 0))
+            pool = self.bundle_available.get(key)
+            return pool  # None → bundle not on this node
+        return self.resources_available
+
+    def _free_task_resources(self, spec: dict):
+        if spec.get("_granted"):
+            pool = self._task_pool(spec)
+            if pool is not None:
+                self._give(spec.get("resources", {}), pool)
+            spec["_granted"] = False
+
+    def _release(self, r, v, bundle_key=None):
+        pool = (self.bundle_available.get(bundle_key)
+                if bundle_key else self.resources_available)
+        if pool is not None:
+            pool[r] = pool.get(r, 0.0) + v
+
+    # ---------------- task scheduling ----------------
+
+    async def rpc_submit_task(self, conn, p):
+        """Entry from a local worker/driver or a spilling peer agent."""
+        spec = p
+        spec.setdefault("_spills", 0)
+        target = self._choose_node(spec)
+        if target is not None and target != self.node_id \
+                and spec["_spills"] < SPILL_MAX:
+            spec["_spills"] += 1
+            ok = await self._forward_task(spec, target)
+            if ok:
+                return {"queued": "remote", "node": target}
+        self.task_queue.append(spec)
+        self._kick_dispatch()
+        return {"queued": "local"}
+
+    def _choose_node(self, spec: dict) -> bytes | None:
+        """Hybrid policy (hybrid_scheduling_policy.h:29): local first while
+        it fits; else the alive node with best availability."""
+        need = spec.get("resources", {})
+        if spec.get("pg_id"):
+            # PG tasks must run where the bundle is committed
+            key = (spec["pg_id"], spec.get("bundle_index", 0))
+            if key in self.bundle_available:
+                return self.node_id
+            pg_nodes = spec.get("bundle_nodes")
+            if pg_nodes:
+                return pg_nodes[spec.get("bundle_index", 0)]
+            return self.node_id
+        strategy = spec.get("scheduling_strategy")
+        if isinstance(strategy, dict) and strategy.get("node_id"):
+            return strategy["node_id"]  # node affinity
+        if self._fits(need, self.resources_available):
+            return self.node_id
+        if not self._fits(need, self.resources_total):
+            # can never run here; find any node whose total fits
+            best, best_avail = None, -1.0
+            for nid, view in self.cluster_view.items():
+                if not view.get("alive") or nid == self.node_id:
+                    continue
+                tot = view.get("resources_total", {})
+                if all(tot.get(r, 0) >= v for r, v in need.items()):
+                    avail = view.get("resources_available", {}).get("CPU", 0)
+                    if avail > best_avail:
+                        best, best_avail = nid, avail
+            return best
+        # fits in total but busy now: spill if a peer has free capacity
+        best, best_avail = None, 0.0
+        for nid, view in self.cluster_view.items():
+            if not view.get("alive") or nid == self.node_id:
+                continue
+            av = view.get("resources_available", {})
+            if all(av.get(r, 0) >= v for r, v in need.items()):
+                score = av.get("CPU", 0)
+                if score > best_avail:
+                    best, best_avail = nid, score
+        if best is not None:
+            return best
+        return self.node_id  # queue locally
+
+    async def _forward_task(self, spec: dict, node_id: bytes) -> bool:
+        cli = await self._peer_agent(node_id)
+        if cli is None:
+            return False
+        fwd = {k: v for k, v in spec.items() if not k.startswith("_")}
+        fwd["_spills"] = spec["_spills"]
+        try:
+            await cli.call("submit_task", fwd)
+            return True
+        except (rpc.ConnectionLost, rpc.RpcError):
+            return False
+
+    async def _peer_agent(self, node_id: bytes) -> AsyncRpcClient | None:
+        cli = self._peer_clients.get(node_id)
+        if cli is not None and not cli.closed:
+            return cli
+        view = self.cluster_view.get(node_id)
+        if view is None or not view.get("alive"):
+            return None
+        cli = AsyncRpcClient(view["addr"], view["port"])
+        try:
+            await cli.connect(retries=3)
+        except rpc.ConnectionLost:
+            return None
+        self._peer_clients[node_id] = cli
+        return cli
+
+    def _kick_dispatch(self):
+        ev = getattr(self, "_dispatch_ev", None)
+        if ev is not None:
+            ev.set()
+
+    async def _dispatch_loop(self):
+        """LocalTaskManager: stage deps → grant resources → run
+        (local_task_manager.cc:101 DispatchScheduledTasksToWorkers)."""
+        self._dispatch_ev = asyncio.Event()
+        while not self._dead:
+            self._dispatch_ev.clear()
+            progressed = await self._dispatch_once()
+            if not progressed:
+                try:
+                    await asyncio.wait_for(self._dispatch_ev.wait(),
+                                           timeout=0.2)
+                except asyncio.TimeoutError:
+                    pass
+
+    async def _dispatch_once(self) -> bool:
+        if not self.task_queue:
+            return False
+        progressed = False
+        for _ in range(len(self.task_queue)):
+            spec = self.task_queue.popleft()
+            pool = self._task_pool(spec)
+            if pool is None:
+                # PG bundle not here (yet) — requeue
+                self.task_queue.append(spec)
+                continue
+            need = spec.get("resources", {})
+            if not self._fits(need, pool):
+                self.task_queue.append(spec)
+                continue
+            deps = spec.get("deps", [])
+            missing = [d for d in deps if not self.store.contains(d)
+                       and not self._is_inline(d, spec)]
+            if missing:
+                if not spec.get("_fetching"):
+                    spec["_fetching"] = True
+                    for d in missing:
+                        asyncio.ensure_future(self._ensure_local(d))
+                spec["_fetching_since"] = spec.get(
+                    "_fetching_since", time.monotonic())
+                self.task_queue.append(spec)
+                continue
+            self._take(need, pool)
+            spec["_granted"] = True
+            progressed = True
+            asyncio.ensure_future(self._run_task(spec))
+        return progressed
+
+    def _is_inline(self, dep: bytes, spec: dict) -> bool:
+        return dep in spec.get("inline_deps", ())
+
+    async def _run_task(self, spec: dict):
+        try:
+            w = await self._pop_worker(
+                spec.get("job_id"),
+                holds_tpu=spec.get("resources", {}).get("TPU", 0) > 0,
+            )
+        except (asyncio.TimeoutError, OSError) as e:
+            self._free_task_resources(spec)
+            await self._notify_task_failed(spec, f"worker spawn failed: {e}")
+            return
+        w.busy_task = spec["task_id"]
+        self.running[spec["task_id"]] = spec
+        spec["_worker_id"] = w.worker_id
+        try:
+            await w.client.oneway(
+                "execute_task",
+                {k: v for k, v in spec.items() if not k.startswith("_")},
+            )
+        except (rpc.ConnectionLost, rpc.RpcError, OSError) as e:
+            self.running.pop(spec["task_id"], None)
+            w.busy_task = None
+            self._free_task_resources(spec)
+            await self._notify_task_failed(spec, f"dispatch failed: {e}")
+
+    async def rpc_task_done(self, conn, p):
+        """Worker reports completion; frees resources, worker back to pool."""
+        spec = self.running.pop(p["task_id"], None)
+        if spec is not None:
+            self._free_task_resources(spec)
+            w = self.workers.get(spec.get("_worker_id", b""))
+            if w is not None:
+                w.busy_task = None
+                w.idle_since = time.monotonic()
+        self._kick_dispatch()
+        return True
+
+    async def rpc_cancel_task(self, conn, p):
+        tid = p["task_id"]
+        for i, spec in enumerate(self.task_queue):
+            if spec["task_id"] == tid:
+                del self.task_queue[i]
+                await self._notify_task_failed(spec, "cancelled",
+                                               retriable=False)
+                return {"cancelled": "queued"}
+        spec = self.running.get(tid)
+        if spec is not None and p.get("force"):
+            w = self.workers.get(spec.get("_worker_id", b""))
+            if w is not None:
+                self._kill_worker(w)
+            # _kill_worker removed the handle, so the reap loop will never
+            # see this death — clean up the task here.
+            self.running.pop(tid, None)
+            self._free_task_resources(spec)
+            self._kick_dispatch()
+            await self._notify_task_failed(spec, "cancelled",
+                                           retriable=False)
+            return {"cancelled": "running"}
+        return {"cancelled": None}
+
+    # ---------------- actors ----------------
+
+    async def rpc_start_actor(self, conn, p):
+        """Control plane placed an actor here: reserve + spawn + create."""
+        need = p.get("resources", {})
+        if not self._fits(need, self.resources_available):
+            raise rpc.RpcError("insufficient resources")
+        self._take(need, self.resources_available)
+        asyncio.ensure_future(self._start_actor_async(p, need))
+        return True
+
+    async def _start_actor_async(self, p: dict, need: dict):
+        try:
+            w = await self._spawn_worker(
+                p.get("job_id"), holds_tpu=need.get("TPU", 0) > 0
+            )
+            await asyncio.wait_for(w.ready.wait(), timeout=60.0)
+            w.actor_id = p["actor_id"]
+            w.actor_resources = need
+            w.actor_bundle = None
+            await w.client.call("create_actor", {
+                "actor_id": p["actor_id"], "spec": p["spec"],
+                "max_concurrency": p.get("max_concurrency", 1),
+            }, timeout=120.0)
+            await self.head.call("actor_started", {
+                "actor_id": p["actor_id"], "addr": w.addr, "port": w.port,
+                "worker_id": w.worker_id,
+            })
+        except Exception as e:  # noqa: BLE001 — any failure fails the actor
+            logger.warning("actor start failed: %s", e)
+            self._give(need, self.resources_available)
+            try:
+                await self.head.call("actor_failed", {
+                    "actor_id": p["actor_id"],
+                    "reason": f"creation failed: {e}",
+                })
+            except (rpc.ConnectionLost, rpc.RpcError):
+                pass
+
+    async def rpc_kill_actor_worker(self, conn, p):
+        for w in list(self.workers.values()):
+            if w.actor_id == p["actor_id"]:
+                self._kill_worker(w)
+                # reap path won't see it (already removed) → report here
+                self._give(w.actor_resources or {}, self.resources_available)
+                await self.head.call("actor_failed", {
+                    "actor_id": p["actor_id"],
+                    "reason": p.get("reason", "killed"),
+                })
+                return True
+        return False
+
+    # ---------------- placement group bundles ----------------
+
+    async def rpc_prepare_bundle(self, conn, p):
+        key = (p["pg_id"], p["bundle_index"])
+        need = p["resources"]
+        if not self._fits(need, self.resources_available):
+            return False
+        self._take(need, self.resources_available)
+        self.bundles[key] = {"resources": need, "state": "PREPARED"}
+        return True
+
+    async def rpc_commit_bundle(self, conn, p):
+        key = (p["pg_id"], p["bundle_index"])
+        b = self.bundles.get(key)
+        if b is None:
+            return False
+        b["state"] = "COMMITTED"
+        self.bundle_available[key] = dict(b["resources"])
+        self._kick_dispatch()
+        return True
+
+    async def rpc_cancel_bundle(self, conn, p):
+        key = (p["pg_id"], p["bundle_index"])
+        b = self.bundles.pop(key, None)
+        if b is not None:
+            self._give(b["resources"], self.resources_available)
+        self.bundle_available.pop(key, None)
+        return True
+
+    async def rpc_return_bundle(self, conn, p):
+        return await self.rpc_cancel_bundle(conn, p)
+
+    # ---------------- object manager ----------------
+
+    async def rpc_read_object_chunk(self, conn, p):
+        """Peer agents pull objects chunk by chunk (object_manager.cc:633)."""
+        oid, offset = p["object_id"], p["offset"]
+        buf = self.store.get(oid)
+        if buf is None:
+            return None
+        try:
+            total = len(buf.data)
+            chunk = bytes(buf.data[offset:offset + CHUNK])
+            return {"total": total, "meta": buf.metadata if offset == 0 else b"",
+                    "chunk": chunk}
+        finally:
+            buf.release()
+
+    async def rpc_fetch_object(self, conn, p):
+        """Local worker asks: make this object present in the node store."""
+        ok = await self._ensure_local(p["object_id"],
+                                      timeout=p.get("timeout", 60.0))
+        return bool(ok)
+
+    async def _ensure_local(self, oid: bytes, timeout: float = 60.0) -> bool:
+        if self.store.contains(oid):
+            return True
+        inflight = self._pulls_inflight.get(oid)
+        if inflight is not None:
+            return await asyncio.shield(inflight)
+        fut = asyncio.get_running_loop().create_future()
+        self._pulls_inflight[oid] = fut
+        try:
+            ok = await self._pull_object(oid, timeout)
+            fut.set_result(ok)
+            return ok
+        except Exception as e:  # propagate to co-waiters
+            fut.set_exception(e)
+            raise
+        finally:
+            self._pulls_inflight.pop(oid, None)
+
+    async def _pull_object(self, oid: bytes, timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            info = await self.head.call("object_wait_location", {
+                "object_id": oid,
+                "timeout": max(0.1, deadline - time.monotonic()),
+            })
+            if info is None:
+                return False
+            if self.node_id in info["locations"]:
+                return True  # a local writer beat us to it
+            pulled = False
+            for nid in info["locations"]:
+                cli = await self._peer_agent(nid)
+                if cli is None:
+                    continue
+                if await self._pull_from(cli, oid):
+                    pulled = True
+                    break
+            if pulled:
+                await self.head.call("object_add_location", {
+                    "object_id": oid, "node_id": self.node_id,
+                })
+                self._kick_dispatch()
+                return True
+            await asyncio.sleep(0.1)
+        return False
+
+    async def _pull_from(self, cli: AsyncRpcClient, oid: bytes) -> bool:
+        try:
+            first = await cli.call("read_object_chunk",
+                                   {"object_id": oid, "offset": 0})
+            if first is None:
+                return False
+            total, meta = first["total"], first["meta"]
+            if self.store.contains(oid):
+                return True
+            wbuf = self.store.create_object(oid, total, len(meta))
+            try:
+                wbuf.data[0:len(first["chunk"])] = first["chunk"]
+                offset = len(first["chunk"])
+                while offset < total:
+                    part = await cli.call(
+                        "read_object_chunk",
+                        {"object_id": oid, "offset": offset},
+                    )
+                    if part is None:
+                        wbuf.abort()
+                        return False
+                    chunk = part["chunk"]
+                    wbuf.data[offset:offset + len(chunk)] = chunk
+                    offset += len(chunk)
+                if meta:
+                    wbuf.meta[:] = meta
+                wbuf.seal()
+                return True
+            except Exception:
+                wbuf.abort()
+                raise
+        except (rpc.ConnectionLost, rpc.RpcError, OSError):
+            return False
+
+    async def rpc_object_sealed(self, conn, p):
+        """Local worker sealed an object: register location + pin primary."""
+        oid = p["object_id"]
+        self.store.pin(oid, True)  # primary copy: spill not evict (later)
+        await self.head.call("object_add_location", {
+            "object_id": oid, "node_id": self.node_id,
+            "owner": p.get("owner"), "size": p.get("size", 0),
+        })
+        self._kick_dispatch()
+        return True
+
+    async def rpc_free_objects(self, conn, p):
+        for oid in p["object_ids"]:
+            self.store.pin(oid, False)
+            self.store.delete(oid)
+            try:
+                await self.head.call("object_remove_location", {
+                    "object_id": oid, "node_id": self.node_id,
+                })
+            except (rpc.ConnectionLost, rpc.RpcError):
+                pass
+        return True
+
+    async def rpc_node_info(self, conn, p):
+        return {
+            "node_id": self.node_id,
+            "resources_total": self.resources_total,
+            "resources_available": self.resources_available,
+            "num_workers": len(self.workers),
+            "queued": len(self.task_queue),
+            "running": len(self.running),
+            "store_used": self.store.used_bytes(),
+            "store_capacity": self.store.capacity(),
+        }
+
+
+def run_node_agent(head_addr: str, head_port: int, *, host="127.0.0.1",
+                   port=0, resources=None, store_capacity=512 * 1024 * 1024,
+                   session_id=None, ready_queue=None):
+    """Run an agent as a dedicated process."""
+    async def _main():
+        agent = NodeAgent(
+            head_addr, head_port, host=host, port=port, resources=resources,
+            store_capacity=store_capacity, session_id=session_id,
+        )
+        actual = await agent.start()
+        if ready_queue is not None:
+            ready_queue.put(actual)
+        await asyncio.Event().wait()
+
+    asyncio.run(_main())

@@ -1,0 +1,287 @@
+"""Executor worker process: runs tasks and hosts actors.
+
+Analog of the reference's worker main loop (`python/ray/_private/worker.py:841
+main_loop` + `_raylet.pyx:1207 task_execution_handler`): spawned by the node
+agent, registers its direct-RPC endpoint, then executes tasks/actor calls on
+a dedicated execution thread pool, pushing results straight to owners.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import sys
+import threading
+import traceback
+
+from ray_tpu._private import rpc, serialization
+from ray_tpu._private.ids import ObjectID, TaskID
+from ray_tpu._private.worker import INLINE_MAX, CoreWorker, RayTaskError
+
+logger = logging.getLogger(__name__)
+
+
+class Executor(CoreWorker):
+    """CoreWorker + task/actor execution endpoints."""
+
+    def __init__(self, **kw):
+        self._exec_queue: queue.Queue = queue.Queue()
+        self._exec_threads: list[threading.Thread] = []
+        self._actor = None
+        self._actor_id: bytes | None = None
+        self._owner_hints: dict[bytes, dict] = {}
+        super().__init__(**kw)
+        self._start_exec_threads(1)
+
+    def _start_exec_threads(self, n: int):
+        while len(self._exec_threads) < n:
+            t = threading.Thread(
+                target=self._exec_loop,
+                name=f"ray_tpu-exec-{len(self._exec_threads)}",
+                daemon=True,
+            )
+            t.start()
+            self._exec_threads.append(t)
+
+    def _exec_loop(self):
+        while True:
+            kind, payload, reply = self._exec_queue.get()
+            try:
+                if kind == "task":
+                    self._execute_task(payload)
+                elif kind == "actor_create":
+                    try:
+                        self._create_actor(payload)
+                        reply.set_result(True)
+                    except BaseException as e:  # noqa: BLE001
+                        reply.set_exception(e)
+                elif kind == "actor_call":
+                    self._execute_actor_call(payload)
+            except Exception:
+                logger.exception("executor loop error")
+
+    # ---------- RPC endpoints (called by agent / owners) ----------
+
+    async def rpc_execute_task(self, conn, spec):
+        self._exec_queue.put(("task", spec, None))
+        return True
+
+    async def rpc_create_actor(self, conn, p):
+        import concurrent.futures
+
+        fut = concurrent.futures.Future()
+        if p.get("max_concurrency", 1) > 1:
+            self._start_exec_threads(p["max_concurrency"])
+        self._exec_queue.put(("actor_create", p, fut))
+        # block this handler until construction finishes (agent awaits)
+        import asyncio
+
+        return await asyncio.get_running_loop().run_in_executor(
+            None, fut.result, 300
+        )
+
+    async def rpc_actor_call(self, conn, call):
+        self._exec_queue.put(("actor_call", call, None))
+        return True
+
+    async def rpc_ping(self, conn, p):
+        return "pong"
+
+    async def rpc_exit(self, conn, p):
+        os._exit(0)
+
+    # ---------- execution ----------
+
+    def _load_inline_values(self, spec):
+        for oid, payload in spec.get("inline_values", {}).items():
+            if isinstance(payload, list) and len(payload) == 2 \
+                    and payload[0] == "__error__":
+                e = self._entry(oid)
+                e.error = payload[1]
+                e.event.set()
+            elif isinstance(payload, list) and len(payload) == 2 \
+                    and payload[0] == "__owner__":
+                self._owner_hints[oid] = payload[1]
+            else:
+                e = self._entry(oid)
+                if not e.ready:
+                    e.payload = payload
+                    e.event.set()
+
+    def _try_resolve_remote(self, oid: bytes) -> bool:
+        if super()._try_resolve_remote(oid):
+            return True
+        hint = self._owner_hints.get(oid)
+        if hint is not None and hint["worker_id"] != self.worker_id:
+            cli = self._peer(hint)
+            if cli is not None:
+                try:
+                    res = cli.call("get_object", {"object_id": oid})
+                except (rpc.ConnectionLost, rpc.RpcError):
+                    return False
+                if res:
+                    e = self._entry(oid)
+                    if res.get("error") is not None:
+                        e.error = res["error"]
+                    elif res.get("in_plasma"):
+                        e.in_plasma = True
+                    else:
+                        e.payload = res["payload"]
+                    e.event.set()
+                    return True
+        return False
+
+    def _resolve_args(self, spec):
+        self._load_inline_values(spec)
+        args_spec = spec["args"]
+        if "args_oid" in args_spec:
+            aoid = args_spec["args_oid"]
+            e = self._entry(aoid)
+            e.in_plasma = True
+            e.event.set()
+            payload = None
+            value = self._fetch_plasma(aoid, None)
+            args, kwargs = value
+        else:
+            payload = args_spec["payload"]
+            args, kwargs = serialization.unpack_payload(payload)
+        # top-level ObjectRef args are awaited + replaced by their values
+        # (reference semantics; nested refs pass through untouched)
+        from ray_tpu._private.api import ObjectRef
+
+        def _resolve(x):
+            if isinstance(x, ObjectRef):
+                return self._get_one(x.binary(), None)
+            return x
+
+        args = tuple(_resolve(a) for a in args)
+        kwargs = {k: _resolve(v) for k, v in kwargs.items()}
+        return args, kwargs
+
+    def _push_results(self, spec, owner, results, error=None):
+        cli = self._peer(owner)
+        n = spec.get("num_returns", 1)
+        task_id = spec["task_id"]
+        actor_id = spec.get("actor_id")
+        for i in range(n):
+            oid = ObjectID.for_task_return(TaskID(task_id), i).binary()
+            msg = {"object_id": oid, "task_id": task_id}
+            if actor_id is not None:
+                msg["actor_id"] = actor_id
+            if error is not None:
+                msg["error"] = error
+            else:
+                value = results[i] if n > 1 else results
+                payload = serialization.pack_payload(value)
+                size = len(payload[0]) + sum(len(b) for b in payload[1])
+                if size <= INLINE_MAX:
+                    msg["payload"] = payload
+                else:
+                    self._put_plasma(oid, payload)
+                    msg["in_plasma"] = True
+                    msg["size"] = size
+            if cli is not None:
+                try:
+                    cli.oneway("push_result", msg)
+                except (rpc.ConnectionLost, rpc.RpcError):
+                    pass
+
+    def _execute_task(self, spec):
+        owner = spec["owner"]
+        try:
+            fn = self.load_function(spec["func_id"])
+            args, kwargs = self._resolve_args(spec)
+            results = fn(*args, **kwargs)
+            n = spec.get("num_returns", 1)
+            if n > 1:
+                results = tuple(results)
+                if len(results) != n:
+                    raise RayTaskError(
+                        f"task declared num_returns={n} but returned "
+                        f"{len(results)} values"
+                    )
+            self._push_results(spec, owner, results)
+        except BaseException as e:  # noqa: BLE001 — report, don't die
+            tb = traceback.format_exc()
+            logger.warning("task %s failed: %s", spec.get("name"), tb)
+            err = serialization.pack_payload(
+                e if _picklable(e) else
+                RayTaskError(f"{type(e).__name__}: {e}\n{tb}")
+            )
+            self._push_results(spec, owner, None, error=err)
+        finally:
+            try:
+                self.agent.call("task_done", {"task_id": spec["task_id"]})
+            except (rpc.ConnectionLost, rpc.RpcError):
+                pass
+
+    def _create_actor(self, p):
+        cls, args, kwargs = serialization.unpack_payload(p["spec"])
+        self._actor_id = p["actor_id"]
+        self._actor = cls(*args, **kwargs)
+
+    def _execute_actor_call(self, call):
+        owner = call["owner"]
+        try:
+            method = getattr(self._actor, call["method"])
+            args, kwargs = self._resolve_args(call)
+            results = method(*args, **kwargs)
+            n = call.get("num_returns", 1)
+            if n > 1:
+                results = tuple(results)
+            self._push_results(call, owner, results)
+        except BaseException as e:  # noqa: BLE001
+            tb = traceback.format_exc()
+            logger.warning("actor call %s failed: %s", call["method"], tb)
+            err = serialization.pack_payload(
+                e if _picklable(e) else
+                RayTaskError(f"{type(e).__name__}: {e}\n{tb}")
+            )
+            self._push_results(call, owner, None, error=err)
+
+    async def rpc_push_result(self, conn, p):
+        # clear owner-side actor pending on completion
+        res = await super().rpc_push_result(conn, p)
+        if p.get("actor_id") and p.get("task_id"):
+            self.actor_task_finished(p["actor_id"], p["task_id"])
+        return res
+
+
+def _picklable(e) -> bool:
+    try:
+        serialization.pack_payload(e)
+        return True
+    except Exception:
+        return False
+
+
+def main():
+    logging.basicConfig(
+        level=os.environ.get("RAY_TPU_LOG_LEVEL", "WARNING"),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    head_addr, head_port = os.environ["RAY_TPU_HEAD"].rsplit(":", 1)
+    agent_addr, agent_port = os.environ["RAY_TPU_AGENT"].rsplit(":", 1)
+    worker = Executor(
+        head_addr=head_addr, head_port=int(head_port),
+        agent_addr=agent_addr, agent_port=int(agent_port),
+        store_name=os.environ["RAY_TPU_STORE"],
+        node_id=bytes.fromhex(os.environ["RAY_TPU_NODE_ID"]),
+        job_id=bytes.fromhex(os.environ.get("RAY_TPU_JOB_ID", "00" * 16)),
+        worker_id=bytes.fromhex(os.environ["RAY_TPU_WORKER_ID"]),
+    )
+    # register with the node agent so it can dispatch to us
+    worker.agent.call("register_executor", {
+        "worker_id": worker.worker_id, "addr": worker.addr,
+        "port": worker.port,
+    })
+    # make the public API usable inside tasks (nested submissions)
+    from ray_tpu._private import api
+
+    api._set_global_worker(worker)
+    threading.Event().wait()  # serve forever
+
+
+if __name__ == "__main__":
+    sys.exit(main())
