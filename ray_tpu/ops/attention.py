@@ -35,11 +35,18 @@ def attention_reference(q, k, v, *, causal: bool = True, logits_dtype=jnp.float3
     logits = jnp.einsum(
         "bthd,bshd->bhts", q, k, preferred_element_type=logits_dtype
     ) * scale
+    empty_rows = None
     if causal:
         t, s = logits.shape[-2:]
         mask = jnp.tril(jnp.ones((t, s), dtype=bool), k=s - t)
         logits = jnp.where(mask, logits, jnp.finfo(logits_dtype).min)
+        if s < t:
+            # Rows attending no keys: softmax would be uniform garbage;
+            # define the output as 0 (matches the flash kernel).
+            empty_rows = ~mask.any(-1)  # [t]
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    if empty_rows is not None:
+        probs = jnp.where(empty_rows[None, None, :, None], 0.0, probs)
     out = jnp.einsum(
         "bhts,bshd->bthd", probs.astype(v.dtype), v, preferred_element_type=jnp.float32
     )

@@ -9,8 +9,11 @@ are predicated off with pl.when, skipping ~half the FLOPs.
 GQA is handled in the BlockSpec index maps: q head h reads kv head h // group,
 so no kv replication ever materializes.
 
-Backward currently reuses the reference VJP (O(T·S) memory under remat);
-a pallas dq/dkv kernel pair replaces it in ops/flash_attention_bwd.py work.
+Backward is the standard flash-2 kernel pair: the forward additionally emits
+the per-row logsumexp ([B, H, T] f32); the backward recomputes
+p = exp(s - lse) per tile and runs two kernels — dq with
+the k dimension innermost, dk/dv with the q dimension innermost — so memory
+stays O(block²) and nothing [T, S]-shaped ever materializes.
 """
 
 from __future__ import annotations
@@ -24,12 +27,27 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ray_tpu.utils.math import cdiv
 
-DEFAULT_BLOCK_Q = 256
-DEFAULT_BLOCK_K = 256
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 1024
+_BWD_INNER = 1024  # min tile width along each bwd kernel's inner grid dim
 _NEG_INF = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, causal, scale, block_q, block_k, offset):
+def _causal_mask(s, q_start, k_start, offset):
+    """End-aligned causal mask: query row i attends keys <= i + offset."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + q_start
+    cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + k_start
+    return jnp.where(rows + offset >= cols, s, _NEG_INF)
+
+
+def _block_live(causal, q_start, k_start, block_q, offset):
+    """A [q, k] tile is dead iff it lies strictly above the shifted diagonal."""
+    return jnp.logical_or(
+        jnp.logical_not(causal), k_start <= q_start + block_q - 1 + offset
+    )
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, causal, scale, block_q, block_k, offset):
     """offset = S - T: the causal mask is end-aligned (query row i attends
     keys <= i + offset), matching attention_reference's tril(k=S-T) so decode
     (T=1 against a long cache) sees the whole prefix."""
@@ -43,36 +61,38 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, causal, sc
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # Causal: block is live unless it lies strictly above the (shifted)
-    # diagonal, i.e. its first key index exceeds the last query's reach.
     q_start = iq * block_q
     k_start = ik * block_k
-    block_live = jnp.logical_or(
-        jnp.logical_not(causal), k_start <= q_start + block_q - 1 + offset
-    )
 
-    @pl.when(block_live)
+    @pl.when(_block_live(causal, q_start, k_start, block_q, offset))
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32) * scale  # [bq, d]
-        k = k_ref[0, 0].astype(jnp.float32)  # [bk, d]
+        # Matmul operands stay in the input dtype (bf16 hits the MXU's native
+        # mode; f32 operands would run at a fraction of peak); accumulation
+        # and all softmax statistics are f32.
+        q = q_ref[0, 0]  # [bq, d]
+        k = k_ref[0, 0]  # [bk, d]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [bq, bk]
+        ) * scale  # [bq, bk]
         if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + q_start
-            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + k_start
-            s = jnp.where(rows + offset >= cols, s, _NEG_INF)
+            s = _causal_mask(s, q_start, k_start, offset)
 
         m_prev = m_scr[:, :1]  # [bq, 1] (lanes replicated)
         m_cur = jnp.max(s, axis=-1, keepdims=True)  # [bq, 1]
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)  # [bq, bk]
+        # Rows whose every key is masked (possible when T > S under causal,
+        # for rows straddling a live block) keep m_new at _NEG_INF; exp(s -
+        # m_new) would be exp(0) = 1 there, so force p to 0 on dead rows.
+        p = jnp.where(
+            m_new > _NEG_INF * 0.5, jnp.exp(s - m_new), 0.0
+        )  # [bq, bk]
         corr = jnp.exp(m_prev - m_new)  # [bq, 1]
         l_new = corr * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
 
-        v = v_ref[0, 0].astype(jnp.float32)  # [bk, d]
+        v = v_ref[0, 0]  # [bk, d]
         pv = jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )  # [bq, d]
         acc_scr[:] = acc_scr[:] * corr + pv
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
@@ -83,6 +103,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, causal, sc
         l = l_scr[:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        # Rows that attend nothing (only possible when T > S under causal)
+        # get lse = +LARGE so the backward's exp(s - lse) underflows to 0.
+        lse = jnp.where(l == 0.0, -_NEG_INF, m_scr[:, :1] + jnp.log(l_safe))
+        lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
 
 
 def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
@@ -103,7 +127,7 @@ def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
         _fwd_kernel, causal=causal, scale=scale, block_q=block_q,
         block_k=block_k, offset=s - t,
     )
-    return pl.pallas_call(
+    out, lse4 = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -115,10 +139,22 @@ def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
                 (1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)
             ),
         ],
-        out_specs=pl.BlockSpec(
-            (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
-        ),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_specs=[
+            pl.BlockSpec(
+                (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+            ),
+            # lse is written 8-lane-replicated: mosaic requires the last
+            # block dim be a multiple of 128 or the full array dim, so a
+            # packed [B, H, T] output can't be blocked per-head; 8 lanes is
+            # the narrowest legal layout (16x less HBM than 128).
+            pl.BlockSpec(
+                (1, 1, block_q, 8), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, hq, t, 8), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),  # running max m
             pltpu.VMEM((block_q, 128), jnp.float32),  # running denom l
@@ -126,38 +162,225 @@ def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(q, k, v)
+    return out, lse4[..., 0]  # lse: [B, H, T] f32
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_scr, *, causal, scale, block_q, block_k, offset):
+    """Grid (b, hq, iq, ik), ik innermost: dq tile accumulates across k."""
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q_start = iq * block_q
+    k_start = ik * block_k
+
+    @pl.when(_block_live(causal, q_start, k_start, block_q, offset))
+    def _compute():
+        q = q_ref[0, 0]  # [bq, d], input dtype (MXU-native)
+        k = k_ref[0, 0]  # [bk, d]
+        v = v_ref[0, 0]  # [bk, d]
+        do = do_ref[0, 0]  # [bq, d]
+        lse = jnp.expand_dims(lse_ref[0, 0, 0], -1)  # [bq, 1] f32
+        delta = jnp.expand_dims(delta_ref[0, 0, 0], -1)  # [bq, 1] f32
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [bq, bk]
+        if causal:
+            s = _causal_mask(s, q_start, k_start, offset)
+        p = jnp.exp(s - lse)  # [bq, bk] f32
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bk]
+        ds = (p * (dp - delta) * scale).astype(k.dtype)  # [bq, bk]
+        dq_scr[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr, *, causal, scale,
+                block_q, block_k, offset):
+    """Grid (b, hq, ik, iq), iq innermost: dk/dv tiles accumulate across q.
+
+    Outputs are per *query* head ([B, Hq, S, D]); the wrapper sums over the
+    GQA group to produce kv-head gradients without any in-kernel races.
+    """
+    ik = pl.program_id(2)
+    iq = pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q_start = iq * block_q
+    k_start = ik * block_k
+
+    @pl.when(_block_live(causal, q_start, k_start, block_q, offset))
+    def _compute():
+        q = q_ref[0, 0]  # [bq, d], input dtype (MXU-native)
+        k = k_ref[0, 0]  # [bk, d]
+        v = v_ref[0, 0]  # [bk, d]
+        do = do_ref[0, 0]  # [bq, d]
+        lse = jnp.expand_dims(lse_ref[0, 0, 0], -1)  # [bq, 1] f32
+        delta = jnp.expand_dims(delta_ref[0, 0, 0], -1)  # [bq, 1] f32
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [bq, bk]
+        if causal:
+            s = _causal_mask(s, q_start, k_start, offset)
+        p = jnp.exp(s - lse)  # [bq, bk] f32
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # p^T @ do -> [bk, d]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bk]
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # ds^T @ q -> [bk, d]
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, *, causal, block_q, block_k, interpret):
+    """Two kernels with independently tuned tile shapes.
+
+    The dq kernel iterates k innermost, so it wants wide k tiles (fewer grid
+    steps, bigger contractions); the dkv kernel iterates q innermost and wants
+    wide q tiles. The caller's (block_q, block_k) seed the *outer* tile of
+    each kernel; the inner tile is widened to the sequence length capped at
+    _BWD_INNER.
+    """
+    b, hq, t, d = q.shape
+    _, hkv, s, _ = k.shape
+    group = hq // hkv
+    scale = d ** -0.5
+    offset = s - t
+
+    def widen(block, seqlen):
+        # Double the tile while it still divides the sequence (the forward
+        # already validated seqlen % block == 0), capped at _BWD_INNER:
+        # pallas pads ragged blocks with undefined values, which must never
+        # reach the accumulating matmuls.
+        block = min(block, seqlen)
+        while block * 2 <= min(_BWD_INNER, seqlen) and seqlen % (block * 2) == 0:
+            block *= 2
+        return block
+
+    # dq kernel tiles: [bq_dq, bk_dq], k innermost and wide.
+    bq_dq = min(block_q, t)
+    bk_dq = widen(block_k, s)
+    # dkv kernel tiles: [bq_kv, bk_kv], q innermost and wide.
+    bq_kv = widen(block_q, t)
+    bk_kv = min(block_k, s)
+
+    # delta_i = rowsum(do_i * o_i); cheap elementwise reduce, XLA fuses it.
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    # [B, H, 1, T] so kernels read (1, 1, 1, block) lane-vectors.
+    lse_r = lse[:, :, None, :]
+    delta_r = delta[:, :, None, :]
+
+    def row_spec(block, index):
+        return pl.BlockSpec((1, 1, 1, block), index)
+
+    block_q, block_k = bq_dq, bk_dq
+    nq, nk = cdiv(t, block_q), cdiv(s, block_k)
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, causal=causal, scale=scale, block_q=block_q,
+            block_k=block_k, offset=offset,
+        ),
+        grid=(b, hq, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            row_spec(block_q, lambda bi, hi, qi, ki: (bi, hi, 0, qi)),
+            row_spec(block_q, lambda bi, hi, qi, ki: (bi, hi, 0, qi)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse_r, delta_r)
+
+    block_q, block_k = bq_kv, bk_kv
+    nq, nk = cdiv(t, block_q), cdiv(s, block_k)
+    dk_full, dv_full = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, causal=causal, scale=scale, block_q=block_q,
+            block_k=block_k, offset=offset,
+        ),
+        grid=(b, hq, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki, qi: (bi, hi // group, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki, qi: (bi, hi // group, ki, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            row_spec(block_q, lambda bi, hi, ki, qi: (bi, hi, 0, qi)),
+            row_spec(block_q, lambda bi, hi, ki, qi: (bi, hi, 0, qi)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hq, s, d), k.dtype),
+            jax.ShapeDtypeStruct((b, hq, s, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse_r, delta_r)
+
+    if group > 1:
+        dk = dk_full.reshape(b, hkv, group, s, d).sum(axis=2).astype(k.dtype)
+        dv = dv_full.reshape(b, hkv, group, s, d).sum(axis=2).astype(v.dtype)
+    else:
+        dk, dv = dk_full, dv_full
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_fwd(q, k, v, causal=causal, block_q=block_q, block_k=block_k, interpret=interpret)
+    out, _ = _flash_fwd(q, k, v, causal=causal, block_q=block_q, block_k=block_k, interpret=interpret)
+    return out
 
 
 def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out = _flash_fwd(q, k, v, causal=causal, block_q=block_q, block_k=block_k, interpret=interpret)
-    return out, (q, k, v)
+    out, lse = _flash_fwd(q, k, v, causal=causal, block_q=block_q, block_k=block_k, interpret=interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, g):
-    # Reference-gradient backward (numerically the same function). The tiled
-    # pallas backward will replace this; until then XLA remats the [T, S]
-    # logits inside this vjp only.
-    from ray_tpu.ops.attention import attention_reference
-
-    q, k, v = res
-
-    def ref(q_, k_, v_):
-        # [B, H, T, D] kernel layout -> reference layout [B, T, H, D]
-        o = attention_reference(
-            q_.transpose(0, 2, 1, 3),
-            k_.transpose(0, 2, 1, 3),
-            v_.transpose(0, 2, 1, 3),
-            causal=causal,
-        )
-        return o.transpose(0, 2, 1, 3)
-
-    _, vjp = jax.vjp(ref, q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    return _flash_bwd(
+        q, k, v, o, lse, g, causal=causal, block_q=block_q,
+        block_k=block_k, interpret=interpret,
+    )
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)

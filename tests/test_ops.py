@@ -82,6 +82,100 @@ def test_flash_gradients_match_reference(rng):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=1e-4, rtol=1e-3)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_gradients_match_reference_gqa(rng, causal):
+    b, t, hq, hkv, d = 1, 128, 4, 2, 32
+    k1, k2, k3 = jax.random.split(rng, 3)
+    q = jax.random.normal(k1, (b, t, hq, d), jnp.float32)
+    k = jax.random.normal(k2, (b, t, hkv, d), jnp.float32)
+    v = jax.random.normal(k3, (b, t, hkv, d), jnp.float32)
+
+    def f_ref(q, k, v):
+        return (attention_reference(q, k, v, causal=causal) ** 2).sum()
+
+    def f_flash(q, k, v):
+        return (flash_attention(q, k, v, causal=causal, block_q=64, block_k=64,
+                                interpret=True) ** 2).sum()
+
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_ref, g_flash):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=2e-4, rtol=1e-3)
+
+
+def test_flash_gradients_decode_shape(rng):
+    """T != S gradients (end-aligned causal mask in the backward)."""
+    b, t, s, h, d = 1, 64, 128, 2, 32
+    k1, k2, k3 = jax.random.split(rng, 3)
+    q = jax.random.normal(k1, (b, t, h, d), jnp.float32)
+    k = jax.random.normal(k2, (b, s, h, d), jnp.float32)
+    v = jax.random.normal(k3, (b, s, h, d), jnp.float32)
+
+    def f_ref(q, k, v):
+        return attention_reference(q, k, v, causal=True).sum()
+
+    def f_flash(q, k, v):
+        return flash_attention(q, k, v, causal=True, block_q=64, block_k=64,
+                               interpret=True).sum()
+
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_ref, g_flash):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=1e-4, rtol=1e-3)
+
+
+def test_flash_empty_rows_t_gt_s(rng):
+    """T > S causal: leading rows attend nothing -> output 0, gradients 0,
+    including rows straddling a live block."""
+    b, t, s, h, d = 1, 256, 128, 2, 32
+    k1, k2, k3 = jax.random.split(rng, 3)
+    q = jax.random.normal(k1, (b, t, h, d), jnp.float32)
+    k = jax.random.normal(k2, (b, s, h, d), jnp.float32)
+    v = jax.random.normal(k3, (b, s, h, d), jnp.float32)
+    want = attention_reference(q, k, v, causal=True)
+    got = flash_attention(q, k, v, causal=True, block_q=64, block_k=64,
+                          interpret=True)
+    # offset = -128: rows 0..127 attend nothing (blocks 0..1 of 64 are dead).
+    np.testing.assert_allclose(np.asarray(got[:, :128]), 0.0, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got[:, 128:]),
+                               np.asarray(want[:, 128:]), atol=2e-5, rtol=1e-4)
+
+    def f_flash(q, k, v):
+        return flash_attention(q, k, v, causal=True, block_q=64, block_k=64,
+                               interpret=True).sum()
+
+    def f_ref(q, k, v):
+        return attention_reference(q, k, v, causal=True).sum()
+
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_ref, g_flash):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=1e-4,
+                                   rtol=1e-3)
+
+
+def test_flash_gradients_non_pow2_seq(rng):
+    """Seq len where naive bwd tile widening would go ragged (1536 % 1024)."""
+    b, t, h, d = 1, 1536, 1, 32
+    k1, k2, k3 = jax.random.split(rng, 3)
+    q = jax.random.normal(k1, (b, t, h, d), jnp.float32)
+    k = jax.random.normal(k2, (b, t, h, d), jnp.float32)
+    v = jax.random.normal(k3, (b, t, h, d), jnp.float32)
+
+    def f_ref(q, k, v):
+        return attention_reference(q, k, v, causal=True).sum()
+
+    def f_flash(q, k, v):
+        return flash_attention(q, k, v, causal=True, block_q=512, block_k=512,
+                               interpret=True).sum()
+
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_ref, g_flash):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=2e-4,
+                                   rtol=1e-3)
+
+
 def test_flash_decode_shape_matches_reference(rng):
     """T != S (decode against a cache): mask must be end-aligned."""
     b, t, s, h, d = 1, 64, 256, 2, 32
