@@ -333,7 +333,9 @@ class ActorHandle:
         self._owns = owns
 
     def __getattr__(self, name):
-        if name.startswith("_"):
+        # "__ray_tpu_*" names are framework hooks (e.g. the collective-group
+        # init installed by CollectiveActorMixin) and are callable remotely.
+        if name.startswith("_") and not name.startswith("__ray_tpu_"):
             raise AttributeError(name)
         return ActorMethod(self, name)
 

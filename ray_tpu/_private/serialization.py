@@ -73,3 +73,35 @@ def pack_payload(obj: Any) -> list:
 def unpack_payload(payload: list) -> Any:
     meta, bufs = payload
     return loads_oob(meta, bufs)
+
+
+def pack_callable(fn) -> list:
+    """pack_payload for user callables, forcing by-value capture.
+
+    cloudpickle pickles module-level functions by reference; a function from
+    a driver-only module (a test file, a script dir) would then fail to
+    import on workers. Registering the defining module for by-value pickling
+    ships the code itself — framework and site-packages modules keep the
+    cheap by-ref path."""
+    import inspect
+    import sys
+
+    mod = inspect.getmodule(fn)
+    name = getattr(mod, "__name__", "") or ""
+    by_value = (
+        mod is not None
+        and name not in sys.builtin_module_names
+        and name != "__main__"  # already by-value in cloudpickle
+        and not name.startswith("ray_tpu")
+        and "site-packages" not in (getattr(mod, "__file__", "") or "")
+    )
+    if by_value:
+        try:
+            cloudpickle.register_pickle_by_value(mod)
+        except Exception:  # noqa: BLE001 — fall back to by-ref
+            by_value = False
+    try:
+        return pack_payload(fn)
+    finally:
+        if by_value:
+            cloudpickle.unregister_pickle_by_value(mod)

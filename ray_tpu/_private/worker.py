@@ -120,6 +120,12 @@ class CoreWorker:
         })
         self.head.on_push("actor_update", self._on_actor_update)
         self.head.call("subscribe", {"channel": "actor_update"})
+        # task_id -> node_id where the task was queued/ran; used to fail or
+        # retry in-flight tasks when that node dies (the dying agent cannot
+        # send task_failed itself).
+        self._task_nodes: dict[bytes, bytes] = {}
+        self.head.on_push("node_dead", self._on_node_dead)
+        self.head.call("subscribe", {"channel": "node_dead"})
 
     # ------------- helpers -------------
 
@@ -161,6 +167,8 @@ class CoreWorker:
 
     async def rpc_push_result(self, conn, p):
         """An executor finished a task we own (or serves a borrowed get)."""
+        if p.get("task_id"):
+            self._task_nodes.pop(p["task_id"], None)
         oid = p["object_id"]
         e = self._entry(oid)
         if p.get("error") is not None:
@@ -182,6 +190,7 @@ class CoreWorker:
 
     def _handle_task_failed(self, p):
         tid = p["task_id"]
+        self._task_nodes.pop(tid, None)
         spec = None
         with self._mem_lock:
             for e in self.memory.values():
@@ -190,6 +199,17 @@ class CoreWorker:
                     break
         if spec is None:
             return
+        # Already completed (e.g. node died after pushing results): no-op.
+        return_oids = [
+            ObjectID.for_task_return(TaskID(tid), i).binary()
+            for i in range(spec.get("num_returns", 1))
+        ]
+        with self._mem_lock:
+            if all(
+                self.memory.get(oid) is not None and self.memory[oid].ready
+                for oid in return_oids
+            ):
+                return
         if p.get("retriable", True) and spec.get("retries_left", 0) > 0:
             spec["retries_left"] -= 1
             logger.warning("retrying task %s (%s left): %s", tid.hex()[:8],
@@ -209,6 +229,25 @@ class CoreWorker:
             e = self._entry(oid)
             e.error = err
             e.event.set()
+
+    async def rpc_task_located(self, conn, p):
+        """An agent accepted one of our tasks into its local queue."""
+        self._task_nodes[p["task_id"]] = p["node_id"]
+        return True
+
+    def _on_node_dead(self, payload: dict):
+        dead = payload.get("node_id")
+        stranded = [tid for tid, nid in self._task_nodes.items()
+                    if nid == dead]
+        for tid in stranded:
+            self._task_nodes.pop(tid, None)
+            threading.Thread(
+                target=self._handle_task_failed,
+                args=({"task_id": tid,
+                       "reason": f"node died: {payload.get('reason')}",
+                       "retriable": True},),
+                daemon=True,
+            ).start()
 
     async def rpc_get_object(self, conn, p):
         """A borrower asks us (the owner) for a small object's value."""

@@ -170,6 +170,7 @@ class CollectiveActorMixin:
     def __ray_tpu_init_collective__(self, world_size, rank, backend,
                                     group_name):
         init_collective_group(world_size, rank, backend, group_name)
+        self._coll_group = group_name
         return rank
 
 

@@ -21,9 +21,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 def _shard_map(fn, mesh: Mesh, in_specs, out_specs):
-    from jax.experimental.shard_map import shard_map
-
-    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    # check_vma=False: collective outputs are replicated by construction
+    # (psum/all_gather), which shard_map's static replication checker can't
+    # always infer.
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
 
 
 def _replicated(mesh):
@@ -101,10 +103,14 @@ def mesh_broadcast(x: jax.Array, mesh: Mesh, axis: str, root: int = 0,
 
 
 def mesh_ppermute(x: jax.Array, mesh: Mesh, axis: str, shift: int = 1,
-                  *, wrap: bool = True):
+                  *, shard_axis: int = 0, wrap: bool = True):
     """Neighbor permute along the axis ring — the ICI primitive ring
     attention is built from (reference has no analog; NCCL send/recv is the
-    closest, collective.py:531)."""
+    closest, collective.py:531).
+
+    `x` is sharded over `axis` along dim `shard_axis`; each member's shard
+    moves to its ring neighbor `shift` hops away.
+    """
     n = mesh.shape[axis]
     perm = [(i, (i + shift) % n) for i in range(n)]
 
@@ -114,6 +120,7 @@ def mesh_ppermute(x: jax.Array, mesh: Mesh, axis: str, shift: int = 1,
     if not wrap:
         return body(x)
     spec = [None] * x.ndim
+    spec[shard_axis] = axis
     f = _shard_map(body, mesh, in_specs=P(*spec), out_specs=P(*spec))
     return jax.jit(f)(x)
 

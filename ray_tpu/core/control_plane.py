@@ -335,9 +335,15 @@ class ControlPlane:
                         continue
                 elif node.node_id not in placed:
                     continue
-            if all(node.resources_available.get(r, 0) >= v
-                   for r, v in need.items()):
-                candidates.append(node)
+            if pg is None and not all(
+                node.resources_available.get(r, 0) >= v
+                for r, v in need.items()
+            ):
+                # PG actors draw from the committed bundle on the agent, not
+                # the node pool (the bundle was deducted at commit time), so
+                # only non-PG actors are gated on node availability here.
+                continue
+            candidates.append(node)
         if not candidates:
             # stays PENDING; retried when resources free up / nodes join
             return
@@ -348,8 +354,13 @@ class ControlPlane:
         agent = await self._agent(node.node_id)
         if agent is None:
             return
-        for r, v in need.items():
-            node.resources_available[r] = node.resources_available.get(r, 0) - v
+        from_node_pool = pg is None
+        actor["_from_node_pool"] = from_node_pool
+        if from_node_pool:
+            for r, v in need.items():
+                node.resources_available[r] = (
+                    node.resources_available.get(r, 0) - v
+                )
         actor["node_id"] = node.node_id
         try:
             await agent.call("start_actor", {
@@ -358,12 +369,15 @@ class ControlPlane:
                 "spec": actor["spec"],
                 "resources": need,
                 "max_concurrency": actor["max_concurrency"],
+                "pg_id": actor.get("pg_id"),
+                "bundle_index": actor.get("bundle_index", -1),
             })
-        except rpc.RpcError as e:
+        except (rpc.RpcError, rpc.ConnectionLost) as e:
             logger.warning("start_actor failed on %s: %s",
                            node.node_id.hex()[:8], e)
-            for r, v in need.items():
-                node.resources_available[r] += v
+            if from_node_pool:
+                for r, v in need.items():
+                    node.resources_available[r] += v
             actor["node_id"] = None
 
     async def rpc_actor_started(self, conn, p):
@@ -400,7 +414,10 @@ class ControlPlane:
 
     def _release_actor_resources(self, actor):
         node = self.nodes.get(actor["node_id"]) if actor["node_id"] else None
-        if node is not None and node.alive:
+        if (node is not None and node.alive
+                and actor.get("_from_node_pool", True)):
+            # PG actors drew from the bundle (still committed) — nothing to
+            # return to the node pool.
             for r, v in actor["resources"].items():
                 node.resources_available[r] = (
                     node.resources_available.get(r, 0) + v

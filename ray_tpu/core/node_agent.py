@@ -419,8 +419,24 @@ class NodeAgent:
             if ok:
                 return {"queued": "remote", "node": target}
         self.task_queue.append(spec)
+        # Tell the owner where the task landed so it can fail/retry it if
+        # this node dies while the task is queued or running (the dying
+        # agent can't report; reference: owner-held leases detect raylet
+        # death via channel breakage).
+        if spec.get("owner"):
+            asyncio.ensure_future(self._notify_task_located(spec))
         self._kick_dispatch()
         return {"queued": "local"}
+
+    async def _notify_task_located(self, spec: dict):
+        try:
+            cli = await self._peer_worker(spec["owner"])
+            if cli is not None:
+                await cli.oneway("task_located", {
+                    "task_id": spec["task_id"], "node_id": self.node_id,
+                })
+        except (rpc.ConnectionLost, rpc.RpcError, OSError):
+            pass
 
     def _choose_node(self, spec: dict) -> bytes | None:
         """Hybrid policy (hybrid_scheduling_policy.h:29): local first while
@@ -610,15 +626,34 @@ class NodeAgent:
     # ---------------- actors ----------------
 
     async def rpc_start_actor(self, conn, p):
-        """Control plane placed an actor here: reserve + spawn + create."""
+        """Control plane placed an actor here: reserve + spawn + create.
+
+        PG actors draw from their committed bundle's pool (mirroring
+        _task_pool; reference converts bundles to indexed resources that PG
+        actors consume instead of the node pool)."""
         need = p.get("resources", {})
-        if not self._fits(need, self.resources_available):
-            raise rpc.RpcError("insufficient resources")
-        self._take(need, self.resources_available)
-        asyncio.ensure_future(self._start_actor_async(p, need))
+        bundle_key = None
+        if p.get("pg_id"):
+            bidx = p.get("bundle_index", -1)
+            keys = ([(p["pg_id"], bidx)] if bidx >= 0 else
+                    [k for k in self.bundle_available if k[0] == p["pg_id"]])
+            for key in keys:
+                pool = self.bundle_available.get(key)
+                if pool is not None and self._fits(need, pool):
+                    bundle_key = key
+                    break
+            if bundle_key is None:
+                raise rpc.RpcError("insufficient resources in pg bundle")
+            self._take(need, self.bundle_available[bundle_key])
+        else:
+            if not self._fits(need, self.resources_available):
+                raise rpc.RpcError("insufficient resources")
+            self._take(need, self.resources_available)
+        asyncio.ensure_future(self._start_actor_async(p, need, bundle_key))
         return True
 
-    async def _start_actor_async(self, p: dict, need: dict):
+    async def _start_actor_async(self, p: dict, need: dict,
+                                 bundle_key=None):
         try:
             w = await self._spawn_worker(
                 p.get("job_id"), holds_tpu=need.get("TPU", 0) > 0
@@ -626,7 +661,7 @@ class NodeAgent:
             await asyncio.wait_for(w.ready.wait(), timeout=60.0)
             w.actor_id = p["actor_id"]
             w.actor_resources = need
-            w.actor_bundle = None
+            w.actor_bundle = bundle_key
             await w.client.call("create_actor", {
                 "actor_id": p["actor_id"], "spec": p["spec"],
                 "max_concurrency": p.get("max_concurrency", 1),
@@ -637,7 +672,8 @@ class NodeAgent:
             })
         except Exception as e:  # noqa: BLE001 — any failure fails the actor
             logger.warning("actor start failed: %s", e)
-            self._give(need, self.resources_available)
+            for r, v in need.items():
+                self._release(r, v, bundle_key)
             try:
                 await self.head.call("actor_failed", {
                     "actor_id": p["actor_id"],
@@ -651,7 +687,8 @@ class NodeAgent:
             if w.actor_id == p["actor_id"]:
                 self._kill_worker(w)
                 # reap path won't see it (already removed) → report here
-                self._give(w.actor_resources or {}, self.resources_available)
+                for r, v in (w.actor_resources or {}).items():
+                    self._release(r, v, w.actor_bundle)
                 await self.head.call("actor_failed", {
                     "actor_id": p["actor_id"],
                     "reason": p.get("reason", "killed"),

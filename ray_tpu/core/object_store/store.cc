@@ -61,6 +61,10 @@ typedef struct ObjectEntry {
   uint8_t pinned;  // primary copy pinned by the node agent: never evict
   uint16_t _pad;
   uint64_t lru_tick;
+  // Actual bytes taken from the heap: heap_alloc may absorb a whole free
+  // block slightly larger than the aligned request; freeing must return
+  // exactly this many bytes or used_bytes/free-list accounting drifts.
+  uint64_t block_size;
 } ObjectEntry;
 
 // Free block header, stored inside the heap region itself.
@@ -149,9 +153,10 @@ static ObjectEntry* alloc_entry(ShmHeader* h, const uint8_t* id) {
 }
 
 // ---- heap allocator: first-fit free list with coalescing ----
-static uint64_t heap_alloc(ShmHeader* h, uint64_t want) {
+static uint64_t heap_alloc(ShmHeader* h, uint64_t want, uint64_t* granted) {
   want = (want + OS_ALIGN - 1) & ~(uint64_t)(OS_ALIGN - 1);
   if (want < sizeof(FreeBlock)) want = OS_ALIGN;
+  *granted = 0;
   uint8_t* heap = (uint8_t*)h + h->heap_off;
   uint64_t prev_off = 0;
   uint64_t cur = h->free_head;
@@ -177,6 +182,7 @@ static uint64_t heap_alloc(ShmHeader* h, uint64_t want) {
           h->free_head = fb->next_off;
       }
       h->used_bytes += want;
+      *granted = want;
       return cur;
     }
     prev_off = cur;
@@ -220,14 +226,6 @@ static void heap_free(ShmHeader* h, uint64_t off, uint64_t size) {
   }
 }
 
-// Storage size for one object (data + meta in one block).
-static uint64_t obj_block_size(ObjectEntry* e) {
-  uint64_t total = e->data_size + e->meta_size;
-  total = (total + OS_ALIGN - 1) & ~(uint64_t)(OS_ALIGN - 1);
-  if (total < OS_ALIGN) total = OS_ALIGN;
-  return total;
-}
-
 // Evict LRU sealed unreferenced objects until `needed` heap bytes could fit.
 // Returns freed byte count. Caller holds lock.
 static uint64_t evict_locked(ShmHeader* h, uint64_t needed) {
@@ -242,7 +240,7 @@ static uint64_t evict_locked(ShmHeader* h, uint64_t needed) {
       }
     }
     if (!victim) break;
-    uint64_t blk = obj_block_size(victim);
+    uint64_t blk = victim->block_size;
     heap_free(h, victim->data_off - h->heap_off, blk);
     victim->state = ST_TOMBSTONE;
     h->num_objects--;
@@ -377,10 +375,11 @@ int store_create(void* sp, const uint8_t* id, uint64_t data_size,
     unlock(h);
     return OS_FULL;
   }
-  uint64_t off = heap_alloc(h, want);
+  uint64_t granted = 0;
+  uint64_t off = heap_alloc(h, want, &granted);
   if (off == UINT64_MAX) {
     evict_locked(h, want);
-    off = heap_alloc(h, want);
+    off = heap_alloc(h, want, &granted);
   }
   if (off == UINT64_MAX) {
     unlock(h);
@@ -388,7 +387,7 @@ int store_create(void* sp, const uint8_t* id, uint64_t data_size,
   }
   ObjectEntry* e = alloc_entry(h, id);
   if (!e) {
-    heap_free(h, off, want);
+    heap_free(h, off, granted);
     unlock(h);
     return OS_FULL;  // table full
   }
@@ -401,6 +400,7 @@ int store_create(void* sp, const uint8_t* id, uint64_t data_size,
   e->state = ST_CREATED;
   e->pinned = 0;
   e->lru_tick = h->lru_clock++;
+  e->block_size = granted;
   h->num_objects++;
   *data_off = e->data_off;
   *meta_off = e->meta_off;
@@ -476,7 +476,7 @@ int store_delete(void* sp, const uint8_t* id) {
     unlock(h);
     return OS_BAD_STATE;
   }
-  heap_free(h, e->data_off - h->heap_off, obj_block_size(e));
+  heap_free(h, e->data_off - h->heap_off, e->block_size);
   e->state = ST_TOMBSTONE;
   h->num_objects--;
   unlock(h);
@@ -497,7 +497,7 @@ int store_abort(void* sp, const uint8_t* id) {
     unlock(h);
     return OS_BAD_STATE;
   }
-  heap_free(h, e->data_off - h->heap_off, obj_block_size(e));
+  heap_free(h, e->data_off - h->heap_off, e->block_size);
   e->state = ST_TOMBSTONE;
   h->num_objects--;
   unlock(h);

@@ -1,6 +1,14 @@
-"""Training layer: sharded train state/step builders and (soon) the
-JaxTrainer actor-group orchestration mirroring reference
-python/ray/train/data_parallel_trainer.py.
+"""Training layer.
+
+- step.py: sharded TrainState/step builders (mesh-axis parallelism)
+- session.py: worker-side report/checkpoint API
+- worker_group.py / backend_executor.py: gang actors + jax.distributed wiring
+- trainer.py: JaxTrainer.fit with gang restart from checkpoints
+- checkpoint.py: sharded multi-process checkpoint save/restore + retention
+
+Reference: python/ray/train (base_trainer.py:555 fit,
+data_parallel_trainer.py:58, _internal/session.py:423 report,
+_internal/backend_executor.py:44).
 """
 
 from ray_tpu.train.step import (  # noqa: F401
@@ -9,3 +17,16 @@ from ray_tpu.train.step import (  # noqa: F401
     init_train_state,
     batch_sharding,
 )
+from ray_tpu.train.checkpoint import (  # noqa: F401
+    Checkpoint,
+    CheckpointManager,
+    save_state,
+    restore_state,
+)
+from ray_tpu.train.trainer import (  # noqa: F401
+    JaxTrainer,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train import session  # noqa: F401

@@ -1,0 +1,190 @@
+"""Gang lifecycle for distributed JAX training.
+
+Reference: `python/ray/train/_internal/backend_executor.py:44`
+(`BackendExecutor`: `start:103`, `_create_placement_group:163`,
+`_create_rank_world_size_mappings:271`, `start_training:341`,
+`get_with_failure_handling:557`). TPU-native backend: instead of a torch
+process group, every worker joins one **jax.distributed** cluster, so a
+single pjit/shard_map program spans all workers' devices — the mesh IS the
+communication backend (SURVEY §2.7/§2.8 mapping). Coordinator address is
+published through the control-plane KV, mirroring the reference's
+`_setup_torch_process_group` TCP-store rendezvous off worker 0.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable
+
+import ray_tpu
+from ray_tpu.train.worker_group import WorkerGroup
+
+logger = logging.getLogger(__name__)
+
+KV_NS = "train"
+
+
+# ---- functions shipped to workers (module-level → plain cloudpickle) ----
+
+
+def _pick_coordinator(worker) -> str:
+    """Run on worker 0: bind a free port on this host for jax.distributed."""
+    import socket
+
+    from ray_tpu._private.api import _get_worker
+
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    host = _get_worker().addr
+    return f"{host}:{port}"
+
+
+def _setup_backend(worker, coordinator: str, world_size: int,
+                   devices_per_worker: int | None, platform: str | None):
+    """Join the jax.distributed cluster (rank = worker_idx).
+
+    Env must be set before jax touches a backend in this (fresh actor)
+    process; the sitecustomize hook forces `axon,cpu`, so the platform is
+    re-asserted via jax.config too."""
+    import os
+
+    if platform == "cpu" and devices_per_worker:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{devices_per_worker}"
+        ).strip()
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=world_size,
+        process_id=worker.worker_idx,
+    )
+    worker.state["world_size"] = world_size
+    return {
+        "rank": jax.process_index(),
+        "local_devices": jax.local_device_count(),
+        "global_devices": jax.device_count(),
+    }
+
+
+def _start_training(worker, fn_blob, config: dict,
+                    resume_ckpt_path: str | None):
+    """Launch the user train loop on a thread (session.py:144 analog)."""
+    import threading
+
+    from ray_tpu._private import serialization
+    from ray_tpu.train import session as S
+    from ray_tpu.train.checkpoint import Checkpoint
+
+    fn = serialization.unpack_payload(fn_blob)
+    sess = S._init_session(
+        world_rank=worker.worker_idx,
+        world_size=worker.state.get("world_size", 1),
+        resume_checkpoint=(
+            Checkpoint(resume_ckpt_path) if resume_ckpt_path else None
+        ),
+    )
+
+    def _run():
+        try:
+            fn(config or {})
+        except BaseException as e:  # noqa: BLE001 — surfaced to the driver
+            sess.error = e
+        finally:
+            sess.finished.set()
+
+    t = threading.Thread(target=_run, daemon=True, name="train_loop")
+    worker.state["train_thread"] = t
+    t.start()
+    return True
+
+
+def _next_result(worker, timeout: float = 10.0):
+    """Poll one report from the session queue (get_next_results analog)."""
+    import queue as _q
+
+    from ray_tpu.train import session as S
+
+    sess = S._get_session()
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            item = sess.results.get(timeout=0.1)
+            return {"type": "report", **item}
+        except _q.Empty:
+            if sess.finished.is_set() and sess.results.empty():
+                if sess.error is not None:
+                    import traceback
+
+                    tb = "".join(traceback.format_exception(sess.error))
+                    return {"type": "error", "error": tb}
+                return {"type": "finished"}
+            if time.monotonic() > deadline:
+                return {"type": "pending"}
+
+
+class TrainingFailedError(RuntimeError):
+    pass
+
+
+class BackendExecutor:
+    """Start a worker gang, wire the jax.distributed backend, stream
+    results; the trainer drives restarts."""
+
+    def __init__(self, num_workers: int,
+                 resources_per_worker: dict | None = None,
+                 devices_per_worker: int | None = None,
+                 platform: str | None = None,
+                 strategy: str = "SPREAD"):
+        self.num_workers = num_workers
+        self.resources_per_worker = resources_per_worker
+        self.devices_per_worker = devices_per_worker
+        self.platform = platform
+        self.strategy = strategy
+        self.worker_group: WorkerGroup | None = None
+
+    def start(self):
+        self.worker_group = WorkerGroup(
+            self.num_workers,
+            resources_per_worker=self.resources_per_worker,
+            strategy=self.strategy,
+        )
+        coordinator = self.worker_group.execute_single(0, _pick_coordinator)
+        infos = self.worker_group.execute(
+            _setup_backend, coordinator, self.num_workers,
+            self.devices_per_worker, self.platform,
+        )
+        logger.info("train backend up: %s", infos)
+        return infos
+
+    def start_training(self, train_fn: Callable, config: dict,
+                       resume_ckpt_path: str | None = None):
+        from ray_tpu._private import serialization
+
+        blob = serialization.pack_callable(train_fn)
+        ray_tpu.get(
+            self.worker_group.execute_async(
+                _start_training, blob, config, resume_ckpt_path
+            ),
+            timeout=300,
+        )
+
+    def next_results(self, timeout: float = 10.0) -> list[dict]:
+        """One lockstep round of per-worker results."""
+        return ray_tpu.get(
+            self.worker_group.execute_async(_next_result, timeout),
+            timeout=timeout + 60,
+        )
+
+    def shutdown(self):
+        if self.worker_group is not None:
+            self.worker_group.shutdown()
+            self.worker_group = None

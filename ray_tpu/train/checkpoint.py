@@ -1,0 +1,247 @@
+"""Checkpointing for (possibly sharded, multi-process) train state.
+
+Reference: `python/ray/air/checkpoint.py:66` (dir/dict Checkpoint),
+`train/_internal/checkpoint.py` + `air/_internal/checkpoint_manager.py`
+(retention/ranking). TPU-native twist: state pytrees hold `jax.Array`s that
+may be sharded across a multi-process mesh, so saving is a collective —
+every process writes exactly the shards it owns, and restore reassembles
+global arrays on the (identical) mesh of the restoring run.
+
+Format (one directory per checkpoint):
+    meta.msgpack             tree structure, leaf shapes/dtypes/sharding
+    shards_p{k}.npz          process k's addressable shards
+    user.pkl                 non-array user payload (cloudpickle)
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import tempfile
+from typing import Any
+
+import numpy as np
+
+
+class Checkpoint:
+    """A directory-backed checkpoint handle (air/checkpoint.py:66 analog)."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+    def __reduce__(self):
+        return (Checkpoint, (self.path,))
+
+    # -- dict-style payload (small, unsharded; e.g. step counters) --
+    @classmethod
+    def from_dict(cls, data: dict, path: str | None = None) -> "Checkpoint":
+        path = path or tempfile.mkdtemp(prefix="ray_tpu_ckpt_")
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "user.pkl"), "wb") as f:
+            pickle.dump(data, f)
+        return cls(path)
+
+    def to_dict(self) -> dict:
+        with open(os.path.join(self.path, "user.pkl"), "rb") as f:
+            return pickle.load(f)
+
+
+def _leaf_meta(leaf) -> dict:
+    import jax
+
+    if isinstance(leaf, jax.Array):
+        return {
+            "kind": "array",
+            "shape": list(leaf.shape),
+            "dtype": str(leaf.dtype),
+            "spec": _spec_of(leaf),
+        }
+    return {"kind": "py"}
+
+
+def _spec_of(arr) -> list:
+    from jax.sharding import NamedSharding
+
+    sh = arr.sharding
+    if isinstance(sh, NamedSharding):
+        return [list(p) if isinstance(p, tuple) else p for p in sh.spec]
+    return []
+
+
+def save_state(state: Any, path: str, *, process_index: int | None = None,
+               extra: dict | None = None) -> Checkpoint:
+    """Collective save: every process calls this with the same `state`
+    pytree and the same `path`; each writes only its addressable shards."""
+    import jax
+    import msgpack
+    from jax.tree_util import tree_flatten
+
+    pid = jax.process_index() if process_index is None else process_index
+    os.makedirs(path, exist_ok=True)
+    leaves, treedef = tree_flatten(state)
+
+    shards = {}
+    for i, leaf in enumerate(leaves):
+        if not isinstance(leaf, jax.Array):
+            continue
+        for s in leaf.addressable_shards:
+            if s.replica_id == 0:  # one writer per distinct shard
+                key = f"{i}/" + ",".join(
+                    f"{sl.start or 0}:{sl.stop if sl.stop is not None else -1}"
+                    for sl in s.index
+                )
+                shards[key] = np.asarray(s.data)
+    np.savez(os.path.join(path, f"shards_p{pid}.npz"), **shards)
+
+    if pid == 0:
+        meta = {
+            "leaves": [_leaf_meta(leaf) for leaf in leaves],
+            "n_leaves": len(leaves),
+        }
+        with open(os.path.join(path, "meta.msgpack"), "wb") as f:
+            f.write(msgpack.packb(meta))
+        with open(os.path.join(path, "treedef.pkl"), "wb") as f:
+            pickle.dump(
+                (treedef,
+                 [leaf if not _is_jax_array(leaf) else None
+                  for leaf in leaves]),
+                f,
+            )
+        if extra is not None:
+            with open(os.path.join(path, "user.pkl"), "wb") as f:
+                pickle.dump(extra, f)
+    return Checkpoint(path)
+
+
+def _is_jax_array(x) -> bool:
+    import jax
+
+    return isinstance(x, jax.Array)
+
+
+def restore_state(path: str, mesh=None, shardings=None) -> Any:
+    """Collective restore on an identical mesh layout.
+
+    `shardings`: optional pytree of NamedSharding matching the saved state;
+    if omitted, leaves are restored with the sharding spec recorded at save
+    time on `mesh`."""
+    import jax
+    import msgpack
+    from jax.sharding import NamedSharding, PartitionSpec
+    from jax.tree_util import tree_flatten, tree_unflatten
+
+    with open(os.path.join(path, "meta.msgpack"), "rb") as f:
+        meta = msgpack.unpackb(f.read())
+    with open(os.path.join(path, "treedef.pkl"), "rb") as f:
+        treedef, py_leaves = pickle.load(f)
+
+    # Load every process's shard file (shared filesystem assumption, same as
+    # the reference's NFS/cloud checkpoint dirs).
+    shard_files = sorted(
+        fn for fn in os.listdir(path) if fn.startswith("shards_p")
+    )
+    by_leaf: dict[int, dict[tuple, np.ndarray]] = {}
+    for fn in shard_files:
+        with np.load(os.path.join(path, fn)) as z:
+            for key in z.files:
+                leaf_i, _, idx = key.partition("/")
+                by_leaf.setdefault(int(leaf_i), {})[idx] = z[key]
+
+    if shardings is not None:
+        # Keep None placeholders for non-array leaves so indices align with
+        # the saved all-leaves order.
+        flat_sh, _ = tree_flatten(
+            shardings,
+            is_leaf=lambda x: x is None or isinstance(x, NamedSharding),
+        )
+        if len(flat_sh) != len(meta["leaves"]):
+            raise ValueError(
+                f"shardings tree has {len(flat_sh)} leaves; checkpoint has "
+                f"{len(meta['leaves'])}"
+            )
+    else:
+        flat_sh = None
+
+    leaves = []
+    for i, lm in enumerate(meta["leaves"]):
+        if lm["kind"] != "array":
+            leaves.append(py_leaves[i])
+            continue
+        shape = tuple(lm["shape"])
+        dtype = np.dtype(lm["dtype"])
+        if flat_sh is not None and flat_sh[i] is not None:
+            sharding = flat_sh[i]
+        else:
+            spec = PartitionSpec(*[
+                tuple(p) if isinstance(p, list) else p for p in lm["spec"]
+            ])
+            sharding = NamedSharding(mesh, spec)
+        full = _assemble(shape, dtype, by_leaf.get(i, {}))
+        leaves.append(jax.device_put(full, sharding))
+    return tree_unflatten(treedef, leaves)
+
+
+def _assemble(shape, dtype, shards: dict) -> np.ndarray:
+    full = np.zeros(shape, dtype=dtype)
+    for idx_key, data in shards.items():
+        if not idx_key:
+            return data.astype(dtype, copy=False)
+        slices = []
+        for part in idx_key.split(","):
+            a, _, b = part.partition(":")
+            stop = None if b == "-1" else int(b)
+            slices.append(slice(int(a), stop))
+        full[tuple(slices)] = data
+    return full
+
+
+class CheckpointManager:
+    """Retention + ranking (air/_internal/checkpoint_manager.py analog)."""
+
+    def __init__(self, root: str, num_to_keep: int = 2,
+                 score_attr: str | None = None, score_order: str = "max"):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self.num_to_keep = num_to_keep
+        self.score_attr = score_attr
+        self.score_order = score_order
+        # (score, seq, path): seq is registration order, the tiebreaker for
+        # `best` and the sole key for `latest` (paths are not assumed to
+        # sort chronologically).
+        self._registered: list[tuple[float, int, str]] = []
+        self._seq = 0
+
+    def next_dir(self) -> str:
+        return os.path.join(
+            self.root, f"checkpoint_{self._seq + 1:06d}"
+        )
+
+    def register(self, ckpt: Checkpoint, metrics: dict | None = None):
+        self._seq += 1
+        score = float(self._seq)
+        if self.score_attr and metrics and self.score_attr in metrics:
+            score = float(metrics[self.score_attr])
+            if self.score_order == "min":
+                score = -score
+        self._registered.append((score, self._seq, ckpt.path))
+        self._registered.sort()
+        while len(self._registered) > self.num_to_keep:
+            _, _, worst = self._registered.pop(0)
+            shutil.rmtree(worst, ignore_errors=True)
+
+    @property
+    def best(self) -> Checkpoint | None:
+        if not self._registered:
+            return None
+        return Checkpoint(self._registered[-1][2])
+
+    @property
+    def latest(self) -> Checkpoint | None:
+        if not self._registered:
+            return None
+        path = max(self._registered, key=lambda t: t[1])[2]
+        return Checkpoint(path)

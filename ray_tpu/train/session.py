@@ -1,0 +1,82 @@
+"""Per-worker training session.
+
+Reference: `python/ray/train/_internal/session.py` (`_TrainSession:73`,
+`report:423`): the user's train loop runs on a thread inside the training
+worker; `report(metrics, checkpoint=...)` hands results to a bounded queue
+that the driver drains one step at a time, keeping workers in lockstep at
+report boundaries.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+@dataclass
+class _Session:
+    world_rank: int
+    world_size: int
+    local_rank: int = 0
+    experiment_dir: str | None = None
+    resume_checkpoint: Any = None  # Checkpoint | None
+    # queue(1): the user thread blocks in report() until the driver consumed
+    # the previous result — the reference's backpressure behavior.
+    results: "queue.Queue[Any]" = field(
+        default_factory=lambda: queue.Queue(maxsize=1)
+    )
+    finished: threading.Event = field(default_factory=threading.Event)
+    error: BaseException | None = None
+
+
+_session: _Session | None = None
+_lock = threading.Lock()
+
+
+def _init_session(**kwargs) -> _Session:
+    global _session
+    with _lock:
+        _session = _Session(**kwargs)
+        return _session
+
+
+def _get_session() -> _Session:
+    if _session is None:
+        raise RuntimeError(
+            "ray_tpu.train.session used outside a training worker"
+        )
+    return _session
+
+
+def _shutdown_session():
+    global _session
+    with _lock:
+        _session = None
+
+
+def report(metrics: dict, checkpoint=None) -> None:
+    """Report metrics (and optionally a checkpoint) to the driver.
+
+    Blocks until the driver has consumed the previous report (reference
+    session.py:423 + result_queue(1))."""
+    s = _get_session()
+    s.results.put({"metrics": dict(metrics), "checkpoint": checkpoint})
+
+
+def get_checkpoint():
+    """The checkpoint to resume from, if the run was restored."""
+    return _get_session().resume_checkpoint
+
+
+def get_world_rank() -> int:
+    return _get_session().world_rank
+
+
+def get_world_size() -> int:
+    return _get_session().world_size
+
+
+def get_local_rank() -> int:
+    return _get_session().local_rank

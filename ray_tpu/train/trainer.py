@@ -1,0 +1,173 @@
+"""JaxTrainer: the user-facing training service.
+
+Reference: `python/ray/train/base_trainer.py:555` (`fit`),
+`data_parallel_trainer.py:58` (`DataParallelTrainer`), failure handling
+`backend_executor.py:557/:618` (gang restart up to `max_failures`, resuming
+from the latest checkpoint). TPU-native: the "backend" is one
+jax.distributed cluster per run (see backend_executor.py); DP/FSDP/TP/SP
+strategies are mesh-axis configuration inside the user loop, not separate
+trainer subclasses.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from ray_tpu._private.worker import RayActorError, GetTimeoutError
+from ray_tpu.train.backend_executor import (
+    BackendExecutor,
+    TrainingFailedError,
+)
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class ScalingConfig:
+    """Reference: air/config.py ScalingConfig."""
+
+    num_workers: int = 1
+    resources_per_worker: dict = field(default_factory=lambda: {"CPU": 1})
+    devices_per_worker: int | None = None  # virtual CPU devices (tests)
+    platform: str | None = None  # "cpu" | "tpu" | None = autodetect
+    placement_strategy: str = "SPREAD"
+
+
+@dataclass
+class RunConfig:
+    """Reference: air/config.py RunConfig + FailureConfig."""
+
+    name: str = "train_run"
+    storage_path: str | None = None
+    max_failures: int = 0
+    checkpoint_num_to_keep: int = 2
+
+
+@dataclass
+class Result:
+    """Reference: air/result.py Result."""
+
+    metrics: dict | None
+    checkpoint: Checkpoint | None
+    metrics_history: list[dict]
+    error: str | None = None
+
+
+class JaxTrainer:
+    """Gang-scheduled SPMD training over a jax.distributed mesh.
+
+    `train_loop_per_worker(config)` runs identically on every worker
+    (single-program multi-host, the JAX model); it reports via
+    `ray_tpu.train.session.report(metrics, checkpoint=...)`.
+    """
+
+    def __init__(self, train_loop_per_worker: Callable[[dict], Any],
+                 *, train_loop_config: dict | None = None,
+                 scaling_config: ScalingConfig | None = None,
+                 run_config: RunConfig | None = None,
+                 resume_from_checkpoint: Checkpoint | None = None):
+        self.train_fn = train_loop_per_worker
+        self.config = train_loop_config or {}
+        self.scaling = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.resume_from_checkpoint = resume_from_checkpoint
+
+    def fit(self) -> Result:
+        """Reference base_trainer.py:555: run to completion, restarting the
+        whole gang on worker failure up to max_failures."""
+        storage = self.run_config.storage_path or tempfile.mkdtemp(
+            prefix=f"ray_tpu_{self.run_config.name}_"
+        )
+        ckpt_mgr = CheckpointManager(
+            os.path.join(storage, "checkpoints"),
+            num_to_keep=self.run_config.checkpoint_num_to_keep,
+        )
+        failures_left = self.run_config.max_failures
+        resume = self.resume_from_checkpoint
+        history: list[dict] = []
+
+        while True:
+            executor = BackendExecutor(
+                self.scaling.num_workers,
+                resources_per_worker=self.scaling.resources_per_worker,
+                devices_per_worker=self.scaling.devices_per_worker,
+                platform=self.scaling.platform,
+                strategy=self.scaling.placement_strategy,
+            )
+            try:
+                executor.start()
+                executor.start_training(
+                    self.train_fn, self.config,
+                    resume_ckpt_path=resume.path if resume else None,
+                )
+                final = self._drain(executor, ckpt_mgr, history)
+                executor.shutdown()
+                return Result(
+                    metrics=final, checkpoint=ckpt_mgr.latest,
+                    metrics_history=history,
+                )
+            except (RayActorError, GetTimeoutError, RuntimeError) as e:
+                if isinstance(e, TrainingFailedError):
+                    executor.shutdown()
+                    raise
+                executor.shutdown()
+                if failures_left <= 0:
+                    return Result(
+                        metrics=history[-1] if history else None,
+                        checkpoint=ckpt_mgr.latest,
+                        metrics_history=history,
+                        error=f"training failed: {e}",
+                    )
+                failures_left -= 1
+                resume = ckpt_mgr.latest or resume
+                logger.warning(
+                    "worker gang failed (%s); restarting (%d retries left) "
+                    "from %s", e, failures_left, resume,
+                )
+
+    def _drain(self, executor: BackendExecutor, ckpt_mgr: CheckpointManager,
+               history: list[dict]) -> dict | None:
+        """Lockstep result loop (reference TrainingIterator semantics).
+
+        Reports are buffered per rank; one training step is recorded only
+        once every rank has reported it, with rank 0's metrics as the
+        authoritative copy — a slow worker can't cause duplicate or
+        out-of-rank history entries."""
+        from collections import deque
+
+        n = executor.num_workers
+        pending = [deque() for _ in range(n)]
+        finished = [False] * n
+        final = None
+        while True:
+            rounds = executor.next_results(timeout=15.0)
+            for rank, res in enumerate(rounds):
+                if res["type"] == "error":
+                    raise TrainingFailedError(res["error"])
+                if res["type"] == "finished":
+                    finished[rank] = True
+                elif res["type"] == "report":
+                    pending[rank].append(res)
+            while all(pending):
+                step_reports = [q.popleft() for q in pending]
+                metrics = step_reports[0]["metrics"]  # true rank 0
+                history.append(metrics)
+                final = metrics
+                ckpt = next(
+                    (r.get("checkpoint") for r in step_reports
+                     if r.get("checkpoint") is not None), None,
+                )
+                if ckpt is not None:
+                    ckpt_mgr.register(ckpt, metrics)
+            if all(finished):
+                if any(pending):
+                    raise TrainingFailedError(
+                        "workers reported unequal numbers of results: "
+                        f"{[len(q) for q in pending]} undrained per rank"
+                    )
+                return final

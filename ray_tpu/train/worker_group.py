@@ -1,0 +1,108 @@
+"""Generic gang of worker actors.
+
+Reference: `python/ray/train/_internal/worker_group.py:100` (`WorkerGroup`,
+`RayTrainWorker:18`): N actors created in one placement group, execute
+arbitrary functions on all/any worker, torn down as a unit.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable
+
+import ray_tpu
+
+logger = logging.getLogger(__name__)
+
+
+@ray_tpu.remote
+class TrainWorker:
+    """Host process for training functions (RayTrainWorker analog).
+
+    Generic: `execute` runs any pickled callable in the worker, so backend
+    setup (jax.distributed init), the user train loop, and checkpoint ops
+    all ride the same actor."""
+
+    def __init__(self, worker_idx: int):
+        self.worker_idx = worker_idx
+        self.state: dict[str, Any] = {}
+
+    def execute(self, fn: Callable, *args, **kwargs):
+        return fn(self, *args, **kwargs)
+
+    def ping(self):
+        return self.worker_idx
+
+    def node_id(self):
+        import os
+
+        return os.environ.get("RAY_TPU_NODE_ID", "")
+
+
+class WorkerGroup:
+    """N TrainWorker actors gang-scheduled via one placement group."""
+
+    def __init__(self, num_workers: int,
+                 resources_per_worker: dict | None = None,
+                 strategy: str = "SPREAD",
+                 max_restarts: int = 0):
+        self.num_workers = num_workers
+        self.resources = dict(resources_per_worker or {"CPU": 1})
+        self.pg = ray_tpu.placement_group(
+            [dict(self.resources) for _ in range(num_workers)],
+            strategy=strategy,
+        )
+        if not self.pg.ready(timeout=60):
+            raise RuntimeError(
+                f"placement group for {num_workers} train workers "
+                f"({self.resources} each, {strategy}) not placeable"
+            )
+        custom = {r: v for r, v in self.resources.items()
+                  if r not in ("CPU", "TPU")}
+        opts = {
+            "placement_group": self.pg,
+            "num_cpus": self.resources.get("CPU", 0),
+            "num_tpus": self.resources.get("TPU", 0),
+            "resources": custom,
+            "max_restarts": max_restarts,
+        }
+        self.workers = [
+            TrainWorker.options(
+                **opts, placement_group_bundle_index=i
+            ).remote(i)
+            for i in range(num_workers)
+        ]
+        # fail fast if any worker can't start
+        ray_tpu.get([w.ping.remote() for w in self.workers], timeout=120)
+
+    def execute(self, fn: Callable, *args, **kwargs) -> list:
+        """Run fn on every worker, return all results (ordered by rank)."""
+        return ray_tpu.get(
+            self.execute_async(fn, *args, **kwargs), timeout=600
+        )
+
+    def execute_async(self, fn: Callable, *args, **kwargs) -> list:
+        return [w.execute.remote(fn, *args, **kwargs) for w in self.workers]
+
+    def execute_single(self, idx: int, fn: Callable, *args, **kwargs):
+        return ray_tpu.get(
+            self.workers[idx].execute.remote(fn, *args, **kwargs),
+            timeout=600,
+        )
+
+    def node_ids(self) -> list[str]:
+        return ray_tpu.get(
+            [w.node_id.remote() for w in self.workers], timeout=60
+        )
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                pass
+        try:
+            ray_tpu.remove_placement_group(self.pg)
+        except Exception:  # noqa: BLE001
+            pass
+        self.workers = []

@@ -74,7 +74,9 @@ def test_placement_group_pack(cluster3):
 def test_node_death_actor_restarts_elsewhere(cluster3):
     victim = cluster3.agents[-1]
 
-    @ray_tpu.remote(num_cpus=2)
+    # 1-CPU actors on 2-CPU nodes: after a node dies, the survivors still
+    # have spare capacity so the restart is actually placeable.
+    @ray_tpu.remote(num_cpus=1)
     class Pinned:
         def node(self):
             import os
@@ -115,3 +117,83 @@ def test_node_death_task_retries(cluster3):
     cluster3.remove_node(cluster3.agents[-1])
     got = ray_tpu.get(refs, timeout=120)
     assert len(got) == 3  # all completed despite the node loss
+
+
+def test_pg_actor_uses_bundle_resources(cluster3):
+    """An actor whose bundle reserves the whole node must still schedule:
+    PG actors draw from the committed bundle, not the depleted node pool
+    (advisor round-1 high finding)."""
+    pg = ray_tpu.placement_group([{"CPU": 2}], strategy="STRICT_PACK")
+    assert pg.ready(timeout=30)
+
+    @ray_tpu.remote(num_cpus=2)
+    class Big:
+        def ping(self):
+            return "pong"
+
+    a = Big.options(
+        placement_group=pg, placement_group_bundle_index=0
+    ).remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+    ray_tpu.kill(a)
+    ray_tpu.remove_placement_group(pg)
+
+
+def test_node_death_fails_queued_tasks(cluster3):
+    """Tasks queued/running on a dying node are failed/retried via the
+    owner's task_located + node_dead tracking, not lost until timeout
+    (advisor round-1 high finding)."""
+
+    victim = cluster3.agents[-1]
+
+    @ray_tpu.remote(num_cpus=1, max_retries=0)
+    def stuck():
+        import time as _t
+
+        _t.sleep(300)  # far longer than the test; must be failed, not joined
+        return "done"
+
+    # Pin both a running and a queued task onto the victim node.
+    pin = {"node_id": victim.node_id}
+    refs = [stuck.options(scheduling_strategy=pin).remote()
+            for _ in range(3)]
+    time.sleep(1.0)  # let tasks land on the agent
+    cluster3.remove_node(victim)
+    # Every pinned ref must fail fast with the node-death reason; none may
+    # take the full sleep.
+    for r in refs:
+        with pytest.raises(ray_tpu.RayTaskError, match="node died"):
+            ray_tpu.get(r, timeout=30)
+
+
+def test_chaos_random_node_kill(cluster3):
+    """NodeKiller-style chaos (reference test_utils.py:1367): kill a random
+    non-head agent under task+actor load; cluster must stay usable."""
+    import random
+
+    @ray_tpu.remote(num_cpus=1, max_retries=5)
+    def work(i):
+        import time as _t
+
+        _t.sleep(0.1)
+        return i
+
+    @ray_tpu.remote(num_cpus=1)
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.options(max_restarts=5).remote()
+    refs = [work.remote(i) for i in range(12)]
+    victim = random.choice(cluster3.agents)
+    cluster3.remove_node(victim)
+    # tasks with retries finish; the cluster still schedules new work
+    got = ray_tpu.get(refs, timeout=120)
+    assert sorted(got) == list(range(12))
+    assert ray_tpu.get(c.bump.remote(), timeout=60) >= 1
+    more = ray_tpu.get([work.remote(i) for i in range(5)], timeout=120)
+    assert sorted(more) == list(range(5))
