@@ -36,6 +36,7 @@ _RUNTIME_API = (
     "remove_placement_group",
     "PlacementGroup",
     "ObjectRef",
+    "ObjectRefGenerator",
     "ActorHandle",
     "RayTaskError",
     "RayActorError",

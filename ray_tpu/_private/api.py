@@ -51,12 +51,27 @@ def _get_worker() -> CoreWorker:
 class ObjectRef:
     """Reference to a (possibly pending) object. Reference: ObjectRef in
     _raylet.pyx; serializing a ref inside task args registers it as a
-    dependency via serialization.note_object_ref."""
+    dependency via serialization.note_object_ref.
 
-    __slots__ = ("_id",)
+    Each live ObjectRef counts one local reference in this process's
+    CoreWorker (reference_count.h:102 AddLocalReference analog); the count
+    transitions 0↔1 are reported to the control-plane directory, which
+    frees cluster-wide copies when no process holds a reference
+    (centralized redesign of the owner/borrower protocol — the directory
+    already is the single source of object locations)."""
+
+    __slots__ = ("_id", "_counted")
 
     def __init__(self, id_bytes: bytes):
         self._id = id_bytes
+        self._counted = False
+        w = _worker
+        if w is not None:
+            try:
+                w.add_local_ref(id_bytes)
+                self._counted = True
+            except Exception:  # noqa: BLE001 — refcounting is best-effort
+                pass
 
     def binary(self) -> bytes:
         return self._id
@@ -76,6 +91,15 @@ class ObjectRef:
     def __reduce__(self):
         serialization.note_object_ref(_RefProxy(self._id))
         return (ObjectRef, (self._id,))
+
+    def __del__(self):
+        if getattr(self, "_counted", False):
+            w = _worker
+            if w is not None:
+                try:
+                    w.remove_local_ref(self._id)
+                except Exception:  # noqa: BLE001 — interpreter teardown
+                    pass
 
 
 class _RefProxy:
@@ -287,7 +311,7 @@ class RemoteFunction:
             name=o.get("name", self.__name__), **pg_kw,
         )
         refs = [ObjectRef(i) for i in ids]
-        return refs[0] if o["num_returns"] == 1 else refs
+        return refs[0] if o["num_returns"] in (1, "dynamic") else refs
 
     def __call__(self, *a, **kw):
         raise TypeError(
@@ -454,12 +478,43 @@ def put(value) -> ObjectRef:
     return ObjectRef(_get_worker().put(value))
 
 
+class ObjectRefGenerator:
+    """Result of getting a num_returns="dynamic" task's ref: an iterable of
+    the per-item ObjectRefs (reference _raylet.pyx:186)."""
+
+    def __init__(self, refs: list[ObjectRef]):
+        self._refs = refs
+
+    def __iter__(self):
+        return iter(self._refs)
+
+    def __len__(self):
+        return len(self._refs)
+
+    def __getitem__(self, i):
+        return self._refs[i]
+
+    def __repr__(self):
+        return f"ObjectRefGenerator({len(self._refs)} refs)"
+
+
+def _wrap_dynamic(value):
+    from ray_tpu._private.worker import DynamicReturns
+
+    if isinstance(value, DynamicReturns):
+        return ObjectRefGenerator([ObjectRef(i) for i in value.object_ids])
+    return value
+
+
 def get(refs, *, timeout: float | None = None):
     w = _get_worker()
     single = isinstance(refs, ObjectRef)
     if single:
         refs = [refs]
-    values = w.get([r.binary() for r in refs], timeout=timeout)
+    values = [
+        _wrap_dynamic(v)
+        for v in w.get([r.binary() for r in refs], timeout=timeout)
+    ]
     return values[0] if single else values
 
 

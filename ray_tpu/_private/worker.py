@@ -7,12 +7,18 @@ per-actor ordered submission queues, retries, and the worker's own RPC
 server (results are pushed owner-directly, as in the reference's
 direct task/actor transports, `transport/direct_task_transport.h:75`).
 
-Ownership model (reference reference_count.h:61, simplified): the worker
-that creates a ref (task submission or put) is its owner; small values live
-in the owner's memory store and are served to borrowers via the owner's RPC;
-large values live in the node shm store with locations tracked by the
-control-plane directory. Full borrower-count GC is future work — objects are
-freed on owner ref-drop or job end.
+Ownership model (reference reference_count.h:61, redesigned around the
+centralized directory): the worker that creates a ref (task submission or
+put) is its owner; small values live in the owner's memory store and are
+served to borrowers via the owner's RPC; large values live in the node shm
+store with locations tracked by the control-plane directory. Distributed GC:
+every process counts its live ObjectRefs plus submitted-task pins and
+reports 0<->1 transitions to the directory, which deletes all cluster
+copies when the last reference anywhere drops (borrowers are just other
+processes' counts — no owner long-poll protocol needed when the directory
+is the single source of truth). Lost objects whose producing TaskSpec is
+known are lineage-reconstructed by resubmitting the task
+(object_recovery_manager.h:90).
 """
 
 from __future__ import annotations
@@ -62,10 +68,25 @@ class GetTimeoutError(Exception):
     pass
 
 
+class DynamicReturns:
+    """Descriptor value of a num_returns="dynamic" task's 0th return: the
+    ids of the objects the generator produced (reference
+    _raylet.pyx:186 ObjectRefGenerator's backing list)."""
+
+    __slots__ = ("object_ids",)
+
+    def __init__(self, object_ids: list[bytes]):
+        self.object_ids = object_ids
+
+    def __reduce__(self):
+        return (DynamicReturns, (self.object_ids,))
+
+
 class _ResultEntry:
     """One object's owner-side state."""
 
-    __slots__ = ("event", "payload", "error", "in_plasma", "size", "spec")
+    __slots__ = ("event", "payload", "error", "in_plasma", "size", "spec",
+                 "reconstructing", "escaped")
 
     def __init__(self):
         self.event = threading.Event()
@@ -74,6 +95,10 @@ class _ResultEntry:
         self.in_plasma = False
         self.size = 0
         self.spec = None        # producing TaskSpec (lineage / retries)
+        self.reconstructing = False  # a lineage resubmit is in flight
+        # the ref left this process (task arg, nested in a stored value):
+        # the owner-side entry must outlive the local refcount
+        self.escaped = False
 
     @property
     def ready(self):
@@ -126,6 +151,14 @@ class CoreWorker:
         self._task_nodes: dict[bytes, bytes] = {}
         self.head.on_push("node_dead", self._on_node_dead)
         self.head.call("subscribe", {"channel": "node_dead"})
+        # Reference counting (reference_count.h:61 semantics, centralized):
+        # per-oid local count; 0<->1 transitions reported to the directory,
+        # which frees cluster copies when no process holds a reference.
+        self._local_refs: dict[bytes, int] = {}
+        self._refs_lock = threading.Lock()
+        # task_id -> dep oids pinned for the task's lifetime (submitted-task
+        # references, reference_count.h:115)
+        self._task_pins: dict[bytes, list[bytes]] = {}
 
     # ------------- helpers -------------
 
@@ -169,8 +202,18 @@ class CoreWorker:
         """An executor finished a task we own (or serves a borrowed get)."""
         if p.get("task_id"):
             self._task_nodes.pop(p["task_id"], None)
+            self._release_task_pins(p["task_id"])
         oid = p["object_id"]
+        if p.get("dynamic_items"):
+            # generator items live as long as their descriptor object
+            try:
+                self.head.fire("object_nested", {
+                    "outer": oid, "inners": p["dynamic_items"],
+                })
+            except (rpc.ConnectionLost, rpc.RpcError, OSError):
+                pass
         e = self._entry(oid)
+        e.reconstructing = False
         if p.get("error") is not None:
             e.error = p["error"]
         elif p.get("in_plasma"):
@@ -200,9 +243,12 @@ class CoreWorker:
         if spec is None:
             return
         # Already completed (e.g. node died after pushing results): no-op.
+        n_ret = spec.get("num_returns", 1)
+        if n_ret == "dynamic":
+            n_ret = 1
         return_oids = [
             ObjectID.for_task_return(TaskID(tid), i).binary()
-            for i in range(spec.get("num_returns", 1))
+            for i in range(n_ret)
         ]
         with self._mem_lock:
             if all(
@@ -222,7 +268,11 @@ class CoreWorker:
         err = serialization.pack_payload(
             RayTaskError(f"task failed: {p.get('reason', 'worker died')}")
         )
-        for i in range(spec.get("num_returns", 1)):
+        self._release_task_pins(spec["task_id"])
+        n_ret = spec.get("num_returns", 1)
+        if n_ret == "dynamic":
+            n_ret = 1
+        for i in range(n_ret):
             oid = ObjectID.for_task_return(
                 TaskID(spec["task_id"]), i
             ).binary()
@@ -287,6 +337,55 @@ class CoreWorker:
                 e.event.set()
         pend.clear()
 
+    # ------------- reference counting -------------
+
+    def add_local_ref(self, oid: bytes):
+        with self._refs_lock:
+            n = self._local_refs.get(oid, 0)
+            self._local_refs[oid] = n + 1
+            first = n == 0
+        if first:
+            try:
+                self.head.fire("ref_add", {
+                    "object_id": oid, "worker_id": self.worker_id,
+                })
+            except (rpc.ConnectionLost, rpc.RpcError, OSError):
+                pass
+
+    def remove_local_ref(self, oid: bytes):
+        with self._refs_lock:
+            n = self._local_refs.get(oid, 0) - 1
+            if n <= 0:
+                self._local_refs.pop(oid, None)
+            else:
+                self._local_refs[oid] = n
+            last = n == 0
+        if last:
+            # Reclaim the owner-side entry (inline payload + spec) unless
+            # the ref escaped this process — escaped refs may still be
+            # resolved by borrowers through our RPC endpoint.
+            with self._mem_lock:
+                e = self.memory.get(oid)
+                if e is not None and not e.escaped:
+                    self.memory.pop(oid, None)
+            try:
+                self.head.fire("ref_del", {
+                    "object_id": oid, "worker_id": self.worker_id,
+                })
+            except (rpc.ConnectionLost, rpc.RpcError, OSError):
+                pass
+
+    def _pin_task_deps(self, task_id: bytes, oids: list[bytes]):
+        if not oids:
+            return
+        self._task_pins[task_id] = oids
+        for oid in oids:
+            self.add_local_ref(oid)
+
+    def _release_task_pins(self, task_id: bytes):
+        for oid in self._task_pins.pop(task_id, ()):
+            self.remove_local_ref(oid)
+
     # ------------- function export -------------
 
     def export_function(self, func) -> bytes:
@@ -342,7 +441,21 @@ class CoreWorker:
         oid = ObjectID.for_put(
             WorkerID(self.worker_id), self.put_counter.next()
         ).binary()
-        payload = serialization.pack_payload(value)
+        meta, bufs, nested_refs = serialization.serialize(value)
+        payload = [meta, [bytes(b.raw()) for b in bufs]]
+        if nested_refs:
+            # refs serialized inside this value stay alive as long as the
+            # value does (reference AddNestedObjectIds semantics)
+            inners = []
+            for r in nested_refs:
+                ie = self._entry(r.binary())
+                ie.escaped = True
+                inners.append(r.binary())
+            try:
+                self.head.fire("object_nested",
+                               {"outer": oid, "inners": inners})
+            except (rpc.ConnectionLost, rpc.RpcError, OSError):
+                pass
         size = len(payload[0]) + sum(len(b) for b in payload[1])
         e = self._entry(oid)
         if size <= INLINE_MAX:
@@ -363,11 +476,22 @@ class CoreWorker:
         sizes = [len(meta)] + [len(b) for b in bufs]
         table = struct.pack(f"<I{len(sizes)}Q", len(sizes), *sizes)
         total = sum(sizes)
-        try:
-            wbuf = self.store.create_object(oid, total, len(table))
-        except StoreFullError:
-            self.store.evict(total)
-            wbuf = self.store.create_object(oid, total, len(table))
+        # Under pressure, block briefly for eviction + async GC to free
+        # space (reference create_request_queue.cc admission behavior).
+        deadline = time.monotonic() + 10.0
+        while True:
+            try:
+                wbuf = self.store.create_object(oid, total, len(table))
+                break
+            except StoreFullError:
+                self.store.evict(total)
+                try:
+                    wbuf = self.store.create_object(oid, total, len(table))
+                    break
+                except StoreFullError:
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.05)
         off = 0
         for part in [meta] + list(bufs):
             n = len(part)
@@ -439,15 +563,64 @@ class CoreWorker:
             if not ok:
                 if deadline is not None and time.monotonic() > deadline:
                     raise GetTimeoutError(oid.hex())
-                # owner may still be computing / object lost → keep trying;
-                # lineage reconstruction hook lands here later.
+                # Owner may still be computing, or every copy died with its
+                # node: lineage reconstruction resubmits the producing task
+                # (object_recovery_manager.h:90 RecoverObject semantics).
                 e = self.memory.get(oid)
                 if e is not None and e.spec is not None:
-                    raise ObjectLostError(
-                        f"object {oid.hex()[:12]} lost and reconstruction "
-                        "not yet enabled"
-                    )
+                    self._maybe_reconstruct(oid, e)
                 time.sleep(0.1)
+
+    def _maybe_reconstruct(self, oid: bytes, e: "_ResultEntry") -> bool:
+        """Resubmit the producing task of a lost object (lineage recovery).
+
+        The task keeps its original task_id, so the recomputed result lands
+        on the same return object ids; waiting fetch loops pick up the new
+        location. Idempotent per loss event via the reconstructing flag.
+        Guards against duplicate execution: no resubmit while the producer
+        is still queued/running somewhere, or while a live copy exists
+        (merely-slow transfers are not losses)."""
+        with self._mem_lock:
+            if e.spec is None or e.reconstructing:
+                return e.spec is not None
+            e.reconstructing = True
+        if e.spec["task_id"] in self._task_nodes:
+            # producer still in flight on a live node; its push will land
+            e.reconstructing = False
+            return True
+        try:
+            info = self.head.call("object_locations", {"object_id": oid})
+        except (rpc.ConnectionLost, rpc.RpcError):
+            info = None
+        if info and (info.get("locations") or info.get("spilled")):
+            # a copy exists: the fetch is slow, not lost
+            e.reconstructing = False
+            return True
+        spec = dict(e.spec)
+        logger.warning("reconstructing %s via task %s (%s)",
+                       oid.hex()[:12], spec["task_id"].hex()[:8],
+                       spec.get("name"))
+        try:
+            self.agent.call("submit_task", spec)
+            return True
+        except (rpc.ConnectionLost, rpc.RpcError):
+            e.reconstructing = False
+            return False
+
+    async def rpc_dep_lost(self, conn, p):
+        """An agent could not fetch a task dependency anywhere: if we own
+        the dep's lineage, recompute it (the agent keeps retrying its
+        fetch and dispatches once the new copy appears).
+
+        Runs off-thread: _maybe_reconstruct makes a blocking agent call,
+        which must not run on this (the io-loop) thread."""
+        oid = p["object_id"]
+        e = self.memory.get(oid)
+        if e is not None and e.spec is not None:
+            threading.Thread(
+                target=self._maybe_reconstruct, args=(oid, e), daemon=True
+            ).start()
+        return True
 
     def _try_resolve_remote(self, oid: bytes) -> bool:
         """Resolve a ref we don't own: directory first, then owner."""
@@ -565,12 +738,16 @@ class CoreWorker:
             spec["bundle_nodes"] = bundle_nodes or []
         if scheduling_strategy is not None:
             spec["scheduling_strategy"] = scheduling_strategy
+        n_ret = 1 if num_returns == "dynamic" else num_returns
         return_ids = [
             ObjectID.for_task_return(TaskID(task_id), i).binary()
-            for i in range(num_returns)
+            for i in range(n_ret)
         ]
         for oid in return_ids:
             self._entry(oid).spec = spec
+        # Submitted-task references: args stay pinned until the task
+        # completes or exhausts retries (reference_count.h:115).
+        self._pin_task_deps(task_id, list(deps))
         self.agent.call("submit_task", spec)
         return return_ids
 
@@ -589,6 +766,8 @@ class CoreWorker:
         for ref in refs:
             oid = ref.binary()
             e = self.memory.get(oid)
+            if e is not None:
+                e.escaped = True
             if e is not None and e.ready and not e.in_plasma:
                 if e.error is None:
                     inline_values[oid] = e.payload

@@ -128,9 +128,12 @@ class ControlPlane:
         self.pgs: dict[bytes, dict] = {}
         self.workers: dict[bytes, dict] = {}
         # object directory: oid → {"locations": set[node_id], "owner": addr,
-        #                          "size": int, "spilled": url|None}
+        #                          "size": int, "spilled": url|None,
+        #                          "refs": set[worker_id]}
         self.objects: dict[bytes, dict] = {}
         self.object_waiters: dict[bytes, list[asyncio.Event]] = {}
+        # oids freed by GC; straggler add_location for them deletes the copy
+        self._freed_tombstones: set[bytes] = set()
         self._agent_clients: dict[bytes, rpc.AsyncRpcClient] = {}
         if heartbeat_timeout_s is not None:
             self.HEARTBEAT_TIMEOUT_S = heartbeat_timeout_s
@@ -238,6 +241,7 @@ class ControlPlane:
             "port": p["port"],
             "job_id": p.get("job_id"),
         }
+        conn.state["ref_worker_id"] = p["worker_id"]
         return True
 
     async def rpc_get_worker(self, conn, p):
@@ -662,9 +666,19 @@ class ControlPlane:
     # -- object directory --
     async def rpc_object_add_location(self, conn, p):
         oid = p["object_id"]
+        if oid in self._freed_tombstones:
+            # Freed while the seal/add-location was in flight: delete the
+            # straggler copy instead of resurrecting the entry.
+            agent = await self._agent(p["node_id"])
+            if agent is not None:
+                try:
+                    await agent.call("free_objects", {"object_ids": [oid]})
+                except (rpc.RpcError, rpc.ConnectionLost):
+                    pass
+            return True
         entry = self.objects.setdefault(
             oid, {"locations": set(), "owner": None, "size": 0,
-                  "spilled": None}
+                  "spilled": None, "refs": set()}
         )
         entry["locations"].add(p["node_id"])
         if p.get("owner"):
@@ -720,8 +734,91 @@ class ControlPlane:
         return True
 
     async def rpc_free_object(self, conn, p):
-        self.objects.pop(p["object_id"], None)
+        await self._free_object_cluster(p["object_id"])
         return True
+
+    # -- distributed GC (reference_count.h semantics, centralized) --
+    #
+    # Every worker process reports per-object local-refcount 0<->1
+    # transitions (ObjectRef lifecycle + submitted-task pins). The
+    # directory entry's `refs` set is the cluster-wide reference view;
+    # when it empties, every node copy is deleted and the owner's pin
+    # released. Worker disconnect sweeps its refs (fate-sharing analog).
+
+    async def rpc_ref_add(self, conn, p):
+        entry = self.objects.setdefault(
+            p["object_id"],
+            {"locations": set(), "owner": None, "size": 0, "spilled": None,
+             "refs": set()},
+        )
+        entry.setdefault("refs", set()).add(p["worker_id"])
+        self._freed_tombstones.discard(p["object_id"])
+        return True
+
+    async def rpc_ref_del(self, conn, p):
+        entry = self.objects.get(p["object_id"])
+        if entry is None:
+            return True
+        refs = entry.setdefault("refs", set())
+        refs.discard(p["worker_id"])
+        if not refs:
+            await self._free_object_cluster(p["object_id"])
+        return True
+
+    async def rpc_object_nested(self, conn, p):
+        """`outer` (a stored object) contains serialized refs to `inners`:
+        each inner is referenced by the outer object itself (reference
+        AddNestedObjectIds, reference_count.h:367). The synthetic holder id
+        b"obj:"+outer keeps inners alive until the outer is freed."""
+        outer = p["outer"]
+        entry = self.objects.setdefault(
+            outer, {"locations": set(), "owner": None, "size": 0,
+                    "spilled": None, "refs": set()},
+        )
+        nested = entry.setdefault("nested", [])
+        holder = b"obj:" + outer
+        for inner in p["inners"]:
+            nested.append(inner)
+            ie = self.objects.setdefault(
+                inner, {"locations": set(), "owner": None, "size": 0,
+                        "spilled": None, "refs": set()},
+            )
+            ie.setdefault("refs", set()).add(holder)
+        return True
+
+    async def _free_object_cluster(self, oid: bytes):
+        entry = self.objects.pop(oid, None)
+        self._freed_tombstones.add(oid)
+        if len(self._freed_tombstones) > 100_000:
+            self._freed_tombstones.clear()  # bounded; stale stragglers rare
+        if entry is None:
+            return
+        for node_id in list(entry["locations"]):
+            agent = await self._agent(node_id)
+            if agent is not None:
+                try:
+                    await agent.call("free_objects", {"object_ids": [oid]})
+                except (rpc.RpcError, rpc.ConnectionLost):
+                    pass
+        # cascade: drop this object's hold on anything nested inside it
+        holder = b"obj:" + oid
+        for inner in entry.get("nested", ()):
+            ie = self.objects.get(inner)
+            if ie is None:
+                continue
+            irefs = ie.setdefault("refs", set())
+            irefs.discard(holder)
+            if not irefs:
+                await self._free_object_cluster(inner)
+
+    async def _sweep_worker_refs(self, worker_id: bytes):
+        """A worker process died: drop its references everywhere."""
+        for oid, entry in list(self.objects.items()):
+            refs = entry.get("refs")
+            if refs and worker_id in refs:
+                refs.discard(worker_id)
+                if not refs:
+                    await self._free_object_cluster(oid)
 
     # ---------------- failure detection ----------------
 
@@ -765,6 +862,9 @@ class ControlPlane:
         node_id = conn.state.get("node_id")
         if node_id is not None:
             await self._mark_node_dead(node_id, "connection lost")
+        ref_worker = conn.state.get("ref_worker_id")
+        if ref_worker is not None:
+            await self._sweep_worker_refs(ref_worker)
         if conn.state.get("is_driver"):
             job_id = conn.state.get("job_id")
             if job_id:

@@ -42,6 +42,7 @@ logger = logging.getLogger(__name__)
 CHUNK = 4 * 1024 * 1024
 IDLE_CULL_S = 60.0
 SPILL_MAX = 2  # max times a task may be forwarded before it must run
+DEP_LOST_S = 10.0  # fetch wait before asking the owner to reconstruct
 
 
 def detect_resources() -> dict:
@@ -428,6 +429,16 @@ class NodeAgent:
         self._kick_dispatch()
         return {"queued": "local"}
 
+    async def _notify_dep_lost(self, spec: dict, oid: bytes):
+        try:
+            cli = await self._peer_worker(spec["owner"])
+            if cli is not None:
+                await cli.oneway("dep_lost", {
+                    "task_id": spec["task_id"], "object_id": oid,
+                })
+        except (rpc.ConnectionLost, rpc.RpcError, OSError):
+            pass
+
     async def _notify_task_located(self, spec: dict):
         try:
             cli = await self._peer_worker(spec["owner"])
@@ -547,12 +558,23 @@ class NodeAgent:
             missing = [d for d in deps if not self.store.contains(d)
                        and not self._is_inline(d, spec)]
             if missing:
+                now = time.monotonic()
                 if not spec.get("_fetching"):
                     spec["_fetching"] = True
+                    spec["_fetching_since"] = now
                     for d in missing:
                         asyncio.ensure_future(self._ensure_local(d))
-                spec["_fetching_since"] = spec.get(
-                    "_fetching_since", time.monotonic())
+                elif now - spec.get("_fetching_since", now) > DEP_LOST_S:
+                    # No copy appeared anywhere: tell the owner so it can
+                    # lineage-reconstruct (object_recovery_manager.h:90),
+                    # then restart the fetch cycle for the recomputed copy.
+                    if spec.get("owner"):
+                        for d in missing:
+                            asyncio.ensure_future(
+                                self._notify_dep_lost(spec, d))
+                    spec["_fetching_since"] = now
+                    for d in missing:
+                        asyncio.ensure_future(self._ensure_local(d))
                 self.task_queue.append(spec)
                 continue
             self._take(need, pool)

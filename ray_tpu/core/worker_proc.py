@@ -17,7 +17,12 @@ import traceback
 
 from ray_tpu._private import rpc, serialization
 from ray_tpu._private.ids import ObjectID, TaskID
-from ray_tpu._private.worker import INLINE_MAX, CoreWorker, RayTaskError
+from ray_tpu._private.worker import (
+    INLINE_MAX,
+    CoreWorker,
+    DynamicReturns,
+    RayTaskError,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -159,33 +164,65 @@ class Executor(CoreWorker):
         kwargs = {k: _resolve(v) for k, v in kwargs.items()}
         return args, kwargs
 
+    def _push_one(self, cli, spec, oid: bytes, value=None, error=None,
+                  extra: dict | None = None):
+        msg = {"object_id": oid, "task_id": spec["task_id"]}
+        if extra:
+            msg.update(extra)
+        if spec.get("actor_id") is not None:
+            msg["actor_id"] = spec["actor_id"]
+        if error is not None:
+            msg["error"] = error
+        else:
+            payload = serialization.pack_payload(value)
+            size = len(payload[0]) + sum(len(b) for b in payload[1])
+            if size <= INLINE_MAX:
+                msg["payload"] = payload
+            else:
+                self._put_plasma(oid, payload)
+                msg["in_plasma"] = True
+                msg["size"] = size
+        if cli is not None:
+            try:
+                cli.oneway("push_result", msg)
+            except (rpc.ConnectionLost, rpc.RpcError):
+                pass
+
     def _push_results(self, spec, owner, results, error=None):
         cli = self._peer(owner)
         n = spec.get("num_returns", 1)
         task_id = spec["task_id"]
-        actor_id = spec.get("actor_id")
+        if n == "dynamic":
+            # error path for a generator task: fail the descriptor object
+            oid = ObjectID.for_task_return(TaskID(task_id), 0).binary()
+            self._push_one(cli, spec, oid, error=error)
+            return
         for i in range(n):
             oid = ObjectID.for_task_return(TaskID(task_id), i).binary()
-            msg = {"object_id": oid, "task_id": task_id}
-            if actor_id is not None:
-                msg["actor_id"] = actor_id
-            if error is not None:
-                msg["error"] = error
-            else:
-                value = results[i] if n > 1 else results
-                payload = serialization.pack_payload(value)
-                size = len(payload[0]) + sum(len(b) for b in payload[1])
-                if size <= INLINE_MAX:
-                    msg["payload"] = payload
-                else:
-                    self._put_plasma(oid, payload)
-                    msg["in_plasma"] = True
-                    msg["size"] = size
-            if cli is not None:
-                try:
-                    cli.oneway("push_result", msg)
-                except (rpc.ConnectionLost, rpc.RpcError):
-                    pass
+            value = None if error is not None else (
+                results[i] if n > 1 else results
+            )
+            self._push_one(cli, spec, oid, value=value, error=error)
+
+    def _push_dynamic_results(self, spec, owner, results):
+        """num_returns="dynamic" (reference _raylet.pyx:186
+        ObjectRefGenerator): each yielded value becomes its own object at
+        return index 1.., then the index-0 descriptor carries the id list.
+        Items stream to the owner as the generator produces them."""
+        cli = self._peer(owner)
+        task_id = spec["task_id"]
+        oids: list[bytes] = []
+        for value in results:
+            oid = ObjectID.for_task_return(
+                TaskID(task_id), len(oids) + 1
+            ).binary()
+            self._push_one(cli, spec, oid, value=value)
+            oids.append(oid)
+        desc = ObjectID.for_task_return(TaskID(task_id), 0).binary()
+        # dynamic_items lets the owner register descriptor->items nesting
+        # so dropping the descriptor ref frees the items too
+        self._push_one(cli, spec, desc, value=DynamicReturns(oids),
+                       extra={"dynamic_items": oids})
 
     def _execute_task(self, spec):
         owner = spec["owner"]
@@ -194,14 +231,17 @@ class Executor(CoreWorker):
             args, kwargs = self._resolve_args(spec)
             results = fn(*args, **kwargs)
             n = spec.get("num_returns", 1)
-            if n > 1:
-                results = tuple(results)
-                if len(results) != n:
-                    raise RayTaskError(
-                        f"task declared num_returns={n} but returned "
-                        f"{len(results)} values"
-                    )
-            self._push_results(spec, owner, results)
+            if n == "dynamic":
+                self._push_dynamic_results(spec, owner, results)
+            else:
+                if n > 1:
+                    results = tuple(results)
+                    if len(results) != n:
+                        raise RayTaskError(
+                            f"task declared num_returns={n} but returned "
+                            f"{len(results)} values"
+                        )
+                self._push_results(spec, owner, results)
         except BaseException as e:  # noqa: BLE001 — report, don't die
             tb = traceback.format_exc()
             logger.warning("task %s failed: %s", spec.get("name"), tb)
@@ -280,7 +320,17 @@ def main():
     from ray_tpu._private import api
 
     api._set_global_worker(worker)
-    threading.Event().wait()  # serve forever
+    # Fate-share with the node agent: a worker whose agent is gone can
+    # never be dispatched to again — exit instead of leaking (reference
+    # workers die when their raylet's connection breaks).
+    import time as _time
+
+    while True:
+        _time.sleep(2.0)
+        cli = worker.agent.client
+        if cli is not None and cli.closed:
+            logger.warning("agent connection lost; worker exiting")
+            os._exit(1)
 
 
 if __name__ == "__main__":

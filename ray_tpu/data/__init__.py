@@ -1,0 +1,18 @@
+"""ray_tpu.data — streaming datasets on the task/object runtime.
+
+Reference: python/ray/data (dataset.py:176 Dataset,
+_internal/execution/streaming_executor.py:48). Scaled v0: block-based
+datasets whose transforms run as pipelined remote tasks with bounded
+in-flight blocks; consumed blocks are freed by the distributed GC as their
+refs drop, which is what keeps long streams memory-bounded.
+"""
+
+from ray_tpu.data.dataset import (  # noqa: F401
+    DataIterator,
+    Dataset,
+    from_items,
+    from_numpy,
+    range as range_,  # `range` shadows the builtin; both names exported
+)
+
+range = range_  # noqa: A001 — mirrors ray.data.range

@@ -1,0 +1,227 @@
+"""Block-based streaming Dataset.
+
+Reference mapping:
+- `Dataset` (reference data/dataset.py:176): an ordered list of block refs.
+  Blocks are lists (rows) or numpy arrays (batches of rows).
+- `map_batches` (reference TaskPoolMapOperator,
+  execution/operators/task_pool_map_operator.py:52): one task per block,
+  submitted with a bounded in-flight window (streaming_executor.py:210's
+  backpressure, simplified to a sliding window over an ordered pipeline).
+- sources use `num_returns="dynamic"` generator tasks
+  (reference _raylet.pyx:186) so one read task can emit many blocks.
+- `streaming_split` (reference dataset.py:1062 + stream_split_iterator.py):
+  disjoint round-robin block streams, one per consumer; each DataIterator
+  is picklable and can be handed to a train worker.
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Any, Callable, Iterable, Iterator
+
+import numpy as np
+
+import ray_tpu
+
+DEFAULT_PARALLELISM = 8
+DEFAULT_INFLIGHT = 4
+
+
+def _default_resources() -> dict:
+    return {"CPU": 1}
+
+
+@ray_tpu.remote(num_cpus=1)
+def _map_block(fn_blob, block):
+    from ray_tpu._private import serialization
+
+    fn = serialization.unpack_payload(fn_blob)
+    return fn(block)
+
+
+@ray_tpu.remote(num_cpus=1, num_returns="dynamic")
+def _read_range(start: int, stop: int, block_size: int):
+    for lo in builtins.range(start, stop, block_size):
+        yield np.arange(lo, min(lo + block_size, stop), dtype=np.int64)
+
+
+class Dataset:
+    """An ordered collection of block refs (reference dataset.py:176)."""
+
+    def __init__(self, block_refs: list):
+        self._blocks = list(block_refs)
+
+    # -- metadata --
+
+    def num_blocks(self) -> int:
+        return len(self._blocks)
+
+    def count(self) -> int:
+        return sum(
+            len(b) for b in ray_tpu.get(list(self._blocks), timeout=300)
+        )
+
+    def __repr__(self):
+        return f"Dataset(num_blocks={len(self._blocks)})"
+
+    # -- transforms --
+
+    def map_batches(self, fn: Callable[[Any], Any], *,
+                    max_in_flight: int = DEFAULT_INFLIGHT) -> "Dataset":
+        """Apply fn to every block via remote tasks.
+
+        Pipelined: at most max_in_flight map tasks are outstanding; output
+        block refs are collected in order. (TaskPoolMapOperator analog; the
+        window is the backpressure budget of streaming_executor.py:210.)"""
+        from ray_tpu._private import serialization
+
+        fn_blob = serialization.pack_callable(fn)
+        out: list = []
+        in_flight: list = []
+        for block_ref in self._blocks:
+            if len(in_flight) >= max_in_flight:
+                _, in_flight = ray_tpu.wait(
+                    in_flight, num_returns=1, timeout=300
+                )
+            ref = _map_block.remote(fn_blob, block_ref)
+            in_flight.append(ref)
+            out.append(ref)
+        return Dataset(out)
+
+    def filter(self, pred: Callable[[Any], bool], **kw) -> "Dataset":
+        from ray_tpu._private import serialization
+
+        # pred may live in a driver-only module: ship it by value and
+        # rebuild the block filter on the worker.
+        pred_blob = serialization.pack_callable(pred)
+
+        def _filter_block(block):
+            from ray_tpu._private import serialization as S
+
+            p = S.unpack_payload(pred_blob)
+            if isinstance(block, np.ndarray):
+                return block[[bool(p(row)) for row in block]]
+            return [row for row in block if p(row)]
+
+        return self.map_batches(_filter_block, **kw)
+
+    # -- consumption --
+
+    def iter_batches(self) -> Iterator[Any]:
+        """Yield blocks in order. The Dataset keeps its block refs (it is
+        re-iterable); to stream-and-release, use streaming_split."""
+        for ref in list(self._blocks):
+            yield ray_tpu.get(ref, timeout=300)
+
+    def iter_rows(self) -> Iterator[Any]:
+        for block in self.iter_batches():
+            yield from block
+
+    def take(self, n: int = 20) -> list:
+        out = []
+        for block in self.iter_batches():
+            for row in block:
+                out.append(row)
+                if len(out) >= n:
+                    return out
+        return out
+
+    def materialize(self) -> list:
+        return ray_tpu.get(list(self._blocks), timeout=600)
+
+    # -- splits --
+
+    def split(self, k: int) -> list["Dataset"]:
+        return [Dataset(self._blocks[i::k]) for i in builtins.range(k)]
+
+    def streaming_split(self, k: int) -> list["DataIterator"]:
+        """k disjoint block streams (reference dataset.py:1062): pass each
+        DataIterator to one train worker; iteration happens there."""
+        return [
+            DataIterator(self._blocks[i::k]) for i in builtins.range(k)
+        ]
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        rows = self.materialize()
+        flat: list = []
+        for b in rows:
+            flat.extend(list(b))
+        if not flat:
+            return Dataset([])
+        is_np = isinstance(rows[0], np.ndarray)
+        chunk = max(1, (len(flat) + num_blocks - 1) // num_blocks)
+        blocks = []
+        for i in builtins.range(0, len(flat), chunk):
+            part = flat[i:i + chunk]
+            blocks.append(
+                ray_tpu.put(np.asarray(part) if is_np else part)
+            )
+        return Dataset(blocks)
+
+
+class DataIterator:
+    """One consumer's stream of blocks; picklable (refs travel by id).
+
+    Reference: _internal/iterator/stream_split_iterator.py:41 — minus the
+    coordinator actor: block ownership is decided up-front by round-robin,
+    which preserves the disjointness + order guarantees tests rely on."""
+
+    def __init__(self, block_refs: list):
+        self._blocks = list(block_refs)
+
+    def __reduce__(self):
+        return (DataIterator, (self._blocks,))
+
+    def iter_batches(self) -> Iterator[Any]:
+        for ref in self._blocks:
+            yield ray_tpu.get(ref, timeout=300)
+
+    def __iter__(self):
+        return self.iter_batches()
+
+    def num_blocks(self) -> int:
+        return len(self._blocks)
+
+
+# ---------------- sources ----------------
+
+def from_items(items: Iterable[Any],
+               parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
+    """reference data/read_api.py from_items."""
+    items = list(items)
+    if not items:
+        return Dataset([])
+    n = min(parallelism, len(items))
+    chunk = (len(items) + n - 1) // n
+    blocks = [
+        ray_tpu.put(items[i:i + chunk])
+        for i in builtins.range(0, len(items), chunk)
+    ]
+    return Dataset(blocks)
+
+
+def from_numpy(arr: np.ndarray,
+               parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
+    splits = np.array_split(arr, min(parallelism, max(1, len(arr))))
+    return Dataset([ray_tpu.put(s) for s in splits if len(s)])
+
+
+def range(n: int, *, parallelism: int = DEFAULT_PARALLELISM,
+          block_size: int | None = None) -> Dataset:
+    """Generator-task source: each read task emits its blocks via
+    num_returns="dynamic" (reference task_pool_map_operator.py:52)."""
+    if n <= 0:
+        return Dataset([])
+    parallelism = min(parallelism, n)
+    per_task = (n + parallelism - 1) // parallelism
+    block_size = block_size or max(1, per_task // 2)
+    blocks: list = []
+    gen_refs = []
+    for start in builtins.range(0, n, per_task):
+        gen_refs.append(
+            _read_range.remote(start, min(start + per_task, n), block_size)
+        )
+    for gref in gen_refs:
+        gen = ray_tpu.get(gref, timeout=300)
+        blocks.extend(list(gen))
+    return Dataset(blocks)

@@ -15,6 +15,7 @@ release/air_examples/gptj_deepspeed_finetuning/):
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 
 import jax
@@ -40,6 +41,10 @@ class LlamaConfig:
     max_seq_len: int = 4096
     dtype: str = "bfloat16"  # compute dtype; master params stay f32
     remat: bool = True
+    # "dots": save matmul outputs, recompute elementwise+attention (fast
+    # bwd, ~0.6 GB/layer at b8x2048/350m). "nothing": full remat — only
+    # the layer input survives (fits 2x the batch; bwd re-runs the fwd).
+    remat_policy: str = "dots"
     use_flash: bool | None = None  # None = auto (flash on TPU)
     tie_embeddings: bool = False
 
@@ -145,33 +150,43 @@ def param_logical_axes(cfg: LlamaConfig):
 # Forward
 # --------------------------------------------------------------------------
 
-def _layer(cfg: LlamaConfig, h, layer_params, sin, cos):
-    """One pre-norm transformer block. h: [B, T, D] in compute dtype."""
-    p = layer_params
-    b, t, d = h.shape
+def _qkv(cfg: LlamaConfig, p, h, sin, cos):
+    """Shared pre-norm QKV projection + rotary for both the training layer
+    and the cached-decode layer."""
+    b, t, _ = h.shape
     hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     cdt = cfg.compute_dtype
-
-    # Attention
     x = rms_norm(h, p["attn_norm"], cfg.rms_eps)
     q = (x @ p["wq"].astype(cdt)).reshape(b, t, hq, hd)
     k = (x @ p["wk"].astype(cdt)).reshape(b, t, hkv, hd)
     v = (x @ p["wv"].astype(cdt)).reshape(b, t, hkv, hd)
-    q = apply_rotary(q, sin, cos)
-    k = apply_rotary(k, sin, cos)
-    q = shard_constraint(q, ("batch", "seq", "heads", "head_dim"))
-    k = shard_constraint(k, ("batch", "seq", "kv_heads", "head_dim"))
-    o = attention(q, k, v, causal=True, use_flash=cfg.use_flash)
-    o = o.reshape(b, t, hq * hd) @ p["wo"].astype(cdt)
-    h = h + shard_constraint(o, ("batch", "seq", "embed"))
+    return apply_rotary(q, sin, cos), apply_rotary(k, sin, cos), v
 
-    # SwiGLU MLP
+
+def _attn_out_and_mlp(cfg: LlamaConfig, p, h, o):
+    """Shared wo projection + residual + SwiGLU MLP."""
+    b, t, _ = h.shape
+    hq, hd = cfg.n_heads, cfg.head_dim
+    cdt = cfg.compute_dtype
+    h = h + shard_constraint(
+        o.reshape(b, t, hq * hd) @ p["wo"].astype(cdt),
+        ("batch", "seq", "embed"),
+    )
     x = rms_norm(h, p["mlp_norm"], cfg.rms_eps)
     gate = x @ p["w_gate"].astype(cdt)
     up = x @ p["w_up"].astype(cdt)
     y = (jax.nn.silu(gate) * up) @ p["w_down"].astype(cdt)
-    h = h + shard_constraint(y, ("batch", "seq", "embed"))
-    return h
+    return h + shard_constraint(y, ("batch", "seq", "embed"))
+
+
+def _layer(cfg: LlamaConfig, h, layer_params, sin, cos):
+    """One pre-norm transformer block. h: [B, T, D] in compute dtype."""
+    p = layer_params
+    q, k, v = _qkv(cfg, p, h, sin, cos)
+    q = shard_constraint(q, ("batch", "seq", "heads", "head_dim"))
+    k = shard_constraint(k, ("batch", "seq", "kv_heads", "head_dim"))
+    o = attention(q, k, v, causal=True, use_flash=cfg.use_flash)
+    return _attn_out_and_mlp(cfg, p, h, o)
 
 
 def forward(params, tokens, cfg: LlamaConfig, *, positions=None):
@@ -182,15 +197,25 @@ def forward(params, tokens, cfg: LlamaConfig, *, positions=None):
         positions = jnp.arange(t, dtype=jnp.int32)[None, :]
     sin, cos = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta)
 
-    h = params["embed"].astype(cdt)[tokens]
+    # Embedding lookup: gather from a fully-replicated view of the table.
+    # With vocab/embed sharded at rest and seq sharded (sp), XLA's
+    # gather+jvp fall back to "involuntary full rematerialization" when
+    # resharding the gather output; one explicit all-gather of the table
+    # (V x D in compute dtype, the fsdp weights-gather pattern) makes the
+    # gather local and its scatter-add transpose a clean reduce-scatter.
+    w_embed = shard_constraint(
+        params["embed"].astype(cdt), (None, None)
+    )
+    h = w_embed[tokens]
     h = shard_constraint(h, ("batch", "seq", "embed"))
 
     layer_fn = lambda h_, p_: (_layer(cfg, h_, p_, sin, cos), None)
     if cfg.remat:
-        layer_fn = jax.checkpoint(
-            layer_fn,
-            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        policy = (
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            if cfg.remat_policy == "dots" else None
         )
+        layer_fn = jax.checkpoint(layer_fn, policy=policy)
     h, _ = jax.lax.scan(layer_fn, h, params["layers"])
 
     h = rms_norm(h, params["final_norm"], cfg.rms_eps)
@@ -213,3 +238,106 @@ def loss_fn(params, batch, cfg: LlamaConfig):
     logits = forward(params, inputs, cfg)
     loss, n = softmax_cross_entropy(logits, targets, mask=mask)
     return loss, {"loss": loss, "tokens": n}
+
+
+# --------------------------------------------------------------------------
+# KV-cache inference (prefill + incremental decode)
+# --------------------------------------------------------------------------
+#
+# The reference serves models through torch (no in-tree decode path); this
+# is the framework-native equivalent that ray_tpu.serve replicas jit:
+# a static-shape cache ([L, B, max_len, Hkv, D]) updated with
+# dynamic_update_slice so the decode step compiles once for all positions.
+
+def init_cache(cfg: LlamaConfig, batch: int, max_len: int) -> dict:
+    """Static-shape KV cache. pos = number of valid positions filled."""
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    cdt = cfg.compute_dtype
+    return {
+        "k": jnp.zeros(shape, cdt),
+        "v": jnp.zeros(shape, cdt),
+        "pos": jnp.zeros((), jnp.int32),
+    }
+
+
+def _layer_with_cache(cfg: LlamaConfig, h, p, sin, cos, ck, cv, pos):
+    """_layer variant that appends this block's k/v at `pos` and attends
+    the cache prefix. h: [B, T, D]; ck/cv: [B, S, Hkv, D]."""
+    from ray_tpu.ops.attention import _repeat_kv
+
+    b, t, _ = h.shape
+    hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    cdt = cfg.compute_dtype
+    s = ck.shape[1]
+
+    q, k, v = _qkv(cfg, p, h, sin, cos)
+    ck = jax.lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
+    cv = jax.lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
+
+    # Explicit-length attention: query i (global position pos+i) attends
+    # cache slots <= pos+i; slots beyond the filled region are masked.
+    kk = _repeat_kv(ck, hq // hkv)
+    vv = _repeat_kv(cv, hq // hkv)
+    logits = jnp.einsum(
+        "bthd,bshd->bhts", q, kk, preferred_element_type=jnp.float32
+    ) * (hd ** -0.5)
+    q_pos = pos + jnp.arange(t, dtype=jnp.int32)[:, None]  # [T, 1]
+    k_pos = jnp.arange(s, dtype=jnp.int32)[None, :]  # [1, S]
+    logits = jnp.where((k_pos <= q_pos)[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(cdt)
+    o = jnp.einsum(
+        "bhts,bshd->bthd", probs, vv, preferred_element_type=jnp.float32
+    ).astype(cdt)
+    h = _attn_out_and_mlp(cfg, p, h, o)
+    return h, ck, cv
+
+
+def forward_with_cache(params, tokens, cfg: LlamaConfig, cache: dict):
+    """Run tokens [B, T] starting at cache['pos']; returns (logits [B,T,V],
+    new cache). Covers both prefill (T=prompt len) and decode (T=1)."""
+    b, t = tokens.shape
+    cdt = cfg.compute_dtype
+    pos = cache["pos"]
+    positions = pos + jnp.arange(t, dtype=jnp.int32)[None, :]
+    sin, cos = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta)
+
+    h = params["embed"].astype(cdt)[tokens]
+
+    def body(h_, xs):
+        p_, ck, cv = xs
+        h_, ck, cv = _layer_with_cache(cfg, h_, p_, sin, cos, ck, cv, pos)
+        return h_, (ck, cv)
+
+    h, (ck, cv) = jax.lax.scan(
+        body, h, (params["layers"], cache["k"], cache["v"])
+    )
+    h = rms_norm(h, params["final_norm"], cfg.rms_eps)
+    w_out = (
+        params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    ).astype(cdt)
+    logits = (h @ w_out).astype(jnp.float32)
+    return logits, {"k": ck, "v": cv, "pos": pos + t}
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _fwd_with_cache_jit(params, tokens, cache, cfg: LlamaConfig):
+    # LlamaConfig is frozen/hashable, so the compiled step is cached per
+    # config across calls (one prefill shape + one decode shape).
+    return forward_with_cache(params, tokens, cfg, cache)
+
+
+def greedy_generate(params, prompt, cfg: LlamaConfig, max_new_tokens: int,
+                    max_len: int | None = None):
+    """Prefill + greedy decode loop (eager driver loop; each step is one
+    jitted decode). prompt: [B, T0] -> [B, T0 + max_new_tokens]."""
+    b, t0 = prompt.shape
+    max_len = max_len or (t0 + max_new_tokens)
+    cache = init_cache(cfg, b, max_len)
+    logits, cache = _fwd_with_cache_jit(params, prompt, cache, cfg)
+    tok = jnp.argmax(logits[:, -1:], axis=-1).astype(prompt.dtype)
+    out = [prompt, tok]
+    for _ in range(max_new_tokens - 1):
+        logits, cache = _fwd_with_cache_jit(params, tok, cache, cfg)
+        tok = jnp.argmax(logits[:, -1:], axis=-1).astype(prompt.dtype)
+        out.append(tok)
+    return jnp.concatenate(out, axis=1)

@@ -29,6 +29,11 @@ from ray_tpu.utils.math import cdiv
 
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 1024
+# Up to this sequence length the kernels take the whole row/column as one
+# inner tile: per-block overhead and dead-block DMA cost more than the
+# causal-flop saving at short-to-medium T (measured on v5e: full-row
+# noncausal matmuls at this shape beat half-flop tiled causal by ~30%).
+_FULL_INNER_MAX = 2048
 _BWD_INNER = 1024  # min tile width along each bwd kernel's inner grid dim
 _NEG_INF = -1e30
 
@@ -45,6 +50,14 @@ def _block_live(causal, q_start, k_start, block_q, offset):
     return jnp.logical_or(
         jnp.logical_not(causal), k_start <= q_start + block_q - 1 + offset
     )
+
+
+def _straddles(q_start, k_start, block_k, offset):
+    """Traced predicate: the tile straddles the diagonal (some entries
+    masked). Fully-live tiles take a branch without the iota/compare/
+    select VPU passes — the kernel is exp/VPU-bound at d=64, so skipping
+    them on the (majority) interior tiles is a real win."""
+    return k_start + block_k - 1 > q_start + offset
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, causal, scale, block_q, block_k, offset):
@@ -64,8 +77,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, c
     q_start = iq * block_q
     k_start = ik * block_k
 
-    @pl.when(_block_live(causal, q_start, k_start, block_q, offset))
-    def _compute():
+    def _compute(masked: bool):
         # Matmul operands stay in the input dtype (bf16 hits the MXU's native
         # mode; f32 operands would run at a fraction of peak); accumulation
         # and all softmax statistics are f32.
@@ -74,18 +86,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, c
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [bq, bk]
-        if causal:
+        if masked:
             s = _causal_mask(s, q_start, k_start, offset)
 
         m_prev = m_scr[:, :1]  # [bq, 1] (lanes replicated)
         m_cur = jnp.max(s, axis=-1, keepdims=True)  # [bq, 1]
         m_new = jnp.maximum(m_prev, m_cur)
-        # Rows whose every key is masked (possible when T > S under causal,
-        # for rows straddling a live block) keep m_new at _NEG_INF; exp(s -
-        # m_new) would be exp(0) = 1 there, so force p to 0 on dead rows.
-        p = jnp.where(
-            m_new > _NEG_INF * 0.5, jnp.exp(s - m_new), 0.0
-        )  # [bq, bk]
+        if masked:
+            # Rows whose every key is masked (possible when T > S under
+            # causal) keep m_new at _NEG_INF; exp(s - m_new) would be
+            # exp(0) = 1 there, so force p to 0 on dead rows.
+            p = jnp.where(
+                m_new > _NEG_INF * 0.5, jnp.exp(s - m_new), 0.0
+            )  # [bq, bk]
+        else:
+            p = jnp.exp(s - m_new)
         corr = jnp.exp(m_prev - m_new)  # [bq, 1]
         l_new = corr * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
 
@@ -97,6 +112,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, c
         acc_scr[:] = acc_scr[:] * corr + pv
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    live = _block_live(causal, q_start, k_start, block_q, offset)
+    if causal:
+        straddle = _straddles(q_start, k_start, block_k, offset)
+        pl.when(jnp.logical_and(live, straddle))(
+            lambda: _compute(masked=True)
+        )
+        pl.when(jnp.logical_and(live, jnp.logical_not(straddle)))(
+            lambda: _compute(masked=False)
+        )
+    else:
+        pl.when(live)(lambda: _compute(masked=False))
 
     @pl.when(ik == nk - 1)
     def _finalize():
@@ -114,7 +141,10 @@ def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
     _, hkv, s, _ = k.shape
     group = hq // hkv
     block_q = min(block_q, t)
-    block_k = min(block_k, s)
+    if s <= _FULL_INNER_MAX:
+        block_k = s  # one k tile per q row: no dead-block grid/DMA overhead
+    else:
+        block_k = min(block_k, s)
     if t % block_q or s % block_k:
         raise ValueError(
             f"flash_attention: T={t} / S={s} must be multiples of block sizes "
@@ -160,6 +190,12 @@ def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
             pltpu.VMEM((block_q, 128), jnp.float32),  # running denom l
             pltpu.VMEM((block_q, d), jnp.float32),  # output accumulator
         ],
+        # b/head/q rows are independent -> mosaic may pipeline them; only
+        # the innermost k dim carries scratch state.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        ),
         interpret=interpret,
     )(q, k, v)
     return out, lse4[..., 0]  # lse: [B, H, T] f32
@@ -179,8 +215,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     q_start = iq * block_q
     k_start = ik * block_k
 
-    @pl.when(_block_live(causal, q_start, k_start, block_q, offset))
-    def _compute():
+    def _compute(masked: bool):
         q = q_ref[0, 0]  # [bq, d], input dtype (MXU-native)
         k = k_ref[0, 0]  # [bk, d]
         v = v_ref[0, 0]  # [bk, d]
@@ -191,7 +226,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [bq, bk]
-        if causal:
+        if masked:
             s = _causal_mask(s, q_start, k_start, offset)
         p = jnp.exp(s - lse)  # [bq, bk] f32
         dp = jax.lax.dot_general(
@@ -201,6 +236,18 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_scr[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
+
+    live = _block_live(causal, q_start, k_start, block_q, offset)
+    if causal:
+        straddle = _straddles(q_start, k_start, block_k, offset)
+        pl.when(jnp.logical_and(live, straddle))(
+            lambda: _compute(masked=True)
+        )
+        pl.when(jnp.logical_and(live, jnp.logical_not(straddle)))(
+            lambda: _compute(masked=False)
+        )
+    else:
+        pl.when(live)(lambda: _compute(masked=False))
 
     @pl.when(ik == nk - 1)
     def _finalize():
@@ -227,8 +274,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     q_start = iq * block_q
     k_start = ik * block_k
 
-    @pl.when(_block_live(causal, q_start, k_start, block_q, offset))
-    def _compute():
+    def _compute(masked: bool):
         q = q_ref[0, 0]  # [bq, d], input dtype (MXU-native)
         k = k_ref[0, 0]  # [bk, d]
         v = v_ref[0, 0]  # [bk, d]
@@ -239,7 +285,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [bq, bk]
-        if causal:
+        if masked:
             s = _causal_mask(s, q_start, k_start, offset)
         p = jnp.exp(s - lse)  # [bq, bk] f32
         dv_scr[:] += jax.lax.dot_general(
@@ -253,6 +299,18 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )  # ds^T @ q -> [bk, d]
+
+    live = _block_live(causal, q_start, k_start, block_q, offset)
+    if causal:
+        straddle = _straddles(q_start, k_start, block_k, offset)
+        pl.when(jnp.logical_and(live, straddle))(
+            lambda: _compute(masked=True)
+        )
+        pl.when(jnp.logical_and(live, jnp.logical_not(straddle)))(
+            lambda: _compute(masked=False)
+        )
+    else:
+        pl.when(live)(lambda: _compute(masked=False))
 
     @pl.when(iq == nq - 1)
     def _finalize():
@@ -285,12 +343,13 @@ def _flash_bwd(q, k, v, o, lse, do, *, causal, block_q, block_k, interpret):
             block *= 2
         return block
 
-    # dq kernel tiles: [bq_dq, bk_dq], k innermost and wide.
-    bq_dq = min(block_q, t)
-    bk_dq = widen(block_k, s)
+    # dq kernel tiles: [bq_dq, bk_dq], k innermost and wide (the whole row
+    # when it fits in VMEM).
+    bq_dq = min(block_q, t, 512)
+    bk_dq = s if s <= _FULL_INNER_MAX else widen(block_k, s)
     # dkv kernel tiles: [bq_kv, bk_kv], q innermost and wide.
-    bq_kv = widen(block_q, t)
-    bk_kv = min(block_k, s)
+    bq_kv = t if t <= _FULL_INNER_MAX else widen(block_q, t)
+    bk_kv = min(block_k, s, 512)
 
     # delta_i = rowsum(do_i * o_i); cheap elementwise reduce, XLA fuses it.
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
@@ -322,6 +381,10 @@ def _flash_bwd(q, k, v, o, lse, do, *, causal, block_q, block_k, interpret):
         ),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        ),
         interpret=interpret,
     )(q, k, v, do, lse_r, delta_r)
 
@@ -353,6 +416,10 @@ def _flash_bwd(q, k, v, o, lse, do, *, causal, block_q, block_k, interpret):
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        ),
         interpret=interpret,
     )(q, k, v, do, lse_r, delta_r)
 

@@ -1,0 +1,134 @@
+"""Ring attention: sequence-parallel attention over the sp mesh axis.
+
+Absent in the reference (SURVEY §5: no sequence/context parallelism
+anywhere in-tree) — designed fresh for TPU: q/k/v stay sharded on the
+sequence dim across the `sp` axis; k/v shards rotate around the ICI ring
+(lax.ppermute) while each device's q block accumulates attention with the
+numerically-stable online-softmax update (same recurrence as the flash
+kernel's m/l/acc scratch). Communication overlaps the per-step compute in
+XLA's pipeline; peak memory is one [Tl, Tl] block of logits per device
+(Tl = T / sp), and the whole thing is differentiable (scan + ppermute have
+transpose rules), so no bespoke backward is needed.
+
+Layout: q, k, v [B, T, H, D] sharded ("batch", "seq"=sp, heads, head_dim).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, q_start, k_start, causal, scale):
+    """One [Tl, Tl] attention block in f32; returns (pv, m, l) unnormalized.
+
+    q: [B, Tq, H, D]; k/v: [B, Tk, H, D] (kv heads already matched).
+    m/l: [B, H, Tq] row max / row sum of exp(s - m)."""
+    s = jnp.einsum(
+        "bthd,bshd->bhts", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        rows = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape[-2:], 0
+        )
+        cols = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape[-2:], 1
+        )
+        s = jnp.where((rows >= cols)[None, None], s, _NEG_INF)
+    m = jnp.max(s, axis=-1)  # [B, H, Tq]
+    # rows with every key masked contribute nothing
+    p = jnp.where(
+        (m > _NEG_INF * 0.5)[..., None], jnp.exp(s - m[..., None]), 0.0
+    )
+    l = jnp.sum(p, axis=-1)  # [B, H, Tq]
+    pv = jnp.einsum(
+        "bhts,bshd->bthd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )  # [B, Tq, H, D] f32
+    return pv, m, l
+
+
+def _repeat_kv(x, n_rep: int):
+    if n_rep == 1:
+        return x
+    b, s, h, d = x.shape
+    return jnp.broadcast_to(
+        x[:, :, :, None, :], (b, s, h, n_rep, d)
+    ).reshape(b, s, h * n_rep, d)
+
+
+def ring_attention(q, k, v, mesh: Mesh, *, axis: str = "sp",
+                   causal: bool = True, remat_blocks: bool = True):
+    """Sequence-parallel attention; result matches attention_reference.
+
+    q [B, T, Hq, D], k/v [B, T, Hkv, D] with T sharded over mesh[axis].
+    Inside shard_map each device holds Tl = T/n rows; n ring steps rotate
+    the k/v shard one neighbor per step."""
+    n = mesh.shape[axis]
+    n_rep = q.shape[2] // k.shape[2]
+    scale = q.shape[-1] ** -0.5
+
+    def local(qb, kb, vb):
+        # qb/kb/vb: this device's shard [B, Tl, H*, D]
+        tl = qb.shape[1]
+        idx = jax.lax.axis_index(axis)
+        q_start = idx * tl
+        kb = _repeat_kv(kb, n_rep)
+        vb = _repeat_kv(vb, n_rep)
+
+        block = _block_attn
+        if remat_blocks:
+            block = jax.checkpoint(
+                functools.partial(_block_attn, causal=causal, scale=scale),
+                static_argnums=(),
+            )
+        else:
+            block = functools.partial(block, causal=causal, scale=scale)
+
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def step(carry, s_i):
+            kb_, vb_, acc, m_run, l_run = carry
+            # k/v currently held originate from rank (idx - s_i) mod n
+            src = (idx - s_i) % n
+            k_start = src * tl
+            pv, m_blk, l_blk = block(qb, kb_, vb_, q_start, k_start)
+            m_new = jnp.maximum(m_run, m_blk)
+            corr_run = jnp.exp(m_run - m_new)
+            corr_blk = jnp.exp(m_blk - m_new)
+            # guard fully-masked m values (exp(-inf - -inf))
+            corr_run = jnp.where(m_run > _NEG_INF * 0.5, corr_run, 0.0)
+            corr_blk = jnp.where(m_blk > _NEG_INF * 0.5, corr_blk, 0.0)
+            acc = acc * _rows(corr_run) + pv * _rows(corr_blk)
+            l_new = l_run * corr_run + l_blk * corr_blk
+            kb_ = jax.lax.ppermute(kb_, axis, perm)
+            vb_ = jax.lax.ppermute(vb_, axis, perm)
+            return (kb_, vb_, acc, m_new, l_new), None
+
+        b, tl_, h, d = qb.shape
+        acc0 = jnp.zeros((b, tl_, h, d), jnp.float32)
+        m0 = jnp.full((b, h, tl_), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((b, h, tl_), jnp.float32)
+        (_, _, acc, _, l_fin), _ = jax.lax.scan(
+            step, (kb, vb, acc0, m0, l0), jnp.arange(n)
+        )
+        l_safe = jnp.where(l_fin == 0.0, 1.0, l_fin)
+        out = acc / _rows(l_safe)
+        return out.astype(q.dtype)
+
+    spec_q = P(None, axis, None, None)
+    f = jax.shard_map(
+        local, mesh=mesh, in_specs=(spec_q, spec_q, spec_q),
+        out_specs=spec_q, check_vma=False,
+    )
+    return f(q, k, v)
+
+
+def _rows(x):
+    """[B, H, T] -> [B, T, H, 1] to broadcast over head_dim."""
+    return jnp.transpose(x, (0, 2, 1))[..., None]

@@ -1,0 +1,27 @@
+"""ray_tpu.serve — model serving on the actor runtime.
+
+Reference: python/ray/serve (controller.py:79 ServeController,
+_private/router.py:227 ReplicaSet.assign_replica, batching.py:48
+_BatchQueue, _private/replica.py:296). v0 surface:
+
+    serve.start()                       # controller (named actor)
+    @serve.deployment(num_replicas=2, max_concurrent_queries=8)
+    class Model: ...
+    serve.run(Model, name="m", init_args=(...))
+    h = serve.get_handle("m")
+    ref = h.remote(request)             # routed, backpressured
+    serve.batch(...)                    # dynamic request batching
+    serve.shutdown()
+
+No HTTP proxy layer yet — the handle API is the TPU-relevant data path
+(reference serve's own composition path; HTTP rides dashboard infra we
+don't have)."""
+
+from ray_tpu.serve.api import (  # noqa: F401
+    deployment,
+    get_handle,
+    run,
+    shutdown,
+    start,
+)
+from ray_tpu.serve.batching import batch  # noqa: F401

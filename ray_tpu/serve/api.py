@@ -1,0 +1,295 @@
+"""Serve control plane + data plane.
+
+- Controller (reference controller.py:79): a named actor holding the
+  deployment table; reconciles desired replica count by starting/killing
+  replica actors; rolling redeploy replaces replicas of older versions.
+- Replica (reference _private/replica.py:296): an actor hosting the user
+  class; handles requests with actor max_concurrency =
+  max_concurrent_queries.
+- Handle/Router (reference handle.py:78 + _private/router.py:227): client-
+  side router, power-of-two-choices over per-replica in-flight counts with
+  max_concurrent_queries backpressure.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from typing import Any
+
+import ray_tpu
+
+logger = logging.getLogger(__name__)
+
+CONTROLLER_NAME = "__serve_controller__"
+
+
+@ray_tpu.remote(num_cpus=0)
+class _ReplicaActor:
+    """Hosts one copy of the user deployment class."""
+
+    def __init__(self, cls_blob, init_args, init_kwargs):
+        from ray_tpu._private import serialization
+
+        cls = serialization.unpack_payload(cls_blob)
+        self._user = cls(*init_args, **init_kwargs)
+
+    def handle_request(self, method: str, args, kwargs):
+        fn = (self._user if method == "__call__"
+              else getattr(self._user, method))
+        return fn(*args, **kwargs)
+
+    def reconfigure(self, user_config):
+        if hasattr(self._user, "reconfigure"):
+            self._user.reconfigure(user_config)
+        return True
+
+    def health(self):
+        return True
+
+
+@ray_tpu.remote(num_cpus=0)
+class _Controller:
+    """Deployment table + replica reconciliation (controller.py:79)."""
+
+    def __init__(self):
+        self.deployments: dict[str, dict] = {}
+
+    def deploy(self, name: str, cls_blob, init_args, init_kwargs,
+               num_replicas: int, max_concurrent_queries: int,
+               version: str, resources: dict):
+        import ray_tpu as rt
+        from ray_tpu.serve.api import _ReplicaActor
+
+        old = self.deployments.get(name)
+        replicas = []
+        opts = {
+            "num_cpus": resources.get("CPU", 0),
+            "num_tpus": resources.get("TPU", 0),
+            "max_concurrency": max_concurrent_queries,
+        }
+        for i in range(num_replicas):
+            replicas.append(
+                _ReplicaActor.options(**opts).remote(
+                    cls_blob, init_args, init_kwargs
+                )
+            )
+        # wait for constructors (health check) before flipping traffic
+        rt.get([r.health.remote() for r in replicas], timeout=300)
+        self.deployments[name] = {
+            "replicas": replicas,
+            "version": version,
+            "max_concurrent_queries": max_concurrent_queries,
+        }
+        if old is not None:
+            for r in old["replicas"]:  # rolling-replace: drain = kill (v0)
+                try:
+                    rt.kill(r)
+                except Exception:  # noqa: BLE001
+                    pass
+        return len(replicas)
+
+    def get_replicas(self, name: str):
+        d = self.deployments.get(name)
+        if d is None:
+            return None
+        return {
+            "actor_ids": [r._actor_id for r in d["replicas"]],
+            "max_concurrent_queries": d["max_concurrent_queries"],
+            "version": d["version"],
+        }
+
+    def list_deployments(self):
+        return {
+            name: {"num_replicas": len(d["replicas"]),
+                   "version": d["version"]}
+            for name, d in self.deployments.items()
+        }
+
+    def delete(self, name: str):
+        import ray_tpu as rt
+
+        d = self.deployments.pop(name, None)
+        if d:
+            for r in d["replicas"]:
+                try:
+                    rt.kill(r)
+                except Exception:  # noqa: BLE001
+                    pass
+        return d is not None
+
+
+# ---------------- driver-side API ----------------
+
+def start():
+    """Start (or connect to) the serve controller."""
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        pass
+    return _Controller.options(
+        name=CONTROLLER_NAME, lifetime="detached"
+    ).remote()
+
+
+def _controller():
+    return ray_tpu.get_actor(CONTROLLER_NAME)
+
+
+def shutdown():
+    try:
+        c = _controller()
+    except ValueError:
+        return
+    for name in list(ray_tpu.get(c.list_deployments.remote(), timeout=60)):
+        ray_tpu.get(c.delete.remote(name), timeout=60)
+    ray_tpu.kill(c)
+
+
+class Deployment:
+    """Result of @serve.deployment on a class."""
+
+    def __init__(self, cls, *, num_replicas=1, max_concurrent_queries=8,
+                 resources=None, name=None):
+        self._cls = cls
+        self.num_replicas = num_replicas
+        self.max_concurrent_queries = max_concurrent_queries
+        self.resources = resources or {"CPU": 0}
+        self.name = name or cls.__name__
+
+    def options(self, **kw) -> "Deployment":
+        merged = {
+            "num_replicas": self.num_replicas,
+            "max_concurrent_queries": self.max_concurrent_queries,
+            "resources": self.resources,
+            "name": self.name,
+        }
+        merged.update(kw)
+        return Deployment(self._cls, **merged)
+
+
+def deployment(_cls=None, **kw):
+    """@serve.deployment decorator (reference api.py deployment)."""
+    if _cls is not None:
+        return Deployment(_cls)
+
+    def wrap(cls):
+        return Deployment(cls, **kw)
+
+    return wrap
+
+
+def run(dep: Deployment, *, name: str | None = None, init_args=(),
+        init_kwargs=None, version: str = "1") -> "DeploymentHandle":
+    """Deploy (or redeploy) and return a handle."""
+    from ray_tpu._private import serialization
+
+    start()
+    name = name or dep.name
+    cls_blob = serialization.pack_callable(dep._cls)
+    c = _controller()
+    ray_tpu.get(
+        c.deploy.remote(
+            name, cls_blob, list(init_args), init_kwargs or {},
+            dep.num_replicas, dep.max_concurrent_queries, version,
+            dep.resources,
+        ),
+        timeout=600,
+    )
+    return get_handle(name)
+
+
+def get_handle(name: str) -> "DeploymentHandle":
+    return DeploymentHandle(name)
+
+
+class DeploymentHandle:
+    """Client-side router (reference handle.py:78 + router.py:227).
+
+    Replica choice: power-of-two-choices on the handle's local in-flight
+    counts; a replica at max_concurrent_queries is skipped (backpressure).
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self._replicas: list = []
+        self._max_q = 8
+        self._inflight: dict[int, int] = {}
+        self._lock = threading.Lock()
+        self._version = None
+        self._refresh()
+
+    def _refresh(self):
+        info = ray_tpu.get(
+            _controller().get_replicas.remote(self.name), timeout=60
+        )
+        if info is None:
+            raise ValueError(f"no deployment named '{self.name}'")
+        self._replicas = [
+            ray_tpu.ActorHandle(aid) for aid in info["actor_ids"]
+        ]
+        self._max_q = info["max_concurrent_queries"]
+        self._version = info["version"]
+        self._inflight = {i: 0 for i in range(len(self._replicas))}
+
+    def method(self, method_name: str) -> "_HandleMethod":
+        return _HandleMethod(self, method_name)
+
+    def remote(self, *args, **kwargs):
+        return self.method("__call__").remote(*args, **kwargs)
+
+    def _assign(self) -> int:
+        """Pick a replica (two random choices, fewer in-flight wins);
+        blocks while every replica is at max_concurrent_queries."""
+        deadline = time.monotonic() + 60.0
+        while True:
+            with self._lock:
+                n = len(self._replicas)
+                idxs = random.sample(range(n), min(2, n))
+                idx = min(idxs, key=lambda i: self._inflight[i])
+                if self._inflight[idx] < self._max_q:
+                    self._inflight[idx] += 1
+                    return idx
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"all {len(self._replicas)} replicas of "
+                    f"'{self.name}' at max_concurrent_queries"
+                )
+            time.sleep(0.002)
+
+    def _done(self, idx: int):
+        with self._lock:
+            self._inflight[idx] -= 1
+
+
+class _HandleMethod:
+    def __init__(self, handle: DeploymentHandle, method: str):
+        self._h = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs):
+        h = self._h
+        idx = h._assign()
+        try:
+            replica = h._replicas[idx]
+            ref = replica.handle_request.remote(self._method, list(args),
+                                                kwargs)
+        except Exception:
+            h._done(idx)
+            raise
+        _track_completion(h, idx, ref)
+        return ref
+
+
+def _track_completion(handle: DeploymentHandle, idx: int, ref):
+    """Decrement the in-flight count when the reply lands, off-thread."""
+
+    def _waiter():
+        try:
+            ray_tpu.wait([ref], num_returns=1, timeout=600)
+        finally:
+            handle._done(idx)
+
+    threading.Thread(target=_waiter, daemon=True).start()

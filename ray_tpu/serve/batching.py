@@ -1,0 +1,120 @@
+"""Dynamic request batching (reference serve/batching.py:48 _BatchQueue,
+:183 @serve.batch).
+
+Decorate a replica method taking a LIST of requests; concurrent callers
+are queued and flushed together when the batch fills or the wait timeout
+expires — the pattern that keeps TPU decode steps fed with full batches.
+The replica must run with max_concurrent_queries > 1 so callers can
+overlap (each caller's actor call parks in the queue)."""
+
+from __future__ import annotations
+
+import functools
+import queue
+import threading
+from typing import Any, Callable
+
+
+class _BatchQueue:
+    def __init__(self, fn: Callable[[list], list], max_batch_size: int,
+                 batch_wait_timeout_s: float):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.timeout = batch_wait_timeout_s
+        self.q: "queue.Queue[tuple[Any, threading.Event, dict]]" = (
+            queue.Queue()
+        )
+        self._runner = threading.Thread(
+            target=self._loop, daemon=True, name="serve-batch"
+        )
+        self._runner.start()
+
+    def submit(self, item: Any):
+        ev = threading.Event()
+        out: dict = {}
+        self.q.put((item, ev, out))
+        ev.wait()
+        if "error" in out:
+            raise out["error"]
+        return out["value"]
+
+    def _loop(self):
+        while True:
+            first = self.q.get()
+            batch = [first]
+            try:
+                while len(batch) < self.max_batch_size:
+                    batch.append(self.q.get(timeout=self.timeout))
+            except queue.Empty:
+                pass
+            items = [b[0] for b in batch]
+            try:
+                results = self.fn(items)
+                if len(results) != len(items):
+                    raise ValueError(
+                        f"@serve.batch function returned {len(results)} "
+                        f"results for {len(items)} requests"
+                    )
+                for (_, ev, out), r in zip(batch, results):
+                    out["value"] = r
+                    ev.set()
+            except BaseException as e:  # noqa: BLE001 — fan error out
+                for _, ev, out in batch:
+                    out["error"] = e
+                    ev.set()
+
+
+# Queue registry lives behind a module-level *function* so the decorated
+# method's closure captures only picklable values (fn + config ints).
+# Deployment classes travel through cloudpickle; closures referencing a
+# Lock or live queues directly would poison that pickle. _get_queue itself
+# pickles by reference (importable module attr), keeping the lock/registry
+# out of the payload.
+_create_lock = threading.Lock()
+_free_queues: dict[int, _BatchQueue] = {}
+
+
+def _get_queue(fn, instance, max_batch_size: int,
+               batch_wait_timeout_s: float) -> _BatchQueue:
+    with _create_lock:
+        if instance is not None:
+            attr = f"__serve_batch_queue_{fn.__name__}"
+            bq = instance.__dict__.get(attr)
+            if bq is None:
+                bq = _BatchQueue(
+                    lambda items: fn(instance, items),
+                    max_batch_size, batch_wait_timeout_s,
+                )
+                instance.__dict__[attr] = bq
+            return bq
+        key = id(fn)
+        bq = _free_queues.get(key)
+        if bq is None:
+            bq = _free_queues[key] = _BatchQueue(
+                fn, max_batch_size, batch_wait_timeout_s
+            )
+        return bq
+
+
+def batch(_fn=None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """@serve.batch — the wrapped fn receives list-of-requests; each caller
+    gets its own element back."""
+
+    def wrap(fn):
+        @functools.wraps(fn)
+        def inner(self_or_item, *rest):
+            # bound-method use: first arg is the replica instance
+            if rest:
+                bq = _get_queue(fn, self_or_item, max_batch_size,
+                                batch_wait_timeout_s)
+                return bq.submit(rest[0])
+            bq = _get_queue(fn, None, max_batch_size,
+                            batch_wait_timeout_s)
+            return bq.submit(self_or_item)
+
+        return inner
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap

@@ -66,6 +66,7 @@ def _setup_backend(worker, coordinator: str, world_size: int,
         coordinator_address=coordinator,
         num_processes=world_size,
         process_id=worker.worker_idx,
+        initialization_timeout=120,
     )
     worker.state["world_size"] = world_size
     return {
@@ -158,9 +159,11 @@ class BackendExecutor:
             strategy=self.strategy,
         )
         coordinator = self.worker_group.execute_single(0, _pick_coordinator)
+        # Bounded: a half-formed jax.distributed rendezvous must fail fast
+        # so the trainer's gang-restart logic can take over.
         infos = self.worker_group.execute(
             _setup_backend, coordinator, self.num_workers,
-            self.devices_per_worker, self.platform,
+            self.devices_per_worker, self.platform, timeout=180.0,
         )
         logger.info("train backend up: %s", infos)
         return infos

@@ -75,10 +75,11 @@ class WorkerGroup:
         # fail fast if any worker can't start
         ray_tpu.get([w.ping.remote() for w in self.workers], timeout=120)
 
-    def execute(self, fn: Callable, *args, **kwargs) -> list:
+    def execute(self, fn: Callable, *args, timeout: float = 600.0,
+                **kwargs) -> list:
         """Run fn on every worker, return all results (ordered by rank)."""
         return ray_tpu.get(
-            self.execute_async(fn, *args, **kwargs), timeout=600
+            self.execute_async(fn, *args, **kwargs), timeout=timeout
         )
 
     def execute_async(self, fn: Callable, *args, **kwargs) -> list:

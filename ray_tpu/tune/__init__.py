@@ -1,0 +1,20 @@
+"""ray_tpu.tune — hyperparameter search over trial actors.
+
+Reference: python/ray/tune (execution/tune_controller.py:48,
+trainable/function_trainable.py:284, schedulers/async_hyperband.py,
+search/basic_variant.py). v0: function trainables in trial actors,
+random + grid search, ASHA early stopping, per-trial checkpoints.
+"""
+
+from ray_tpu.tune.tuner import (  # noqa: F401
+    ASHAScheduler,
+    Result,
+    ResultGrid,
+    TuneConfig,
+    Tuner,
+    choice,
+    grid_search,
+    loguniform,
+    report,
+    uniform,
+)

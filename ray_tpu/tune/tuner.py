@@ -1,0 +1,337 @@
+"""Tuner: trial loop + search + ASHA.
+
+Reference mapping:
+- Tuner/TuneController (tune/tuner.py + execution/tune_controller.py:48):
+  the driver-side loop below — start up to max_concurrent trial actors,
+  drain their reports, apply scheduler decisions, collect results.
+- FunctionTrainable (trainable/function_trainable.py:284): _TrialActor
+  runs the user function on a thread; `tune.report` rides the same
+  bounded-queue session as ray_tpu.train.session.
+- ASHA (schedulers/async_hyperband.py): asynchronous successive halving —
+  at each rung a trial must be in the top 1/eta of metrics recorded at
+  that rung or it is stopped.
+- search spaces (search/basic_variant.py + sample.py): uniform /
+  loguniform / choice samplers and grid_search expansion.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import random as _random
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import ray_tpu
+
+logger = logging.getLogger(__name__)
+
+
+# ---------------- search space ----------------
+
+class _Sampler:
+    def sample(self, rng):  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class uniform(_Sampler):  # noqa: N801 — mirrors tune.uniform
+    def __init__(self, low, high):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+class loguniform(_Sampler):  # noqa: N801
+    def __init__(self, low, high):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return math.exp(rng.uniform(math.log(self.low),
+                                    math.log(self.high)))
+
+
+class choice(_Sampler):  # noqa: N801
+    def __init__(self, options):
+        self.options = list(options)
+
+    def sample(self, rng):
+        return rng.choice(self.options)
+
+
+class grid_search:  # noqa: N801 — mirrors tune.grid_search
+    def __init__(self, values):
+        self.values = list(values)
+
+
+def _expand_grid(space: dict) -> list[dict]:
+    grids = {k: v.values for k, v in space.items()
+             if isinstance(v, grid_search)}
+    if not grids:
+        return [dict(space)]
+    out = [dict(space)]
+    for key, values in grids.items():
+        nxt = []
+        for base in out:
+            for v in values:
+                c = dict(base)
+                c[key] = v
+                nxt.append(c)
+        out = nxt
+    return out
+
+
+def _sample_config(space: dict, rng) -> dict:
+    cfg = {}
+    for k, v in space.items():
+        if isinstance(v, _Sampler):
+            cfg[k] = v.sample(rng)
+        elif isinstance(v, grid_search):
+            raise AssertionError("grid entries expanded before sampling")
+        else:
+            cfg[k] = v
+    return cfg
+
+
+# ---------------- worker-side report ----------------
+
+def report(metrics: dict, checkpoint=None):
+    """tune.report inside a trainable (reference session.report)."""
+    from ray_tpu.train import session as S
+
+    S.report(metrics, checkpoint=checkpoint)
+
+
+# ---------------- trial actor ----------------
+
+@ray_tpu.remote(num_cpus=1)
+class _TrialActor:
+    """FunctionTrainable host (function_trainable.py:284)."""
+
+    def start(self, fn_blob, config: dict):
+        import threading
+
+        from ray_tpu._private import serialization
+        from ray_tpu.train import session as S
+
+        fn = serialization.unpack_payload(fn_blob)
+        self._sess = S._init_session(world_rank=0, world_size=1)
+        sess = self._sess
+
+        def _run():
+            try:
+                fn(config)
+            except BaseException as e:  # noqa: BLE001
+                sess.error = e
+            finally:
+                sess.finished.set()
+
+        threading.Thread(target=_run, daemon=True,
+                         name="tune-trial").start()
+        return True
+
+    def next_report(self, timeout: float = 5.0):
+        import queue as _q
+
+        sess = self._sess
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                item = sess.results.get(timeout=0.05)
+                return {"type": "report", **item}
+            except _q.Empty:
+                if sess.finished.is_set() and sess.results.empty():
+                    if sess.error is not None:
+                        return {"type": "error", "error": repr(sess.error)}
+                    return {"type": "finished"}
+                if time.monotonic() > deadline:
+                    return {"type": "pending"}
+
+
+# ---------------- scheduler ----------------
+
+class ASHAScheduler:
+    """Async successive halving (schedulers/async_hyperband.py).
+
+    Decision on report t (the trial's iteration count): at each rung
+    r = grace_period * eta^k <= max_t, a trial continues only if its
+    metric is within the top 1/eta of all metrics recorded at that rung
+    so far (async: compares against whatever has arrived)."""
+
+    def __init__(self, *, metric: str | None = None, mode: str = "min",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: int = 3):
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.eta = reduction_factor
+        self.grace = grace_period
+        self.rungs: dict[int, list[float]] = {}
+        r = grace_period
+        while r < max_t:
+            self.rungs[r] = []
+            r *= reduction_factor
+
+    def on_result(self, trial_id: str, iteration: int,
+                  metric_value: float) -> str:
+        """Returns "continue" or "stop"."""
+        if iteration >= self.max_t:
+            return "stop"  # budget exhausted (normal completion)
+        if iteration not in self.rungs:
+            return "continue"
+        vals = self.rungs[iteration]
+        score = metric_value if self.mode == "min" else -metric_value
+        vals.append(score)
+        vals.sort()
+        cutoff_idx = max(0, len(vals) // self.eta - 1) if len(vals) >= \
+            self.eta else None
+        if cutoff_idx is None:
+            return "continue"  # not enough peers yet (async optimism)
+        cutoff = vals[cutoff_idx]
+        return "continue" if score <= cutoff else "stop"
+
+
+# ---------------- results ----------------
+
+@dataclass
+class Result:
+    config: dict
+    metrics: dict | None
+    checkpoint: Any = None
+    error: str | None = None
+    trial_id: str = ""
+
+    @property
+    def metrics_dataframe(self):  # placeholder parity hook
+        return None
+
+
+class ResultGrid:
+    def __init__(self, results: list[Result], metric: str, mode: str):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __iter__(self):
+        return iter(self._results)
+
+    def __getitem__(self, i):
+        return self._results[i]
+
+    def get_best_result(self, metric: str | None = None,
+                        mode: str | None = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        scored = [r for r in self._results
+                  if r.metrics and metric in r.metrics]
+        if not scored:
+            raise ValueError("no trial reported metric " + metric)
+        key = lambda r: r.metrics[metric]  # noqa: E731
+        return (min if mode == "min" else max)(scored, key=key)
+
+
+# ---------------- tuner ----------------
+
+@dataclass
+class TuneConfig:
+    metric: str = "loss"
+    mode: str = "min"
+    num_samples: int = 1
+    max_concurrent_trials: int = 4
+    scheduler: ASHAScheduler | None = None
+    seed: int | None = None
+
+
+class Tuner:
+    """Reference tune/tuner.py Tuner; fit() is the TuneController loop."""
+
+    def __init__(self, trainable: Callable[[dict], Any], *,
+                 param_space: dict | None = None,
+                 tune_config: TuneConfig | None = None):
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.cfg = tune_config or TuneConfig()
+
+    def fit(self) -> ResultGrid:
+        from ray_tpu._private import serialization
+
+        rng = _random.Random(self.cfg.seed)
+        grid_bases = _expand_grid(self.param_space)
+        configs: list[dict] = []
+        for i in range(self.cfg.num_samples):
+            base = grid_bases[i % len(grid_bases)]
+            configs.append(_sample_config(base, rng))
+        # grid search with num_samples=1 still runs the whole grid
+        if len(grid_bases) > 1 and self.cfg.num_samples == 1:
+            configs = [_sample_config(b, rng) for b in grid_bases]
+
+        fn_blob = serialization.pack_callable(self.trainable)
+        sched = self.cfg.scheduler
+        if sched is not None and sched.metric is None:
+            sched.metric = self.cfg.metric
+            sched.mode = self.cfg.mode
+
+        pending = list(enumerate(configs))
+        running: dict[int, dict] = {}  # idx -> {actor, iter, last, ckpt}
+        results: dict[int, Result] = {}
+
+        def _launch(idx, config):
+            actor = _TrialActor.remote()
+            ray_tpu.get(actor.start.remote(fn_blob, config), timeout=120)
+            running[idx] = {"actor": actor, "config": config,
+                            "iteration": 0, "last": None, "ckpt": None}
+
+        def _finish(idx, error=None):
+            st = running.pop(idx)
+            try:
+                ray_tpu.kill(st["actor"])
+            except Exception:  # noqa: BLE001
+                pass
+            results[idx] = Result(
+                config=st["config"], metrics=st["last"],
+                checkpoint=st["ckpt"], error=error,
+                trial_id=f"trial_{idx:04d}",
+            )
+
+        while pending or running:
+            while pending and len(running) < self.cfg.max_concurrent_trials:
+                idx, config = pending.pop(0)
+                _launch(idx, config)
+            # poll all running trials for one report round
+            polls = {
+                idx: st["actor"].next_report.remote(2.0)
+                for idx, st in list(running.items())
+            }
+            for idx, ref in polls.items():
+                try:
+                    res = ray_tpu.get(ref, timeout=60)
+                except (ray_tpu.RayActorError, ray_tpu.RayTaskError) as e:
+                    _finish(idx, error=str(e))
+                    continue
+                st = running.get(idx)
+                if st is None:
+                    continue
+                if res["type"] == "finished":
+                    _finish(idx)
+                elif res["type"] == "error":
+                    _finish(idx, error=res["error"])
+                elif res["type"] == "report":
+                    st["iteration"] += 1
+                    st["last"] = dict(res["metrics"])
+                    st["last"]["training_iteration"] = st["iteration"]
+                    if res.get("checkpoint") is not None:
+                        st["ckpt"] = res["checkpoint"]
+                    if sched is not None:
+                        decision = sched.on_result(
+                            f"trial_{idx:04d}", st["iteration"],
+                            float(res["metrics"][self.cfg.metric]),
+                        )
+                        if decision == "stop":
+                            _finish(idx)
+
+        ordered = [results[i] for i in sorted(results)]
+        return ResultGrid(ordered, self.cfg.metric, self.cfg.mode)

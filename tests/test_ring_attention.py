@@ -1,0 +1,87 @@
+"""Ring attention parity vs attention_reference on the 8-device CPU mesh
+(VERDICT round-1 item 8 'done' bar: match at seq 8k)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.ops.attention import attention_reference
+from ray_tpu.ops.ring_attention import ring_attention
+
+
+@pytest.fixture(scope="module")
+def sp_mesh():
+    devs = np.array(jax.devices()[:8])
+    return Mesh(devs, ("sp",))
+
+
+def _mk(b, t, h, d, hkv=None, seed=0):
+    hkv = hkv or h
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(k1, (b, t, h, d), jnp.float32)
+    k = jax.random.normal(k2, (b, t, hkv, d), jnp.float32)
+    v = jax.random.normal(k3, (b, t, hkv, d), jnp.float32)
+    return q, k, v
+
+
+def _shard(x, mesh):
+    return jax.device_put(x, NamedSharding(mesh, P(None, "sp", None, None)))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_matches_reference(sp_mesh, causal):
+    q, k, v = _mk(2, 64, 2, 16)
+    want = attention_reference(q, k, v, causal=causal)
+    got = ring_attention(
+        _shard(q, sp_mesh), _shard(k, sp_mesh), _shard(v, sp_mesh),
+        sp_mesh, causal=causal,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=1e-4
+    )
+
+
+def test_ring_gqa(sp_mesh):
+    q, k, v = _mk(1, 64, 4, 16, hkv=2)
+    want = attention_reference(q, k, v, causal=True)
+    got = ring_attention(
+        _shard(q, sp_mesh), _shard(k, sp_mesh), _shard(v, sp_mesh),
+        sp_mesh, causal=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=1e-4
+    )
+
+
+def test_ring_seq8k(sp_mesh):
+    """The headline case: 8k sequence over 8 sp shards."""
+    q, k, v = _mk(1, 8192, 1, 8)
+    want = attention_reference(q, k, v, causal=True)
+    got = ring_attention(
+        _shard(q, sp_mesh), _shard(k, sp_mesh), _shard(v, sp_mesh),
+        sp_mesh, causal=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=5e-5, rtol=1e-3
+    )
+
+
+def test_ring_gradients(sp_mesh):
+    q, k, v = _mk(1, 64, 2, 16)
+
+    def f_ring(q, k, v):
+        return ring_attention(q, k, v, sp_mesh, causal=True).sum()
+
+    def f_ref(q, k, v):
+        return attention_reference(q, k, v, causal=True).sum()
+
+    g_ring = jax.grad(f_ring, argnums=(0, 1, 2))(
+        _shard(q, sp_mesh), _shard(k, sp_mesh), _shard(v, sp_mesh)
+    )
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_ring):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), atol=1e-4, rtol=1e-3
+        )

@@ -1,0 +1,158 @@
+"""Serve v0 tests: deploy/route/batch + batched jitted llama decode.
+
+Reference analogs: python/ray/serve/tests/test_standalone.py,
+test_batching.py, scaled to the handle (HTTP-less) data path.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(head_resources={"CPU": 4, "memory": 4 * 2**30})
+    c.connect()
+    yield c
+    serve.shutdown()
+    c.shutdown()
+
+
+def test_deploy_and_route(cluster):
+    @serve.deployment(num_replicas=2, max_concurrent_queries=4)
+    class Echo:
+        def __init__(self, tag):
+            self.tag = tag
+
+        def __call__(self, x):
+            import os
+
+            return (self.tag, x, os.getpid())
+
+    h = serve.run(Echo, name="echo", init_args=("v1",))
+    outs = ray_tpu.get(
+        [h.remote(i) for i in range(20)], timeout=120
+    )
+    assert all(tag == "v1" and x == i for (tag, x, _), i in
+               zip(outs, range(20)))
+    # both replicas served traffic
+    pids = {pid for (_, _, pid) in outs}
+    assert len(pids) == 2
+
+
+def test_redeploy_new_version(cluster):
+    @serve.deployment(num_replicas=1)
+    class V:
+        def __init__(self, v):
+            self.v = v
+
+        def __call__(self, _):
+            return self.v
+
+    h = serve.run(V, name="v", init_args=("one",))
+    assert ray_tpu.get(h.remote(0), timeout=60) == "one"
+    h = serve.run(V, name="v", init_args=("two",), version="2")
+    assert ray_tpu.get(h.remote(0), timeout=60) == "two"
+
+
+def test_method_call(cluster):
+    @serve.deployment(num_replicas=1)
+    class M:
+        def stats(self):
+            return {"ok": True}
+
+    h = serve.run(M, name="m")
+    assert ray_tpu.get(h.method("stats").remote(), timeout=60) == {
+        "ok": True
+    }
+
+
+def test_batching_groups_requests(cluster):
+    @serve.deployment(num_replicas=1, max_concurrent_queries=16)
+    class Batched:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.2)
+        def _handle(self, items):
+            self.batch_sizes.append(len(items))
+            return [i * 10 for i in items]
+
+        def __call__(self, x):
+            return self._handle(x)
+
+        def sizes(self):
+            return self.batch_sizes
+
+    h = serve.run(Batched, name="batched")
+    refs = [h.remote(i) for i in range(16)]
+    outs = ray_tpu.get(refs, timeout=120)
+    assert sorted(outs) == [i * 10 for i in range(16)]
+    sizes = ray_tpu.get(h.method("sizes").remote(), timeout=60)
+    # at least one multi-request batch formed
+    assert max(sizes) > 1
+
+
+def test_serve_llama_decode(cluster):
+    """Replica hosting tiny-llama with a jitted KV-cache decode path,
+    batched requests, p50 latency asserted (VERDICT item 7 'done' bar)."""
+
+    @serve.deployment(num_replicas=1, max_concurrent_queries=16)
+    class LM:
+        def __init__(self):
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+            import jax.numpy as jnp
+
+            from ray_tpu.models import llama
+
+            self.llama = llama
+            self.jnp = jnp
+            self.cfg = llama.LlamaConfig.tiny()
+            self.params = llama.init_params(
+                self.cfg, __import__("jax").random.PRNGKey(0)
+            )
+
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.05)
+        def _generate(self, prompts):
+            import numpy as np
+
+            arr = self.jnp.asarray(np.stack(prompts))
+            out = self.llama.greedy_generate(
+                self.params, arr, self.cfg, max_new_tokens=4
+            )
+            return [np.asarray(o) for o in out]
+
+        def __call__(self, prompt):
+            return self._generate(prompt)
+
+    h = serve.run(LM, name="lm")
+    prompt = np.array([1, 2, 3, 4], dtype=np.int32)
+    # warm (compile)
+    first = ray_tpu.get(h.remote(prompt), timeout=300)
+    assert first.shape == (8,)
+    assert list(first[:4]) == [1, 2, 3, 4]
+
+    lat: list[float] = []
+
+    def one():
+        t0 = time.perf_counter()
+        out = ray_tpu.get(h.remote(prompt), timeout=120)
+        lat.append(time.perf_counter() - t0)
+        assert out.shape == (8,)
+
+    threads = [threading.Thread(target=one) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(lat) == 8
+    p50 = sorted(lat)[len(lat) // 2]
+    assert p50 < 5.0  # CPU tiny-llama, batched: comfortably sub-5s

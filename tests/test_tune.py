@@ -1,0 +1,161 @@
+"""Tune v0 tests: random/grid search, ASHA early stopping, best-trial
+checkpoint restore. Reference analogs: python/ray/tune/tests/test_tune_*.py
+(scaled) per VERDICT round-1 item 10.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.train import Checkpoint
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(head_resources={"CPU": 8, "memory": 4 * 2**30})
+    c.connect()
+    yield c
+    c.shutdown()
+
+
+def test_random_search_finds_good_lr(cluster):
+    """Quadratic bowl: trials with lr nearer 0.3 score better."""
+
+    def trainable(config):
+        lr = config["lr"]
+        loss = (lr - 0.3) ** 2
+        for _ in range(3):
+            tune.report({"loss": loss})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.loguniform(1e-3, 1.0)},
+        tune_config=tune.TuneConfig(
+            metric="loss", mode="min", num_samples=8,
+            max_concurrent_trials=4, seed=7,
+        ),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 8
+    best = grid.get_best_result()
+    assert best.metrics["loss"] == min(
+        r.metrics["loss"] for r in grid if r.metrics
+    )
+
+
+def test_grid_search_runs_every_value(cluster):
+    def trainable(config):
+        tune.report({"loss": config["x"] ** 2, "x": config["x"]})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([-2, -1, 0, 1, 2])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min",
+                                    num_samples=1),
+    )
+    grid = tuner.fit()
+    assert sorted(r.metrics["x"] for r in grid) == [-2, -1, 0, 1, 2]
+    assert grid.get_best_result().metrics["x"] == 0
+
+
+def test_asha_stops_bad_trials_early(cluster):
+    """Bad trials (high loss) must be stopped before max_t reports."""
+
+    def trainable(config):
+        for step in range(20):
+            tune.report({"loss": config["level"] + step * 0.0})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"level": tune.grid_search(
+            [0.1, 0.2, 5.0, 6.0, 7.0, 8.0]
+        )},
+        tune_config=tune.TuneConfig(
+            metric="loss", mode="min", num_samples=1,
+            max_concurrent_trials=6,
+            scheduler=tune.ASHAScheduler(
+                max_t=20, grace_period=2, reduction_factor=2,
+            ),
+        ),
+    )
+    grid = tuner.fit()
+    iters = {r.config["level"]: r.metrics["training_iteration"]
+             for r in grid if r.metrics}
+    # the best trial survived longer than the worst
+    assert iters[0.1] > min(iters[5.0], iters[6.0], iters[7.0], iters[8.0])
+    best = grid.get_best_result()
+    assert best.config["level"] in (0.1, 0.2)
+
+
+def test_tune_tiny_llama_lr_with_checkpoints(cluster, tmp_path):
+    """VERDICT item 10 'done' bar: tune tiny-llama LR over trials; best
+    trial's checkpoint is restorable."""
+
+    def trainable(config):
+        import os
+
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu import tune as T
+        from ray_tpu.models import llama
+        from ray_tpu.train import checkpoint as ckpt_mod
+
+        jax.config.update("jax_platforms", "cpu")
+        cfg = llama.LlamaConfig.tiny()
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        opt = optax.adam(config["lr"])
+        opt_state = opt.init(params)
+        toks = jax.random.randint(
+            jax.random.PRNGKey(1), (4, 33), 0, cfg.vocab_size
+        )
+        batch = {"tokens": toks}
+
+        @jax.jit
+        def step(params, opt_state):
+            (loss, _), grads = jax.value_and_grad(
+                lambda p: llama.loss_fn(p, batch, cfg), has_aux=True
+            )(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        for i in range(4):
+            params, opt_state, loss = step(params, opt_state)
+            path = os.path.join(
+                config["storage"], f"lr{config['lr']:.6f}_step{i}"
+            )
+            ck = ckpt_mod.save_state(
+                {"params": params}, path, process_index=0,
+                extra={"loss": float(loss), "step": i + 1},
+            )
+            T.report({"loss": float(loss)}, checkpoint=ck)
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={
+            "lr": tune.grid_search([1e-4, 1e-3, 1e-2, 3e-2]),
+            "storage": str(tmp_path),
+        },
+        tune_config=tune.TuneConfig(
+            metric="loss", mode="min", num_samples=1,
+            max_concurrent_trials=4,
+        ),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 4
+    best = grid.get_best_result()
+    assert best.checkpoint is not None
+    # restore the winning checkpoint on the driver's single-device "mesh"
+    import jax
+    from jax.sharding import Mesh
+
+    from ray_tpu.train import restore_state
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("d",))
+    state = restore_state(best.checkpoint.path, mesh=mesh)
+    assert "params" in state and "embed" in state["params"]
+    meta = Checkpoint(best.checkpoint.path).to_dict()
+    assert meta["step"] == 4
