@@ -1,0 +1,78 @@
+"""Central config-flag system.
+
+Reference: `src/ray/common/ray_config_def.h:18` — ~200 `RAY_CONFIG(type,
+name, default)` macros overridable via env vars. Same mechanism here:
+every tunable below reads `RAY_TPU_<UPPER_NAME>` at first access, parsed
+to the default's type; `_system_config` dicts passed to `ray_tpu.init`
+override programmatically (propagated head -> workers via env, like the
+reference's GCS-stored system config).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any
+
+_DEFS: dict[str, Any] = {
+    # -- node agent / data plane --
+    "object_transfer_chunk_bytes": 4 * 1024 * 1024,
+    "idle_worker_cull_s": 60.0,          # ray_config_def.h:542 analog
+    "task_spill_max_forwards": 2,
+    "dep_lost_reconstruct_s": 10.0,
+    "spill_high_fraction": 0.8,          # spill primaries above this fill
+    "spill_low_fraction": 0.5,           # ...until back under this
+    "worker_register_timeout_s": 60.0,
+    # -- control plane --
+    "heartbeat_timeout_s": 10.0,
+    "heartbeat_period_fraction": 0.25,
+    # -- core worker --
+    "inline_object_max_bytes": 100 * 1024,
+    "put_pressure_retry_s": 10.0,
+    "fetch_retry_timeout_s": 60.0,
+    # -- memory monitor --
+    "memory_monitor_interval_s": 2.0,
+    "memory_usage_kill_fraction": 0.95,  # memory_monitor.h:52 analog
+}
+
+_cache: dict[str, Any] = {}
+_overrides: dict[str, Any] = {}
+_lock = threading.Lock()
+
+
+def _parse(raw: str, default: Any) -> Any:
+    t = type(default)
+    if t is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    return t(raw)
+
+
+def get(name: str) -> Any:
+    """Flag value: programmatic override > env RAY_TPU_<NAME> > default."""
+    if name not in _DEFS:
+        raise KeyError(f"unknown config flag: {name}")
+    with _lock:
+        if name in _overrides:
+            return _overrides[name]
+        if name in _cache:
+            return _cache[name]
+        default = _DEFS[name]
+        raw = os.environ.get("RAY_TPU_" + name.upper())
+        val = default if raw is None else _parse(raw, default)
+        _cache[name] = val
+        return val
+
+
+def set_system_config(config: dict) -> None:
+    """Programmatic overrides (ray.init(_system_config=...) analog); also
+    exported to env so spawned workers inherit them."""
+    with _lock:
+        for k, v in config.items():
+            if k not in _DEFS:
+                raise KeyError(f"unknown config flag: {k}")
+            _overrides[k] = v
+            os.environ["RAY_TPU_" + k.upper()] = str(v)
+
+
+def all_flags() -> dict[str, Any]:
+    return {k: get(k) for k in _DEFS}

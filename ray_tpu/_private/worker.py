@@ -44,7 +44,9 @@ from ray_tpu.core.object_store import ObjectStoreClient, StoreFullError
 
 logger = logging.getLogger(__name__)
 
-INLINE_MAX = 100 * 1024  # results/args under this ride inline; over → shm
+from ray_tpu._private import config as _config
+
+INLINE_MAX = _config.get("inline_object_max_bytes")  # under: inline; over: shm
 FUNC_NS = "funcs"
 
 
@@ -200,7 +202,7 @@ class CoreWorker:
 
     async def rpc_push_result(self, conn, p):
         """An executor finished a task we own (or serves a borrowed get)."""
-        if p.get("task_id"):
+        if p.get("task_id") and not p.get("partial"):
             self._task_nodes.pop(p["task_id"], None)
             self._release_task_pins(p["task_id"])
         oid = p["object_id"]
@@ -478,7 +480,7 @@ class CoreWorker:
         total = sum(sizes)
         # Under pressure, block briefly for eviction + async GC to free
         # space (reference create_request_queue.cc admission behavior).
-        deadline = time.monotonic() + 10.0
+        deadline = time.monotonic() + _config.get("put_pressure_retry_s")
         while True:
             try:
                 wbuf = self.store.create_object(oid, total, len(table))
@@ -555,10 +557,11 @@ class CoreWorker:
             value = self._read_plasma(oid)
             if value is not None:
                 return value
-            timeout = 60.0 if deadline is None else max(
+            fetch_cap = _config.get("fetch_retry_timeout_s")
+            timeout = fetch_cap if deadline is None else max(
                 0.1, deadline - time.monotonic())
             ok = self.agent.call("fetch_object", {
-                "object_id": oid, "timeout": min(timeout, 60.0),
+                "object_id": oid, "timeout": min(timeout, fetch_cap),
             })
             if not ok:
                 if deadline is not None and time.monotonic() > deadline:

@@ -113,7 +113,7 @@ class NodeInfo:
 class ControlPlane:
     """Composition root — all RPC services of the head node."""
 
-    HEARTBEAT_TIMEOUT_S = 10.0
+    HEARTBEAT_TIMEOUT_S = None  # from config below
 
     def __init__(self, host="127.0.0.1", port=0,
                  heartbeat_timeout_s: float | None = None):
@@ -135,8 +135,12 @@ class ControlPlane:
         # oids freed by GC; straggler add_location for them deletes the copy
         self._freed_tombstones: set[bytes] = set()
         self._agent_clients: dict[bytes, rpc.AsyncRpcClient] = {}
-        if heartbeat_timeout_s is not None:
-            self.HEARTBEAT_TIMEOUT_S = heartbeat_timeout_s
+        from ray_tpu._private import config as cfg
+
+        self.HEARTBEAT_TIMEOUT_S = (
+            heartbeat_timeout_s if heartbeat_timeout_s is not None
+            else cfg.get("heartbeat_timeout_s")
+        )
         self._install_routes()
         self._bg: list[asyncio.Task] = []
 
@@ -685,6 +689,8 @@ class ControlPlane:
             entry["owner"] = p["owner"]
         if p.get("size"):
             entry["size"] = p["size"]
+        if p.get("restored"):
+            entry["spilled"] = None  # live again; spill file was consumed
         for ev in self.object_waiters.pop(oid, []):
             ev.set()
         return True
@@ -724,12 +730,29 @@ class ControlPlane:
         return None
 
     async def rpc_object_spilled(self, conn, p):
+        oid = p["object_id"]
+        if oid in self._freed_tombstones:
+            # freed while the spill was in flight: delete the file too
+            try:
+                node_id = bytes.fromhex(
+                    p["url"].split("//", 1)[1].split("/", 1)[0]
+                )
+            except (ValueError, IndexError):
+                return True
+            agent = await self._agent(node_id)
+            if agent is not None:
+                try:
+                    await agent.call("free_objects", {"object_ids": [oid]})
+                except (rpc.RpcError, rpc.ConnectionLost):
+                    pass
+            return True
         entry = self.objects.setdefault(
-            p["object_id"],
-            {"locations": set(), "owner": None, "size": 0, "spilled": None},
+            oid,
+            {"locations": set(), "owner": None, "size": 0, "spilled": None,
+             "refs": set()},
         )
         entry["spilled"] = p["url"]
-        for ev in self.object_waiters.pop(p["object_id"], []):
+        for ev in self.object_waiters.pop(oid, []):
             ev.set()
         return True
 
@@ -793,7 +816,17 @@ class ControlPlane:
             self._freed_tombstones.clear()  # bounded; stale stragglers rare
         if entry is None:
             return
-        for node_id in list(entry["locations"]):
+        targets = set(entry["locations"])
+        if entry.get("spilled"):
+            # spilled copies live on the spilling node's disk, which is no
+            # longer in locations — free the file there too
+            try:
+                targets.add(bytes.fromhex(
+                    entry["spilled"].split("//", 1)[1].split("/", 1)[0]
+                ))
+            except (ValueError, IndexError):
+                pass
+        for node_id in targets:
             agent = await self._agent(node_id)
             if agent is not None:
                 try:
@@ -824,7 +857,12 @@ class ControlPlane:
 
     async def _health_loop(self):
         while True:
-            await asyncio.sleep(self.HEARTBEAT_TIMEOUT_S / 4)
+            from ray_tpu._private import config as _cfg
+
+            await asyncio.sleep(
+                self.HEARTBEAT_TIMEOUT_S
+                * _cfg.get("heartbeat_period_fraction")
+            )
             now = time.monotonic()
             for node in list(self.nodes.values()):
                 if node.alive and (

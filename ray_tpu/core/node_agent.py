@@ -29,20 +29,24 @@ import logging
 import os
 import subprocess
 import sys
+import tempfile
 import time
 from collections import deque
 from typing import Any
 
+from ray_tpu._private import config as cfg
 from ray_tpu._private import rpc
 from ray_tpu._private.rpc import AsyncRpcClient, RpcServer
 from ray_tpu.core.object_store import ObjectStoreClient
 
 logger = logging.getLogger(__name__)
 
-CHUNK = 4 * 1024 * 1024
-IDLE_CULL_S = 60.0
-SPILL_MAX = 2  # max times a task may be forwarded before it must run
-DEP_LOST_S = 10.0  # fetch wait before asking the owner to reconstruct
+# Tunables ride the central flag system (ray_config_def.h analog); env
+# RAY_TPU_<NAME> overrides each.
+CHUNK = cfg.get("object_transfer_chunk_bytes")
+IDLE_CULL_S = cfg.get("idle_worker_cull_s")
+SPILL_MAX = cfg.get("task_spill_max_forwards")
+DEP_LOST_S = cfg.get("dep_lost_reconstruct_s")
 
 
 def detect_resources() -> dict:
@@ -113,9 +117,22 @@ class NodeAgent:
         self.bundle_available: dict[tuple[bytes, int], dict] = {}
         self._peer_clients: dict[bytes, AsyncRpcClient] = {}
         self._pulls_inflight: dict[bytes, asyncio.Future] = {}
+        # Spilling state (reference local_object_manager.h:110 SpillObjects
+        # + external_storage.py:246 FileSystemStorage): pinned primaries in
+        # seal order (the spill queue) and oid -> spill file for restores.
+        self.primaries: dict[bytes, int] = {}  # oid -> size, insert-ordered
+        self.spilled_files: dict[bytes, str] = {}
+        self.spill_dir = os.path.join(
+            tempfile.gettempdir(),
+            f"ray_tpu_spill_{self.session_id}_{self.node_id.hex()[:8]}",
+        )
+        self._spilling = False
         self._bg: list[asyncio.Task] = []
         self._install_routes()
         self._dead = False
+
+    SPILL_HIGH = cfg.get("spill_high_fraction")
+    SPILL_LOW = cfg.get("spill_low_fraction")
 
     # ---------------- lifecycle ----------------
 
@@ -144,6 +161,7 @@ class NodeAgent:
         self._bg.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._bg.append(asyncio.ensure_future(self._reap_loop()))
         self._bg.append(asyncio.ensure_future(self._dispatch_loop()))
+        self._bg.append(asyncio.ensure_future(self._memory_monitor_loop()))
         logger.info("node agent %s up on %s:%s", self.node_id.hex()[:8],
                     self.host, port)
         return port
@@ -680,7 +698,10 @@ class NodeAgent:
             w = await self._spawn_worker(
                 p.get("job_id"), holds_tpu=need.get("TPU", 0) > 0
             )
-            await asyncio.wait_for(w.ready.wait(), timeout=60.0)
+            await asyncio.wait_for(
+                w.ready.wait(),
+                timeout=cfg.get("worker_register_timeout_s"),
+            )
             w.actor_id = p["actor_id"]
             w.actor_resources = need
             w.actor_bundle = bundle_key
@@ -801,6 +822,24 @@ class NodeAgent:
                 return False
             if self.node_id in info["locations"]:
                 return True  # a local writer beat us to it
+            if not info["locations"] and info.get("spilled"):
+                # only a spilled copy exists: ask the spilling node to
+                # restore it, then loop to pull the live copy
+                spill_node = bytes.fromhex(
+                    info["spilled"].split("//", 1)[1].split("/", 1)[0]
+                )
+                if spill_node == self.node_id:
+                    await self.rpc_restore_object(None, {"object_id": oid})
+                else:
+                    cli = await self._peer_agent(spill_node)
+                    if cli is not None:
+                        try:
+                            await cli.call("restore_object",
+                                           {"object_id": oid})
+                        except (rpc.ConnectionLost, rpc.RpcError):
+                            pass
+                await asyncio.sleep(0.05)
+                continue
             pulled = False
             for nid in info["locations"]:
                 cli = await self._peer_agent(nid)
@@ -855,24 +894,184 @@ class NodeAgent:
     async def rpc_object_sealed(self, conn, p):
         """Local worker sealed an object: register location + pin primary."""
         oid = p["object_id"]
-        self.store.pin(oid, True)  # primary copy: spill not evict (later)
+        self.store.pin(oid, True)  # primary copy: spilled, never evicted
+        self.primaries[oid] = p.get("size", 0)
         await self.head.call("object_add_location", {
             "object_id": oid, "node_id": self.node_id,
             "owner": p.get("owner"), "size": p.get("size", 0),
         })
         self._kick_dispatch()
+        self._maybe_spill()
         return True
 
     async def rpc_free_objects(self, conn, p):
         for oid in p["object_ids"]:
             self.store.pin(oid, False)
             self.store.delete(oid)
+            self.primaries.pop(oid, None)
+            path = self.spilled_files.pop(oid, None)
+            if path is not None:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
             try:
                 await self.head.call("object_remove_location", {
                     "object_id": oid, "node_id": self.node_id,
                 })
             except (rpc.ConnectionLost, rpc.RpcError):
                 pass
+        return True
+
+    # ---------------- memory monitor ----------------
+    # reference: common/memory_monitor.h:52 + raylet worker-killing
+    # policies (worker_killing_policy.h): above the usage threshold, kill
+    # the newest retriable (task) worker first — its owner retries the
+    # task; actor workers only as a last resort.
+
+    async def _memory_monitor_loop(self):
+        interval = cfg.get("memory_monitor_interval_s")
+        while not self._dead:
+            await asyncio.sleep(interval)
+            try:
+                await self._oom_kill_if_needed()
+            except Exception:  # noqa: BLE001 — monitor must not die
+                logger.exception("memory monitor error")
+
+    async def _oom_kill_if_needed(self) -> bool:
+        import psutil
+
+        frac = psutil.virtual_memory().percent / 100.0
+        if frac <= cfg.get("memory_usage_kill_fraction"):
+            return False
+        return await self._oom_kill_once(frac)
+
+    async def _oom_kill_once(self, frac: float = 1.0) -> bool:
+        """Kill the newest task worker (retriable-FIFO policy)."""
+        candidates = [w for w in self.workers.values()
+                      if w.busy_task is not None and w.actor_id is None]
+        if not candidates:
+            candidates = [w for w in self.workers.values()
+                          if w.actor_id is not None]
+        if not candidates:
+            return False
+        victim = max(candidates, key=lambda w: w.started_at)
+        logger.warning(
+            "memory pressure (%.0f%%): killing newest worker %s (task %s)",
+            frac * 100, victim.worker_id.hex()[:8],
+            victim.busy_task.hex()[:8] if victim.busy_task else "-",
+        )
+        self._kill_worker(victim)
+        await self._on_worker_death(victim, -9)
+        return True
+
+    # ---------------- spilling ----------------
+    # reference: local_object_manager.h:110 SpillObjects /
+    # :122 AsyncRestoreSpilledObject; IO here is node-local files (the
+    # FileSystemStorage analog), URLs carry the owning node id so any
+    # agent can route a restore request.
+
+    def _maybe_spill(self):
+        cap = self.store.capacity()
+        if cap <= 0 or self._spilling:
+            return
+        if self.store.used_bytes() > self.SPILL_HIGH * cap:
+            self._spilling = True
+            asyncio.ensure_future(self._spill_until_low())
+
+    async def _spill_until_low(self):
+        try:
+            cap = self.store.capacity()
+            target = self.SPILL_LOW * cap
+            # oldest primaries first (insertion order = seal order)
+            for oid in list(self.primaries):
+                if self.store.used_bytes() <= target:
+                    break
+                await self._spill_one(oid)
+        finally:
+            self._spilling = False
+
+    async def _spill_one(self, oid: bytes) -> bool:
+        buf = self.store.get(oid)
+        if buf is None:
+            self.primaries.pop(oid, None)
+            return False
+        try:
+            os.makedirs(self.spill_dir, exist_ok=True)
+            path = os.path.join(self.spill_dir, oid.hex())
+            meta = bytes(buf.metadata)
+            size = len(buf.data)
+            with open(path, "wb") as f:
+                f.write(len(meta).to_bytes(8, "little"))
+                f.write(meta)
+                f.write(buf.data)
+        finally:
+            buf.release()
+        self.spilled_files[oid] = path
+        url = f"file://{self.node_id.hex()}/{path}"
+        try:
+            await self.head.call("object_spilled",
+                                 {"object_id": oid, "url": url})
+            await self.head.call("object_remove_location", {
+                "object_id": oid, "node_id": self.node_id,
+            })
+        except (rpc.ConnectionLost, rpc.RpcError):
+            pass
+        self.primaries.pop(oid, None)
+        self.store.pin(oid, False)
+        self.store.delete(oid)
+        logger.info("spilled %s (%d bytes) to %s", oid.hex()[:12], size, path)
+        return True
+
+    async def rpc_restore_object(self, conn, p):
+        """Reload a spilled object into the local store (restore path)."""
+        oid = p["object_id"]
+        if self.store.contains(oid):
+            return True
+        path = self.spilled_files.get(oid)
+        if path is None:
+            return False
+        try:
+            with open(path, "rb") as f:
+                meta_len = int.from_bytes(f.read(8), "little")
+                meta = f.read(meta_len)
+                data = f.read()
+        except OSError:
+            return False
+        need = len(data) + len(meta)
+        stored = False
+        for _ in range(len(self.primaries) + 2):
+            try:
+                self.store.put_bytes(oid, data, metadata=meta)
+                stored = True
+                break
+            except Exception:
+                # store full: evict unpinned copies, then swap out other
+                # primaries (spill) until the restore fits
+                self.store.evict(need)
+                swapped = False
+                for other in list(self.primaries):
+                    if other != oid:
+                        swapped = await self._spill_one(other)
+                        if swapped:
+                            break
+                if not swapped:
+                    break
+        if not stored:
+            # keep the spill file: the object is still recoverable later
+            return False
+        self.store.pin(oid, True)
+        self.primaries[oid] = len(data)
+        self.spilled_files.pop(oid, None)
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        await self.head.call("object_add_location", {
+            "object_id": oid, "node_id": self.node_id,
+            "restored": True,
+        })
+        self._kick_dispatch()
         return True
 
     async def rpc_node_info(self, conn, p):

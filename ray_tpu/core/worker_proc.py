@@ -216,7 +216,10 @@ class Executor(CoreWorker):
             oid = ObjectID.for_task_return(
                 TaskID(task_id), len(oids) + 1
             ).binary()
-            self._push_one(cli, spec, oid, value=value)
+            # partial: the generator is still running — the owner must not
+            # release submitted-task pins or in-flight tracking yet
+            self._push_one(cli, spec, oid, value=value,
+                           extra={"partial": True})
             oids.append(oid)
         desc = ObjectID.for_task_return(TaskID(task_id), 0).binary()
         # dynamic_items lets the owner register descriptor->items nesting

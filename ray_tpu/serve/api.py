@@ -271,24 +271,37 @@ class _HandleMethod:
 
     def remote(self, *args, **kwargs):
         h = self._h
-        idx = h._assign()
-        try:
-            replica = h._replicas[idx]
-            ref = replica.handle_request.remote(self._method, list(args),
-                                                kwargs)
-        except Exception:
-            h._done(idx)
-            raise
-        _track_completion(h, idx, ref)
-        return ref
+        for attempt in (0, 1):
+            idx = h._assign()
+            try:
+                replica = h._replicas[idx]
+                ref = replica.handle_request.remote(self._method,
+                                                    list(args), kwargs)
+            except Exception:
+                h._done(idx)
+                if attempt == 0:
+                    # replicas may have been rolled by a redeploy: refresh
+                    # the routing table once and retry
+                    h._refresh()
+                    continue
+                raise
+            _track_completion(h, idx, ref)
+            return ref
 
 
 def _track_completion(handle: DeploymentHandle, idx: int, ref):
-    """Decrement the in-flight count when the reply lands, off-thread."""
+    """Decrement the in-flight count when the reply actually lands (not on
+    a wait timeout — a still-running request must keep holding its
+    max_concurrent_queries slot), off-thread."""
 
     def _waiter():
         try:
-            ray_tpu.wait([ref], num_returns=1, timeout=600)
+            while True:
+                ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=60)
+                if ready:
+                    return
+        except Exception:  # noqa: BLE001 — replica died; slot comes back
+            pass
         finally:
             handle._done(idx)
 

@@ -297,6 +297,15 @@ class Tuner:
                 trial_id=f"trial_{idx:04d}",
             )
 
+        try:
+            self._drive(pending, running, results, sched, _launch, _finish)
+        finally:
+            for idx in list(running):
+                _finish(idx, error="tuner aborted")
+        ordered = [results[i] for i in sorted(results)]
+        return ResultGrid(ordered, self.cfg.metric, self.cfg.mode)
+
+    def _drive(self, pending, running, results, sched, _launch, _finish):
         while pending or running:
             while pending and len(running) < self.cfg.max_concurrent_trials:
                 idx, config = pending.pop(0)
@@ -325,13 +334,11 @@ class Tuner:
                     st["last"]["training_iteration"] = st["iteration"]
                     if res.get("checkpoint") is not None:
                         st["ckpt"] = res["checkpoint"]
-                    if sched is not None:
+                    metric_val = res["metrics"].get(self.cfg.metric)
+                    if sched is not None and metric_val is not None:
                         decision = sched.on_result(
                             f"trial_{idx:04d}", st["iteration"],
-                            float(res["metrics"][self.cfg.metric]),
+                            float(metric_val),
                         )
                         if decision == "stop":
                             _finish(idx)
-
-        ordered = [results[i] for i in sorted(results)]
-        return ResultGrid(ordered, self.cfg.metric, self.cfg.mode)

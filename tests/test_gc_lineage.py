@@ -154,3 +154,41 @@ def test_lineage_chain_reconstruction(cluster2):
     out = consume.remote(dep)
     expected = float(np.arange(500_000, dtype=np.float64).sum())
     assert ray_tpu.get(out, timeout=120) == expected
+
+
+def test_spill_and_restore_2x_capacity(cluster):
+    """VERDICT round-1 item 9 'done' bar: put 2x store capacity while
+    KEEPING every ref (no GC eligible); primaries spill to disk and every
+    object reads back intact."""
+    refs = []
+    for i in range(16):  # 16 x 8 MiB = 128 MiB in a 64 MiB store
+        refs.append(ray_tpu.put(np.full(MB, i, dtype=np.float64)))
+    agent = cluster.head_agent
+    deadline = time.time() + 30
+    while time.time() < deadline and not agent.spilled_files:
+        time.sleep(0.2)
+    assert agent.spilled_files, "store pressure never triggered spilling"
+    # every object restores, including spilled ones
+    for i, r in enumerate(refs):
+        out = ray_tpu.get(r, timeout=60)
+        assert out[0] == float(i) and out[-1] == float(i)
+
+
+def test_spilled_object_freed_on_gc(cluster):
+    """Dropping refs to a spilled object removes its spill file."""
+    refs = [ray_tpu.put(np.full(MB, i, dtype=np.float64))
+            for i in range(16)]
+    agent = cluster.head_agent
+    deadline = time.time() + 30
+    while time.time() < deadline and not agent.spilled_files:
+        time.sleep(0.2)
+    assert agent.spilled_files
+    import os
+
+    paths = list(agent.spilled_files.values())
+    del refs
+    gc.collect()
+    deadline = time.time() + 20
+    while time.time() < deadline and any(os.path.exists(p) for p in paths):
+        time.sleep(0.2)
+    assert not any(os.path.exists(p) for p in paths)

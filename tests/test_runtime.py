@@ -260,3 +260,42 @@ def test_detached_actor_outlives_job(cluster):
 def test_cluster_resources(cluster):
     total = ray_tpu.cluster_resources()
     assert total.get("CPU", 0) >= 4
+
+
+def test_config_flags_env_override():
+    from ray_tpu._private import config as cfg
+
+    assert cfg.get("task_spill_max_forwards") == 2
+    flags = cfg.all_flags()
+    assert "heartbeat_timeout_s" in flags
+    import pytest as _pytest
+
+    with _pytest.raises(KeyError):
+        cfg.get("not_a_flag")
+
+
+def test_memory_monitor_kills_newest_task_worker(cluster):
+    """OOM policy: the newest task worker dies; max_retries reruns the
+    task (reference worker_killing_policy retriable-FIFO)."""
+    import asyncio
+
+    @ray_tpu.remote(num_cpus=1, max_retries=2)
+    def slowish():
+        import time as _t
+
+        _t.sleep(8)
+        return "done"
+
+    ref = slowish.remote()
+    agent = cluster.head_agent
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if any(w.busy_task for w in agent.workers.values()):
+            break
+        time.sleep(0.1)
+    fut = asyncio.run_coroutine_threadsafe(
+        agent._oom_kill_once(), cluster.io.loop
+    )
+    assert fut.result(timeout=10) is True
+    # retried on a fresh worker and completes
+    assert ray_tpu.get(ref, timeout=60) == "done"
