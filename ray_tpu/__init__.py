@@ -32,6 +32,10 @@ _RUNTIME_API = (
     "available_resources",
     "cluster_resources",
     "nodes",
+    "timeline",
+    "list_tasks",
+    "list_objects",
+    "list_actors",
     "placement_group",
     "remove_placement_group",
     "PlacementGroup",

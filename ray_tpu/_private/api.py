@@ -214,7 +214,7 @@ def init(address: str | None = None, *, num_cpus: float | None = None,
             is_driver=True,
         )
         worker.namespace = namespace
-        worker.head.call("register_job", {
+        worker.register_job({
             "job_id": job_id,
             "driver_addr": [worker.addr, worker.port],
         })
@@ -261,7 +261,8 @@ class RemoteFunction:
     """Reference: remote_function.py:241 RemoteFunction._remote."""
 
     def __init__(self, func, *, num_returns=1, num_cpus=1.0, num_tpus=0.0,
-                 resources=None, max_retries=3, scheduling_strategy=None):
+                 resources=None, max_retries=3, scheduling_strategy=None,
+                 runtime_env=None):
         self._func = func
         self._opts = {
             "num_returns": num_returns,
@@ -270,6 +271,7 @@ class RemoteFunction:
             "resources": resources or {},
             "max_retries": max_retries,
             "scheduling_strategy": scheduling_strategy,
+            "runtime_env": runtime_env,
         }
         self.__name__ = getattr(func, "__name__", "remote_function")
 
@@ -308,6 +310,7 @@ class RemoteFunction:
             num_returns=o["num_returns"], resources=res,
             retries=o["max_retries"],
             scheduling_strategy=o["scheduling_strategy"],
+            runtime_env=o.get("runtime_env"),
             name=o.get("name", self.__name__), **pg_kw,
         )
         refs = [ObjectRef(i) for i in ids]
@@ -384,7 +387,7 @@ class ActorHandle:
 
 class ActorClass:
     def __init__(self, cls, *, num_cpus=1.0, num_tpus=0.0, resources=None,
-                 max_restarts=0, max_concurrency=1):
+                 max_restarts=0, max_concurrency=1, runtime_env=None):
         self._cls = cls
         self._opts = {
             "num_cpus": num_cpus, "num_tpus": num_tpus,
@@ -392,6 +395,7 @@ class ActorClass:
             "max_concurrency": max_concurrency, "name": None,
             "namespace": None, "lifetime": None, "get_if_exists": False,
             "placement_group": None, "placement_group_bundle_index": -1,
+            "runtime_env": runtime_env,
         }
 
     def options(self, **kw) -> "ActorClass":
@@ -421,6 +425,7 @@ class ActorClass:
             bundle_index=o["placement_group_bundle_index"],
             max_concurrency=o["max_concurrency"],
             get_if_exists=o["get_if_exists"],
+            runtime_env=o.get("runtime_env"),
         )
         owns = o["name"] is None and o["lifetime"] != "detached" \
             and not reply.get("existing")
@@ -446,6 +451,7 @@ def remote(*args, **kwargs):
                 resources=kwargs.get("resources"),
                 max_restarts=kwargs.get("max_restarts", 0),
                 max_concurrency=kwargs.get("max_concurrency", 1),
+                runtime_env=kwargs.get("runtime_env"),
             )
         return RemoteFunction(
             target,
@@ -455,6 +461,7 @@ def remote(*args, **kwargs):
             resources=kwargs.get("resources"),
             max_retries=kwargs.get("max_retries", 3),
             scheduling_strategy=kwargs.get("scheduling_strategy"),
+            runtime_env=kwargs.get("runtime_env"),
         )
 
     if len(args) == 1 and callable(args[0]) and not kwargs:
@@ -623,8 +630,48 @@ def nodes() -> list[dict]:
     return _get_worker().head.call("get_cluster_view", {})["nodes"]
 
 
-def timeline() -> list:
-    return []  # profile-event plumbing lands with the observability pass
+def list_tasks(limit: int = 10_000) -> list[dict]:
+    """Task lifecycle events (reference state API `ray list tasks` +
+    gcs_task_manager.h:61 event store)."""
+    w = _get_worker()
+    return w.head.call("list_task_events", {"limit": limit})
+
+
+def list_objects(limit: int = 1000) -> list[dict]:
+    """Cluster object directory entries (`ray list objects` analog)."""
+    w = _get_worker()
+    return w.head.call("list_objects", {"limit": limit})
+
+
+def list_actors() -> list[dict]:
+    w = _get_worker()
+    return w.head.call("list_actors", {})
+
+
+def timeline(filename: str | None = None) -> list:
+    """Chrome-trace events from the task-event store (reference
+    _private/profiling.py:123 chrome_tracing_dump). Load the result in
+    chrome://tracing or Perfetto; pid = node, tid = worker."""
+    events = list_tasks()
+    trace = []
+    for ev in events:
+        trace.append({
+            "name": ev.get("name", "task"),
+            "cat": "task",
+            "ph": "X",  # complete event
+            "ts": ev["start_s"] * 1e6,
+            "dur": max(0.0, (ev["end_s"] - ev["start_s"]) * 1e6),
+            "pid": ev["node_id"].hex()[:8],
+            "tid": ev["worker_id"].hex()[:8],
+            "args": {"state": ev.get("state"),
+                     "task_id": ev["task_id"].hex()},
+        })
+    if filename:
+        import json
+
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace
 
 
 __all__ = [
@@ -632,6 +679,6 @@ __all__ = [
     "wait", "kill", "cancel", "get_actor", "free", "ObjectRef",
     "ActorHandle", "PlacementGroup", "placement_group",
     "remove_placement_group", "cluster_resources", "available_resources",
-    "nodes", "RayTaskError", "RayActorError", "GetTimeoutError",
-    "ObjectLostError",
+    "nodes", "timeline", "list_tasks", "list_objects", "list_actors",
+    "RayTaskError", "RayActorError", "GetTimeoutError", "ObjectLostError",
 ]

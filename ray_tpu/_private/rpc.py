@@ -338,15 +338,57 @@ class EventLoopThread:
 
 
 class SyncRpcClient:
-    """Blocking facade over AsyncRpcClient via an EventLoopThread."""
+    """Blocking facade over AsyncRpcClient via an EventLoopThread.
 
-    def __init__(self, host: str, port: int, io: EventLoopThread):
+    Reconnects transparently: if the server restarts (head fault tolerance,
+    reference NotifyGCSRestart flow), the next call dials a fresh
+    connection, replays push subscriptions, and invokes `on_reconnect`
+    (used by CoreWorker to re-register/re-subscribe)."""
+
+    def __init__(self, host: str, port: int, io: EventLoopThread,
+                 reconnect: bool = False):
         self.io = io
+        self._host, self._port = host, port
+        self._reconnect_enabled = reconnect
+        self._reconnect_lock = threading.Lock()
+        self._push: dict[str, Any] = {}
+        self.on_reconnect = None  # callable run (on caller thread) after
         self.client = AsyncRpcClient(host, port)
         io.run(self.client.connect())
 
+    def _try_reconnect(self) -> bool:
+        if not self._reconnect_enabled:
+            return False
+        with self._reconnect_lock:
+            if not self.client.closed:
+                return True  # another thread already reconnected
+            try:
+                cli = AsyncRpcClient(self._host, self._port)
+                self.io.run(cli.connect(retries=50, delay=0.2))
+            except ConnectionLost:
+                return False
+            for channel, fn in self._push.items():
+                cli.on_push(channel, fn)
+            self.client = cli
+            cb = self.on_reconnect
+            if cb is not None:
+                try:
+                    cb()
+                except Exception:  # noqa: BLE001
+                    logger.exception("on_reconnect callback failed")
+            return True
+
     def call(self, method: str, payload: Any = None, timeout=None) -> Any:
-        return self.io.run(self.client.call(method, payload, timeout=timeout))
+        try:
+            return self.io.run(
+                self.client.call(method, payload, timeout=timeout)
+            )
+        except ConnectionLost:
+            if not self._try_reconnect():
+                raise
+            return self.io.run(
+                self.client.call(method, payload, timeout=timeout)
+            )
 
     def oneway(self, method: str, payload: Any = None):
         return self.io.run(self.client.oneway(method, payload))
@@ -359,9 +401,11 @@ class SyncRpcClient:
             self.io.submit(self.client.oneway(method, payload))
 
     def on_push(self, channel: str, fn):
+        self._push[channel] = fn
         self.client.on_push(channel, fn)
 
     def close(self):
+        self._reconnect_enabled = False
         # Safe from any thread, including the IO loop itself (push
         # callbacks): never block the loop waiting on its own work.
         if threading.current_thread() is self.io.thread:

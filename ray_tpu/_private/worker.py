@@ -119,7 +119,8 @@ class CoreWorker:
         self.node_id = node_id
         self.is_driver = is_driver
         self.io = EventLoopThread("ray_tpu-worker-io")
-        self.head = rpc.SyncRpcClient(head_addr, head_port, self.io)
+        self.head = rpc.SyncRpcClient(head_addr, head_port, self.io,
+                                      reconnect=True)
         self.agent = rpc.SyncRpcClient(agent_addr, agent_port, self.io)
         self.store = ObjectStoreClient.attach(store_name)
         self.memory: dict[bytes, _ResultEntry] = {}
@@ -153,6 +154,9 @@ class CoreWorker:
         self._task_nodes: dict[bytes, bytes] = {}
         self.head.on_push("node_dead", self._on_node_dead)
         self.head.call("subscribe", {"channel": "node_dead"})
+        # Head restart (GCS FT): the SyncRpcClient reconnects transparently;
+        # we must re-register and re-subscribe on the fresh connection.
+        self.head.on_reconnect = self._resync_head
         # Reference counting (reference_count.h:61 semantics, centralized):
         # per-oid local count; 0<->1 transitions reported to the directory,
         # which frees cluster copies when no process holds a reference.
@@ -161,6 +165,34 @@ class CoreWorker:
         # task_id -> dep oids pinned for the task's lifetime (submitted-task
         # references, reference_count.h:115)
         self._task_pins: dict[bytes, list[bytes]] = {}
+        self._job_payload: dict | None = None
+
+    def _resync_head(self):
+        try:
+            self.head.call("register_worker", {
+                "worker_id": self.worker_id, "node_id": self.node_id,
+                "addr": self.addr, "port": self.port, "job_id": self.job_id,
+            })
+            for ch in ("actor_update", "node_dead"):
+                self.head.call("subscribe", {"channel": ch})
+            if self._job_payload is not None:
+                # restore is_driver/job conn state on the fresh head
+                self.head.call("register_job", self._job_payload)
+            # replay our live references: the rebuilt directory must not
+            # GC objects this process still holds
+            with self._refs_lock:
+                held = list(self._local_refs)
+            for oid in held:
+                self.head.fire("ref_add", {
+                    "object_id": oid, "worker_id": self.worker_id,
+                })
+        except (rpc.ConnectionLost, rpc.RpcError):
+            pass
+
+    def register_job(self, payload: dict):
+        """Register the driver's job; remembered for head-restart resync."""
+        self._job_payload = payload
+        self.head.call("register_job", payload)
 
     # ------------- helpers -------------
 
@@ -713,7 +745,8 @@ class CoreWorker:
                     num_returns: int = 1, resources: dict | None = None,
                     retries: int = 3, pg_id: bytes | None = None,
                     bundle_index: int = -1, bundle_nodes: list | None = None,
-                    scheduling_strategy=None, name: str = "") -> list[bytes]:
+                    scheduling_strategy=None, runtime_env: dict | None = None,
+                    name: str = "") -> list[bytes]:
         func_id = self.export_function(func)
         # parent chain: drivers are roots; executor-submitted tasks chain
         # through their own worker ids via the counter namespace
@@ -741,6 +774,8 @@ class CoreWorker:
             spec["bundle_nodes"] = bundle_nodes or []
         if scheduling_strategy is not None:
             spec["scheduling_strategy"] = scheduling_strategy
+        if runtime_env:
+            spec["runtime_env"] = runtime_env
         n_ret = 1 if num_returns == "dynamic" else num_returns
         return_ids = [
             ObjectID.for_task_return(TaskID(task_id), i).binary()
@@ -802,7 +837,8 @@ class CoreWorker:
                        name=None, namespace="default", detached=False,
                        max_restarts=0, resources=None, pg_id=None,
                        bundle_index=-1, max_concurrency=1,
-                       get_if_exists=False) -> dict:
+                       get_if_exists=False,
+                       runtime_env: dict | None = None) -> dict:
         spec = serialization.pack_payload((cls, args, kwargs))
         reply = self.head.call("register_actor", {
             "actor_id": actor_id, "job_id": self.job_id,
@@ -813,6 +849,7 @@ class CoreWorker:
             "pg_id": pg_id, "bundle_index": bundle_index,
             "max_concurrency": max_concurrency,
             "get_if_exists": get_if_exists,
+            "runtime_env": runtime_env,
         })
         return reply
 

@@ -20,14 +20,20 @@ from ray_tpu._private.worker import CoreWorker
 class Cluster:
     def __init__(self, *, head_resources: dict | None = None,
                  store_capacity: int = 256 * 1024 * 1024,
-                 heartbeat_timeout_s: float = 3.0):
+                 heartbeat_timeout_s: float = 3.0,
+                 persist_path: str | None = None):
         from ray_tpu.core.control_plane import ControlPlane
         from ray_tpu.core.node_agent import NodeAgent
 
         self.io = EventLoopThread("ray_tpu-test-cluster")
         self.session_id = os.urandom(4).hex()
         self.store_capacity = store_capacity
-        self.cp = ControlPlane(heartbeat_timeout_s=heartbeat_timeout_s)
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.persist_path = persist_path
+        self.cp = ControlPlane(
+            heartbeat_timeout_s=heartbeat_timeout_s,
+            persist_path=persist_path,
+        )
         self.head_port = self.io.run(self.cp.start())
         self.agents: list = []
         self.head_agent = self.add_node(
@@ -63,13 +69,32 @@ class Cluster:
             job_id=JobID.from_random().binary(), is_driver=True,
         )
         worker.namespace = namespace
-        worker.head.call("register_job", {
+        worker.register_job({
             "job_id": worker.job_id,
             "driver_addr": [worker.addr, worker.port],
         })
         api._set_global_worker(worker)
         self._driver = worker
         return worker
+
+    def restart_head(self):
+        """Kill + restart the control plane on the same port (GCS fault
+        tolerance test hook, reference test_gcs_fault_tolerance.py).
+        State reloads from persist_path; agents and the driver reconnect."""
+        from ray_tpu.core.control_plane import ControlPlane
+
+        host_port = self.head_port
+        try:
+            self.io.run(self.cp.stop(), timeout=10)
+        except Exception:
+            pass
+        self.cp = ControlPlane(
+            port=host_port,
+            heartbeat_timeout_s=self.heartbeat_timeout_s,
+            persist_path=self.persist_path,
+        )
+        self.head_port = self.io.run(self.cp.start())
+        assert self.head_port == host_port
 
     def shutdown(self):
         if self._driver is not None:

@@ -116,7 +116,8 @@ class ControlPlane:
     HEARTBEAT_TIMEOUT_S = None  # from config below
 
     def __init__(self, host="127.0.0.1", port=0,
-                 heartbeat_timeout_s: float | None = None):
+                 heartbeat_timeout_s: float | None = None,
+                 persist_path: str | None = None):
         self.server = RpcServer(host, port)
         self.kv = KvManager()
         self.pub = Publisher()
@@ -134,6 +135,10 @@ class ControlPlane:
         self.object_waiters: dict[bytes, list[asyncio.Event]] = {}
         # oids freed by GC; straggler add_location for them deletes the copy
         self._freed_tombstones: set[bytes] = set()
+        # bounded task-event store (gcs_task_manager.h:61 ring buffer)
+        import collections
+
+        self.task_events: collections.deque = collections.deque(maxlen=50_000)
         self._agent_clients: dict[bytes, rpc.AsyncRpcClient] = {}
         from ray_tpu._private import config as cfg
 
@@ -143,6 +148,73 @@ class ControlPlane:
         )
         self._install_routes()
         self._bg: list[asyncio.Task] = []
+        # GCS fault tolerance (reference gcs_table_storage.h:252 +
+        # redis_store_client.h:28, scaled to a file-backed store): durable
+        # tables are snapshotted; a restarted head reloads them, agents
+        # reconnect+re-register (NotifyGCSRestart analog), and heartbeats
+        # rebuild the live resource view.
+        self.persist_path = persist_path
+        self._dirty = False
+        if persist_path:
+            self._load_snapshot()
+
+    def mark_dirty(self):
+        self._dirty = True
+
+    def _load_snapshot(self):
+        import os
+
+        import msgpack
+
+        if not os.path.exists(self.persist_path):
+            return
+        try:
+            with open(self.persist_path, "rb") as f:
+                snap = msgpack.unpackb(f.read(), strict_map_key=False)
+        except Exception:  # noqa: BLE001 — corrupt snapshot: start fresh
+            logger.exception("failed to load control-plane snapshot")
+            return
+        self.kv.data = {(ns, key): v for ns, key, v in snap["kv"]}
+        self.jobs = {j["job_id"]: j for j in snap["jobs"]}
+        self.actors = {a["actor_id"]: a for a in snap["actors"]}
+        self.named_actors = {
+            (ns, name): aid for ns, name, aid in snap["named_actors"]
+        }
+        self.pgs = {p["pg_id"]: p for p in snap["pgs"]}
+        logger.info(
+            "restored control plane: %d actors, %d pgs, %d kv keys",
+            len(self.actors), len(self.pgs), len(self.kv.data),
+        )
+
+    def _write_snapshot(self):
+        import os
+
+        import msgpack
+
+        snap = {
+            "kv": [[ns, key, v] for (ns, key), v in self.kv.data.items()],
+            "jobs": list(self.jobs.values()),
+            "actors": list(self.actors.values()),
+            "named_actors": [
+                [ns, name, aid]
+                for (ns, name), aid in self.named_actors.items()
+            ],
+            "pgs": list(self.pgs.values()),
+        }
+        tmp = self.persist_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(msgpack.packb(snap))
+        os.replace(tmp, self.persist_path)
+
+    async def _persist_loop(self):
+        while True:
+            await asyncio.sleep(0.5)
+            if self._dirty:
+                self._dirty = False
+                try:
+                    self._write_snapshot()
+                except Exception:  # noqa: BLE001
+                    logger.exception("snapshot write failed")
 
     # ---------------- lifecycle ----------------
 
@@ -150,11 +222,18 @@ class ControlPlane:
         port = await self.server.start()
         self.server.on_disconnect = self._on_disconnect
         self._bg.append(asyncio.ensure_future(self._health_loop()))
+        if self.persist_path:
+            self._bg.append(asyncio.ensure_future(self._persist_loop()))
         return port
 
     async def stop(self):
         for t in self._bg:
             t.cancel()
+        if self.persist_path and self._dirty:
+            try:
+                self._write_snapshot()  # flush acknowledged writes
+            except Exception:  # noqa: BLE001
+                logger.exception("final snapshot flush failed")
         for c in self._agent_clients.values():
             await c.close()
         await self.server.stop()
@@ -185,14 +264,18 @@ class ControlPlane:
 
     # -- kv --
     async def rpc_kv_put(self, conn, p):
-        return self.kv.put(p["ns"], p["key"], p["value"],
-                           p.get("overwrite", True))
+        ok = self.kv.put(p["ns"], p["key"], p["value"],
+                         p.get("overwrite", True))
+        self.mark_dirty()
+        return ok
 
     async def rpc_kv_get(self, conn, p):
         return self.kv.get(p["ns"], p["key"])
 
     async def rpc_kv_del(self, conn, p):
-        return self.kv.delete(p["ns"], p["key"])
+        ok = self.kv.delete(p["ns"], p["key"])
+        self.mark_dirty()
+        return ok
 
     async def rpc_kv_keys(self, conn, p):
         return self.kv.keys(p["ns"], p.get("prefix", b""))
@@ -261,6 +344,7 @@ class ControlPlane:
         }
         conn.state["job_id"] = p["job_id"]
         conn.state["is_driver"] = True
+        self.mark_dirty()
         return True
 
     async def rpc_finish_job(self, conn, p):
@@ -290,6 +374,10 @@ class ControlPlane:
         """Register + schedule an actor. Returns when placement is decided
         (worker spawn happens async on the node agent)."""
         aid = p["actor_id"]
+        if aid in self.actors:
+            # duplicate submission (e.g. a reconnect retry after the head
+            # executed the original but the reply was lost): idempotent
+            return {"actor_id": aid, "existing": True}
         name = p.get("name")
         ns = p.get("namespace", "default")
         if name:
@@ -319,10 +407,12 @@ class ControlPlane:
             "pg_id": p.get("pg_id"),
             "bundle_index": p.get("bundle_index", -1),
             "max_concurrency": p.get("max_concurrency", 1),
+            "runtime_env": p.get("runtime_env"),
             "death_reason": None,
         }
         self.actors[aid] = actor
         await self._schedule_actor(actor)
+        self.mark_dirty()
         return {"actor_id": aid, "existing": False}
 
     async def _schedule_actor(self, actor: dict):
@@ -379,6 +469,7 @@ class ControlPlane:
                 "max_concurrency": actor["max_concurrency"],
                 "pg_id": actor.get("pg_id"),
                 "bundle_index": actor.get("bundle_index", -1),
+                "runtime_env": actor.get("runtime_env"),
             })
         except (rpc.RpcError, rpc.ConnectionLost) as e:
             logger.warning("start_actor failed on %s: %s",
@@ -397,6 +488,7 @@ class ControlPlane:
         actor["worker_addr"] = (p["addr"], p["port"])
         actor["worker_id"] = p.get("worker_id")
         self.pub.publish("actor_update", self._actor_view(actor))
+        self.mark_dirty()
         return True
 
     async def rpc_actor_failed(self, conn, p):
@@ -419,6 +511,7 @@ class ControlPlane:
             actor["death_reason"] = reason
             actor["worker_addr"] = None
             self.pub.publish("actor_update", self._actor_view(actor))
+            self.mark_dirty()
 
     def _release_actor_resources(self, actor):
         node = self.nodes.get(actor["node_id"]) if actor["node_id"] else None
@@ -549,6 +642,7 @@ class ControlPlane:
             "job_id": p.get("job_id"),
         }
         self.pub.publish("pg_update", {"pg_id": pgid, "state": "CREATED"})
+        self.mark_dirty()
         return {"state": "CREATED", "bundle_nodes": plan}
 
     def _plan_bundles(self, bundles, strategy) -> list[bytes] | None:
@@ -615,6 +709,7 @@ class ControlPlane:
 
     async def rpc_remove_pg(self, conn, p):
         pg = self.pgs.pop(p["pg_id"], None)
+        self.mark_dirty()
         if pg is None:
             return False
         for bidx, node_id in enumerate(pg.get("bundle_nodes", [])):
@@ -852,6 +947,35 @@ class ControlPlane:
                 refs.discard(worker_id)
                 if not refs:
                     await self._free_object_cluster(oid)
+
+    # -- task events / observability --
+    # reference GcsTaskManager (gcs_task_manager.h:61): bounded ring buffer
+    # of task lifecycle/profile events, queried by the state API and
+    # ray_tpu.timeline().
+
+    async def rpc_task_events(self, conn, p):
+        self.task_events.extend(p["events"])
+        return True
+
+    async def rpc_list_task_events(self, conn, p):
+        events = list(self.task_events)
+        job_id = p.get("job_id")
+        if job_id:
+            events = [e for e in events if e.get("job_id") == job_id]
+        limit = p.get("limit", 10_000)
+        return events[-limit:]
+
+    async def rpc_list_objects(self, conn, p):
+        out = []
+        for oid, entry in list(self.objects.items())[: p.get("limit", 1000)]:
+            out.append({
+                "object_id": oid,
+                "locations": list(entry["locations"]),
+                "size": entry.get("size", 0),
+                "spilled": entry.get("spilled"),
+                "num_refs": len(entry.get("refs", ())),
+            })
+        return out
 
     # ---------------- failure detection ----------------
 

@@ -63,6 +63,18 @@ def detect_resources() -> dict:
     return res
 
 
+def _env_hash(runtime_env: dict | None):
+    if not runtime_env:
+        return None
+    import hashlib
+    import json
+
+    return hashlib.blake2b(
+        json.dumps(runtime_env, sort_keys=True, default=str).encode(),
+        digest_size=8,
+    ).hexdigest()
+
+
 class WorkerHandle:
     def __init__(self, worker_id: bytes, proc: subprocess.Popen):
         self.worker_id = worker_id
@@ -199,9 +211,45 @@ class NodeAgent:
             if w.job_id == job_id and w.actor_id is None:
                 self._kill_worker(w)
 
+    async def _reconnect_head(self) -> bool:
+        """Head restarted (GCS FT): dial it again, re-register, re-subscribe
+        (reference raylet NotifyGCSRestart reconnect flow)."""
+        cli = AsyncRpcClient(self.head_addr, self.head_port)
+        try:
+            await cli.connect(retries=10, delay=0.3)
+        except rpc.ConnectionLost:
+            return False
+        old, self.head = self.head, cli
+        if old is not None:
+            await old.close()
+        cli.on_push("node_dead", self._on_node_dead_push)
+        cli.on_push("node_added", self._on_node_added_push)
+        cli.on_push("job_finished", self._on_job_finished_push)
+        try:
+            await cli.call("register_node", {
+                "node_id": self.node_id, "addr": self.host,
+                "port": self.port, "resources": self.resources_total,
+                "labels": self.labels,
+            })
+            for ch in ("node_dead", "node_added", "job_finished"):
+                await cli.call("subscribe", {"channel": ch})
+            # re-announce local primaries so the rebuilt directory knows us
+            for oid in list(self.primaries):
+                await cli.call("object_add_location", {
+                    "object_id": oid, "node_id": self.node_id,
+                })
+        except (rpc.ConnectionLost, rpc.RpcError):
+            return False
+        logger.info("reconnected to restarted head")
+        return True
+
     async def _heartbeat_loop(self):
         while not self._dead:
             try:
+                if self.head.closed:
+                    if not await self._reconnect_head():
+                        await asyncio.sleep(1.0)
+                        continue
                 reply = await self.head.call("heartbeat", {
                     "node_id": self.node_id,
                     "resources_available": self.resources_available,
@@ -223,7 +271,8 @@ class NodeAgent:
     # ---------------- worker pool ----------------
 
     async def _spawn_worker(self, job_id: bytes | None,
-                            holds_tpu: bool = False) -> WorkerHandle:
+                            holds_tpu: bool = False,
+                            runtime_env: dict | None = None) -> WorkerHandle:
         worker_id = os.urandom(16)
         env = dict(os.environ)
         env.update({
@@ -234,15 +283,40 @@ class NodeAgent:
             "RAY_TPU_WORKER_ID": worker_id.hex(),
             "RAY_TPU_SESSION": self.session_id,
         })
+        # runtime_env (reference _private/runtime_env/, scaled):
+        # env_vars merge into the process env; working_dir becomes the cwd;
+        # py_modules prepend to PYTHONPATH. Workers are keyed by the env
+        # hash, so an env mismatch forces a fresh process (worker_pool.h
+        # runtime-env-keyed pools).
+        cwd = None
+        if runtime_env:
+            env.update({str(k): str(v) for k, v in
+                        (runtime_env.get("env_vars") or {}).items()})
+            cwd = runtime_env.get("working_dir")
+            mods = list(runtime_env.get("py_modules") or [])
+            if cwd:
+                # the worker runs `python -m ray_tpu...` from the new cwd:
+                # keep the framework importable alongside the working_dir
+                import ray_tpu as _pkg
+
+                repo_root = os.path.dirname(os.path.dirname(_pkg.__file__))
+                mods = [cwd, repo_root, *mods]
+            if mods:
+                prev = env.get("PYTHONPATH", "")
+                env["PYTHONPATH"] = os.pathsep.join(
+                    [*mods, prev] if prev else mods
+                )
         if job_id:
             env["RAY_TPU_JOB_ID"] = job_id.hex()
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.core.worker_proc"],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            env=env, cwd=cwd,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         )
         handle = WorkerHandle(worker_id, proc)
         handle.job_id = job_id
         handle.holds_tpu = holds_tpu
+        handle.env_hash = _env_hash(runtime_env)
         self.workers[worker_id] = handle
         asyncio.ensure_future(self._drain_worker_logs(handle))
         return handle
@@ -289,15 +363,21 @@ class NodeAgent:
         return True
 
     async def _pop_worker(self, job_id: bytes | None,
-                          holds_tpu: bool = False) -> WorkerHandle:
-        """Idle worker of the same job, else spawn (worker_pool.h PopWorker)."""
+                          holds_tpu: bool = False,
+                          runtime_env: dict | None = None) -> WorkerHandle:
+        """Idle worker of the same job AND runtime env, else spawn
+        (worker_pool.h PopWorker; env mismatch forces a new process)."""
+        want = _env_hash(runtime_env)
         for w in self.workers.values():
             if w.idle and w.ready.is_set() and w.job_id == job_id \
+                    and getattr(w, "env_hash", None) == want \
                     and w.proc.poll() is None:
                 w.idle_since = time.monotonic()
                 return w
-        w = await self._spawn_worker(job_id, holds_tpu)
-        await asyncio.wait_for(w.ready.wait(), timeout=60.0)
+        w = await self._spawn_worker(job_id, holds_tpu, runtime_env)
+        await asyncio.wait_for(
+            w.ready.wait(), timeout=cfg.get("worker_register_timeout_s")
+        )
         return w
 
     def _kill_worker(self, w: WorkerHandle):
@@ -609,6 +689,7 @@ class NodeAgent:
             w = await self._pop_worker(
                 spec.get("job_id"),
                 holds_tpu=spec.get("resources", {}).get("TPU", 0) > 0,
+                runtime_env=spec.get("runtime_env"),
             )
         except (asyncio.TimeoutError, OSError) as e:
             self._free_task_resources(spec)
@@ -696,7 +777,8 @@ class NodeAgent:
                                  bundle_key=None):
         try:
             w = await self._spawn_worker(
-                p.get("job_id"), holds_tpu=need.get("TPU", 0) > 0
+                p.get("job_id"), holds_tpu=need.get("TPU", 0) > 0,
+                runtime_env=p.get("runtime_env"),
             )
             await asyncio.wait_for(
                 w.ready.wait(),
@@ -814,10 +896,15 @@ class NodeAgent:
     async def _pull_object(self, oid: bytes, timeout: float) -> bool:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            info = await self.head.call("object_wait_location", {
-                "object_id": oid,
-                "timeout": max(0.1, deadline - time.monotonic()),
-            })
+            try:
+                info = await self.head.call("object_wait_location", {
+                    "object_id": oid,
+                    "timeout": max(0.1, deadline - time.monotonic()),
+                })
+            except (rpc.ConnectionLost, rpc.RpcError):
+                # head restarting: the heartbeat loop reconnects; retry
+                await asyncio.sleep(0.3)
+                continue
             if info is None:
                 return False
             if self.node_id in info["locations"]:
@@ -896,10 +983,15 @@ class NodeAgent:
         oid = p["object_id"]
         self.store.pin(oid, True)  # primary copy: spilled, never evicted
         self.primaries[oid] = p.get("size", 0)
-        await self.head.call("object_add_location", {
-            "object_id": oid, "node_id": self.node_id,
-            "owner": p.get("owner"), "size": p.get("size", 0),
-        })
+        try:
+            await self.head.call("object_add_location", {
+                "object_id": oid, "node_id": self.node_id,
+                "owner": p.get("owner"), "size": p.get("size", 0),
+            })
+        except (rpc.ConnectionLost, rpc.RpcError):
+            # head down/restarting: the reconnect path re-announces every
+            # primary, so the directory converges once it is back
+            pass
         self._kick_dispatch()
         self._maybe_spill()
         return True

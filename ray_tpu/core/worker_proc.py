@@ -13,6 +13,7 @@ import os
 import queue
 import sys
 import threading
+import time
 import traceback
 
 from ray_tpu._private import rpc, serialization
@@ -227,8 +228,27 @@ class Executor(CoreWorker):
         self._push_one(cli, spec, desc, value=DynamicReturns(oids),
                        extra={"dynamic_items": oids})
 
+    def _emit_task_event(self, spec, state: str, start: float, end: float,
+                         name: str | None = None):
+        """TaskEventBuffer analog (task_event_buffer.h:205): lifecycle
+        events fired to the head's bounded event store."""
+        try:
+            self.head.fire("task_events", {"events": [{
+                "task_id": spec["task_id"],
+                "job_id": spec.get("job_id"),
+                "name": name or spec.get("name", "task"),
+                "state": state,
+                "worker_id": self.worker_id,
+                "node_id": self.node_id,
+                "start_s": start,
+                "end_s": end,
+            }]})
+        except Exception:  # noqa: BLE001 — observability is best-effort
+            pass
+
     def _execute_task(self, spec):
         owner = spec["owner"]
+        t_start = time.time()
         try:
             fn = self.load_function(spec["func_id"])
             args, kwargs = self._resolve_args(spec)
@@ -253,6 +273,9 @@ class Executor(CoreWorker):
                 RayTaskError(f"{type(e).__name__}: {e}\n{tb}")
             )
             self._push_results(spec, owner, None, error=err)
+            self._emit_task_event(spec, "FAILED", t_start, time.time())
+        else:
+            self._emit_task_event(spec, "FINISHED", t_start, time.time())
         finally:
             try:
                 self.agent.call("task_done", {"task_id": spec["task_id"]})
@@ -266,6 +289,7 @@ class Executor(CoreWorker):
 
     def _execute_actor_call(self, call):
         owner = call["owner"]
+        t_start = time.time()
         try:
             method = getattr(self._actor, call["method"])
             args, kwargs = self._resolve_args(call)
@@ -274,6 +298,8 @@ class Executor(CoreWorker):
             if n > 1:
                 results = tuple(results)
             self._push_results(call, owner, results)
+            self._emit_task_event(call, "FINISHED", t_start, time.time(),
+                                  name=call.get("method"))
         except BaseException as e:  # noqa: BLE001
             tb = traceback.format_exc()
             logger.warning("actor call %s failed: %s", call["method"], tb)
@@ -282,6 +308,8 @@ class Executor(CoreWorker):
                 RayTaskError(f"{type(e).__name__}: {e}\n{tb}")
             )
             self._push_results(call, owner, None, error=err)
+            self._emit_task_event(call, "FAILED", t_start, time.time(),
+                                  name=call.get("method"))
 
     async def rpc_push_result(self, conn, p):
         # clear owner-side actor pending on completion

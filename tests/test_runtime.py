@@ -299,3 +299,92 @@ def test_memory_monitor_kills_newest_task_worker(cluster):
     assert fut.result(timeout=10) is True
     # retried on a fresh worker and completes
     assert ray_tpu.get(ref, timeout=60) == "done"
+
+
+def test_task_events_and_timeline(cluster):
+    """Task lifecycle events reach the head store; ray_tpu.timeline()
+    renders chrome-trace events (reference gcs_task_manager.h:61 +
+    profiling.py:123)."""
+
+    @ray_tpu.remote
+    def traced(x):
+        return x + 1
+
+    ray_tpu.get([traced.remote(i) for i in range(3)], timeout=60)
+    deadline = time.time() + 15
+    names = []
+    while time.time() < deadline:
+        events = ray_tpu.list_tasks()
+        names = [e["name"] for e in events if e["name"] == "traced"]
+        if len(names) >= 3:
+            break
+        time.sleep(0.2)
+    assert len(names) >= 3
+    trace = ray_tpu.timeline()
+    spans = [t for t in trace if t["name"] == "traced"]
+    assert len(spans) >= 3
+    assert all(t["ph"] == "X" and t["dur"] >= 0 for t in spans)
+
+
+def test_list_objects_state_api(cluster):
+    ref = ray_tpu.put(np.arange(200_000))  # plasma-sized
+    deadline = time.time() + 10
+    found = False
+    while time.time() < deadline and not found:
+        objs = ray_tpu.list_objects()
+        found = any(o["object_id"] == ref.binary() for o in objs)
+        time.sleep(0.1)
+    assert found
+    entry = next(o for o in ray_tpu.list_objects()
+                 if o["object_id"] == ref.binary())
+    assert entry["num_refs"] >= 1 and entry["locations"]
+
+
+def test_runtime_env_vars_and_worker_isolation(cluster):
+    """runtime_env env_vars reach the worker process; different envs get
+    different worker processes (reference runtime_env + worker_pool
+    env-hash keying)."""
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"MY_FLAG": "abc"}})
+    def read_flag():
+        import os as _os
+
+        return (_os.environ.get("MY_FLAG"), _os.getpid())
+
+    @ray_tpu.remote
+    def plain():
+        import os as _os
+
+        return (_os.environ.get("MY_FLAG"), _os.getpid())
+
+    flag, pid_env = ray_tpu.get(read_flag.remote(), timeout=60)
+    none_flag, pid_plain = ray_tpu.get(plain.remote(), timeout=60)
+    assert flag == "abc"
+    assert none_flag is None
+    assert pid_env != pid_plain  # env mismatch forced a separate worker
+
+
+def test_runtime_env_working_dir(cluster, tmp_path):
+    mod = tmp_path / "my_dyn_mod.py"
+    mod.write_text("VALUE = 41\n")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(tmp_path)})
+    def use_mod():
+        import my_dyn_mod
+
+        return my_dyn_mod.VALUE + 1
+
+    assert ray_tpu.get(use_mod.remote(), timeout=60) == 42
+
+
+def test_runtime_env_actor(cluster):
+    @ray_tpu.remote(num_cpus=0, runtime_env={"env_vars": {"A_FLAG": "on"}})
+    class EnvActor:
+        def flag(self):
+            import os as _os
+
+            return _os.environ.get("A_FLAG")
+
+    a = EnvActor.remote()
+    assert ray_tpu.get(a.flag.remote(), timeout=60) == "on"
+    ray_tpu.kill(a)
