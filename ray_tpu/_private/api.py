@@ -190,7 +190,26 @@ def init(address: str | None = None, *, num_cpus: float | None = None,
             io.stop()
             if not view["nodes"]:
                 raise RuntimeError("cluster has no alive nodes")
-            me = view["nodes"][0]
+            # the driver attaches a node's SHARED-MEMORY store, so it must
+            # be co-located with that node: prefer loopback/local agents
+            import socket as _socket
+
+            local = {"127.0.0.1", "0.0.0.0", "localhost",
+                     _socket.gethostname()}
+            try:
+                local.add(_socket.gethostbyname(_socket.gethostname()))
+            except OSError:
+                pass
+            candidates = [n for n in view["nodes"]
+                          if n["alive"] and n["addr"] in local]
+            if not candidates:
+                raise RuntimeError(
+                    "no node agent runs on this host; a driver must "
+                    "connect through a local agent (its object store is "
+                    "shared memory) — start one with "
+                    "`python -m ray_tpu.scripts start --address ...`"
+                )
+            me = candidates[0]
             agent_addr, agent_port = me["addr"], me["port"]
             io2 = EventLoopThread("ray_tpu-probe2")
             probe2 = _rpc.SyncRpcClient(agent_addr, agent_port, io2)
@@ -198,15 +217,9 @@ def init(address: str | None = None, *, num_cpus: float | None = None,
             probe2.close()
             io2.stop()
             node_id = info["node_id"]
-            # store segment name is derivable only agent-side; ask for it
-            store_name = None  # filled below
+            store_name = info["store_name"]
 
         job_id = JobID.from_random().binary()
-        if address is not None and store_name is None:
-            # remote-connect drivers attach the agent's store by convention
-            raise NotImplementedError(
-                "remote driver connect lands with the multi-node launcher"
-            )
         worker = CoreWorker(
             head_addr=head_addr, head_port=head_port,
             agent_addr=agent_addr, agent_port=agent_port,
@@ -316,6 +329,12 @@ class RemoteFunction:
         refs = [ObjectRef(i) for i in ids]
         return refs[0] if o["num_returns"] in (1, "dynamic") else refs
 
+    def bind(self, *args, **kwargs):
+        """Build a lazy DAG node (reference dag_node.py:23 .bind)."""
+        from ray_tpu.dag.dag_node import _bind
+
+        return _bind(self, *args, **kwargs)
+
     def __call__(self, *a, **kw):
         raise TypeError(
             f"remote function {self.__name__} cannot be called directly; "
@@ -344,6 +363,12 @@ class ActorMethod:
         )
         refs = [ObjectRef(i) for i in ids]
         return refs[0] if self._num_returns == 1 else refs
+
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node over this actor method (dag_node.py:23)."""
+        from ray_tpu.dag.dag_node import _bind
+
+        return _bind(self, *args, **kwargs)
 
 
 class ActorHandle:

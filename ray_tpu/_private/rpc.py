@@ -359,6 +359,7 @@ class SyncRpcClient:
     def _try_reconnect(self) -> bool:
         if not self._reconnect_enabled:
             return False
+        ran_swap = False
         with self._reconnect_lock:
             if not self.client.closed:
                 return True  # another thread already reconnected
@@ -370,13 +371,16 @@ class SyncRpcClient:
             for channel, fn in self._push.items():
                 cli.on_push(channel, fn)
             self.client = cli
-            cb = self.on_reconnect
-            if cb is not None:
-                try:
-                    cb()
-                except Exception:  # noqa: BLE001
-                    logger.exception("on_reconnect callback failed")
-            return True
+            ran_swap = True
+        # Run the resync callback OUTSIDE the lock: it makes calls on this
+        # client, and a second connection loss during resync must be able
+        # to re-enter _try_reconnect rather than deadlock.
+        if ran_swap and self.on_reconnect is not None:
+            try:
+                self.on_reconnect()
+            except Exception:  # noqa: BLE001
+                logger.exception("on_reconnect callback failed")
+        return True
 
     def call(self, method: str, payload: Any = None, timeout=None) -> Any:
         try:

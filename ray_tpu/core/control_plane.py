@@ -97,6 +97,29 @@ class NodeInfo:
         self.labels = labels or {}
         self.alive = True
         self.last_heartbeat = time.monotonic()
+        # Head-side placement deductions newer than ~2 heartbeats: applied
+        # on top of agent reports so a fresh heartbeat (sent before the
+        # agent processed the placement) can't make the head double-book
+        # the node. Agents remain the authoritative admission gate.
+        self.recent_deductions: list[tuple[float, dict]] = []
+
+    def deduct(self, need: dict):
+        for r, v in need.items():
+            self.resources_available[r] = (
+                self.resources_available.get(r, 0) - v
+            )
+        self.recent_deductions.append((time.monotonic(), dict(need)))
+
+    def apply_report(self, reported: dict, window_s: float):
+        now = time.monotonic()
+        self.recent_deductions = [
+            (t, d) for t, d in self.recent_deductions if now - t < window_s
+        ]
+        avail = dict(reported)
+        for _, d in self.recent_deductions:
+            for r, v in d.items():
+                avail[r] = avail.get(r, 0) - v
+        self.resources_available = avail
 
     def view(self) -> dict:
         return {
@@ -135,6 +158,7 @@ class ControlPlane:
         self.object_waiters: dict[bytes, list[asyncio.Event]] = {}
         # oids freed by GC; straggler add_location for them deletes the copy
         self._freed_tombstones: set[bytes] = set()
+        self._pg_locks: dict[bytes, asyncio.Lock] = {}
         # bounded task-event store (gcs_task_manager.h:61 ring buffer)
         import collections
 
@@ -181,6 +205,12 @@ class ControlPlane:
             (ns, name): aid for ns, name, aid in snap["named_actors"]
         }
         self.pgs = {p["pg_id"]: p for p in snap["pgs"]}
+        # Actors caught mid-placement by the crash: clear their node so the
+        # health loop reschedules them (their old placement never happened
+        # or died with the head's in-flight RPC).
+        for a in self.actors.values():
+            if a.get("state") in (PENDING, RESTARTING):
+                a["node_id"] = None
         logger.info(
             "restored control plane: %d actors, %d pgs, %d kv keys",
             len(self.actors), len(self.pgs), len(self.kv.data),
@@ -309,7 +339,9 @@ class ControlPlane:
             return {"unknown": True}  # tell agent to re-register
         node.last_heartbeat = time.monotonic()
         if "resources_available" in p:
-            node.resources_available = p["resources_available"]
+            node.apply_report(
+                p["resources_available"], window_s=2.0
+            )
         return {"ok": True}
 
     async def rpc_get_cluster_view(self, conn, p):
@@ -352,6 +384,7 @@ class ControlPlane:
         return True
 
     async def _finish_job(self, job_id: bytes):
+        self.mark_dirty()
         job = self.jobs.get(job_id)
         if job is None or not job["alive"]:
             return
@@ -455,10 +488,7 @@ class ControlPlane:
         from_node_pool = pg is None
         actor["_from_node_pool"] = from_node_pool
         if from_node_pool:
-            for r, v in need.items():
-                node.resources_available[r] = (
-                    node.resources_available.get(r, 0) - v
-                )
+            node.deduct(need)
         actor["node_id"] = node.node_id
         try:
             await agent.call("start_actor", {
@@ -631,11 +661,7 @@ class ControlPlane:
         for bidx, node_id, agent in prepared:
             await agent.call("commit_bundle",
                              {"pg_id": pgid, "bundle_index": bidx})
-            node = self.nodes[node_id]
-            for r, v in bundles[bidx].items():
-                node.resources_available[r] = (
-                    node.resources_available.get(r, 0) - v
-                )
+            self.nodes[node_id].deduct(bundles[bidx])
         self.pgs[pgid] = {
             "pg_id": pgid, "state": "CREATED", "bundles": bundles,
             "strategy": strategy, "bundle_nodes": plan,
@@ -745,15 +771,25 @@ class ControlPlane:
             if pg["state"] == "CREATED":
                 return {"state": "CREATED",
                         "bundle_nodes": pg["bundle_nodes"]}
-            # retry placement as cluster changes
-            plan = self._plan_bundles(pg["bundles"], pg["strategy"])
-            if plan is not None:
-                res = await self.rpc_create_pg(None, {
-                    "pg_id": pg["pg_id"], "bundles": pg["bundles"],
-                    "strategy": pg["strategy"], "job_id": pg.get("job_id"),
-                })
-                if res["state"] == "CREATED":
-                    return res
+            # retry placement as cluster changes — single-flight per PG:
+            # concurrent waiters must not double-PREPARE the same bundles
+            lock = self._pg_locks.setdefault(p["pg_id"], asyncio.Lock())
+            if not lock.locked():
+                async with lock:
+                    pg = self.pgs.get(p["pg_id"])
+                    if pg is None:
+                        return None
+                    if pg["state"] != "CREATED" and self._plan_bundles(
+                        pg["bundles"], pg["strategy"]
+                    ) is not None:
+                        res = await self.rpc_create_pg(None, {
+                            "pg_id": pg["pg_id"],
+                            "bundles": pg["bundles"],
+                            "strategy": pg["strategy"],
+                            "job_id": pg.get("job_id"),
+                        })
+                        if res["state"] == "CREATED":
+                            return res
             await asyncio.sleep(0.1)
         return {"state": "PENDING"}
 

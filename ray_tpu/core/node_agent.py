@@ -49,12 +49,29 @@ SPILL_MAX = cfg.get("task_spill_max_forwards")
 DEP_LOST_S = cfg.get("dep_lost_reconstruct_s")
 
 
+def detect_tpu_chips() -> int:
+    """Count local TPU chips without initializing jax (which would grab
+    them): libtpu exposes one /dev/accel* (v4/v5) or /dev/vfio group per
+    chip. RAY_TPU_CHIPS overrides for tests/virtual topologies."""
+    chips = os.environ.get("RAY_TPU_CHIPS")
+    if chips:
+        return int(float(chips))
+    import glob
+
+    # numbered chip devices only: a bare /dev/accel directory is the
+    # Linux DRM compute-accelerator class (NPUs etc.), not a TPU
+    accels = glob.glob("/dev/accel[0-9]*")
+    if accels:
+        return len(accels)
+    return 0
+
+
 def detect_resources() -> dict:
     import psutil
 
     res = {"CPU": float(os.cpu_count() or 1),
            "memory": float(psutil.virtual_memory().total)}
-    chips = os.environ.get("RAY_TPU_CHIPS")
+    chips = detect_tpu_chips()
     if chips:
         res["TPU"] = float(chips)
         topo = os.environ.get("RAY_TPU_TOPOLOGY")
@@ -1169,6 +1186,7 @@ class NodeAgent:
     async def rpc_node_info(self, conn, p):
         return {
             "node_id": self.node_id,
+            "store_name": self.store_name,
             "resources_total": self.resources_total,
             "resources_available": self.resources_available,
             "num_workers": len(self.workers),

@@ -1,0 +1,9 @@
+"""Lazy task/actor DAGs (reference python/ray/dag/dag_node.py:23).
+
+`fn.bind(*args)` builds a DAG node without executing; `node.execute()`
+submits the whole graph as remote tasks with ObjectRef edges (each node
+executes once, shared descendants reuse its ref). Serve's deployment
+graphs build on the same structure in the reference.
+"""
+
+from ray_tpu.dag.dag_node import DAGNode, InputNode  # noqa: F401

@@ -1,0 +1,59 @@
+"""DAG nodes: deferred remote calls composed into a graph.
+
+Reference: python/ray/dag/dag_node.py:23 (DAGNode, .bind/.execute) +
+input_node.py (InputNode). Execution walks the graph depth-first,
+submitting each node's task once; edges travel as ObjectRefs so the
+runtime pipelines the whole graph without driver round-trips.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class DAGNode:
+    """One deferred `fn.remote(...)` with DAGNode-typed args as edges."""
+
+    def __init__(self, remote_fn, args: tuple, kwargs: dict):
+        self._remote_fn = remote_fn
+        self._args = args
+        self._kwargs = kwargs
+
+    def execute(self, *input_args) -> Any:
+        """Submit the graph; returns the root's ObjectRef."""
+        cache: dict[int, Any] = {}
+        return self._execute(cache, input_args)
+
+    def _execute(self, cache: dict, input_args: tuple):
+        if id(self) in cache:
+            return cache[id(self)]
+
+        def resolve(v):
+            if isinstance(v, DAGNode):
+                return v._execute(cache, input_args)
+            return v
+
+        args = tuple(resolve(a) for a in self._args)
+        kwargs = {k: resolve(v) for k, v in self._kwargs.items()}
+        ref = self._remote_fn.remote(*args, **kwargs)
+        cache[id(self)] = ref
+        return ref
+
+    def __repr__(self):
+        name = getattr(self._remote_fn, "__name__", "node")
+        return f"DAGNode({name}, {len(self._args)} args)"
+
+
+class InputNode(DAGNode):
+    """Placeholder for execute()-time input (reference input_node.py)."""
+
+    def __init__(self, index: int = 0):
+        super().__init__(None, (), {})
+        self._index = index
+
+    def _execute(self, cache: dict, input_args: tuple):
+        return input_args[self._index]
+
+
+def _bind(remote_fn, *args, **kwargs) -> DAGNode:
+    return DAGNode(remote_fn, args, kwargs)

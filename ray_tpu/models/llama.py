@@ -47,6 +47,12 @@ class LlamaConfig:
     remat_policy: str = "dots"
     use_flash: bool | None = None  # None = auto (flash on TPU)
     tie_embeddings: bool = False
+    # Mixture-of-experts MLP (0 = dense). TPU-first dense-dispatch MoE:
+    # every expert computes on every token via batched einsum with the
+    # expert dim sharded over the ep mesh axis (all-to-all-free expert
+    # parallelism; the reference has no MoE at all, SURVEY §2.7).
+    n_experts: int = 0
+    top_k: int = 2
 
     @property
     def head_dim(self) -> int:
@@ -60,7 +66,10 @@ class LlamaConfig:
         d, f, v, l = self.d_model, self.d_ff, self.vocab_size, self.n_layers
         hd = self.head_dim
         attn = d * self.n_heads * hd + 2 * d * self.n_kv_heads * hd + self.n_heads * hd * d
-        mlp = 3 * d * f
+        if self.n_experts > 0:
+            mlp = self.n_experts * 3 * d * f + d * self.n_experts
+        else:
+            mlp = 3 * d * f
         per_layer = attn + mlp + 2 * d
         head = 0 if self.tie_embeddings else d * v
         return v * d + l * per_layer + d + head
@@ -84,6 +93,9 @@ def llama2_size(name: str) -> LlamaConfig:
     """Named sizes for benchmarks: '125m', '350m', '1b', '7b'."""
     table = {
         "125m": dict(d_model=768, n_layers=12, n_heads=12, n_kv_heads=12, d_ff=2048),
+        "moe-tiny": dict(d_model=128, n_layers=2, n_heads=4, n_kv_heads=4,
+                         d_ff=256, vocab_size=512, max_seq_len=128,
+                         n_experts=4, top_k=2),
         "350m": dict(d_model=1024, n_layers=24, n_heads=16, n_kv_heads=16, d_ff=2816),
         "1b": dict(d_model=2048, n_layers=22, n_heads=16, n_kv_heads=8, d_ff=5632),
         "7b": dict(d_model=4096, n_layers=32, n_heads=32, n_kv_heads=32, d_ff=11008),
@@ -113,9 +125,20 @@ def init_params(cfg: LlamaConfig, key):
             "wv": dense(next(k), (l, d, hkv * hd), d),
             "wo": dense(next(k), (l, hq * hd, d), hq * hd),
             "mlp_norm": jnp.ones((l, d), jnp.float32),
-            "w_gate": dense(next(k), (l, d, f), d),
-            "w_up": dense(next(k), (l, d, f), d),
-            "w_down": dense(next(k), (l, f, d), f),
+            **(
+                {
+                    "router": dense(next(k), (l, d, cfg.n_experts), d),
+                    "w_gate": dense(next(k), (l, cfg.n_experts, d, f), d),
+                    "w_up": dense(next(k), (l, cfg.n_experts, d, f), d),
+                    "w_down": dense(next(k), (l, cfg.n_experts, f, d), f),
+                }
+                if cfg.n_experts > 0 else
+                {
+                    "w_gate": dense(next(k), (l, d, f), d),
+                    "w_up": dense(next(k), (l, d, f), d),
+                    "w_down": dense(next(k), (l, f, d), f),
+                }
+            ),
         },
         "final_norm": jnp.ones((d,), jnp.float32),
     }
@@ -135,9 +158,20 @@ def param_logical_axes(cfg: LlamaConfig):
             "wv": ("layers", "embed", "kv_heads"),
             "wo": ("layers", "heads", "embed"),
             "mlp_norm": ("layers", "norm"),
-            "w_gate": ("layers", "embed", "mlp"),
-            "w_up": ("layers", "embed", "mlp"),
-            "w_down": ("layers", "mlp", "embed"),
+            **(
+                {
+                    "router": ("layers", "embed", None),
+                    "w_gate": ("layers", "expert", "embed", "mlp"),
+                    "w_up": ("layers", "expert", "embed", "mlp"),
+                    "w_down": ("layers", "expert", "mlp", "embed"),
+                }
+                if cfg.n_experts > 0 else
+                {
+                    "w_gate": ("layers", "embed", "mlp"),
+                    "w_up": ("layers", "embed", "mlp"),
+                    "w_down": ("layers", "mlp", "embed"),
+                }
+            ),
         },
         "final_norm": ("norm",),
     }
@@ -163,8 +197,39 @@ def _qkv(cfg: LlamaConfig, p, h, sin, cos):
     return apply_rotary(q, sin, cos), apply_rotary(k, sin, cos), v
 
 
+def moe_gates(cfg: LlamaConfig, router, x):
+    """Router probabilities with top-k masking; [B, T, E], rows sum to 1
+    over exactly top_k nonzero entries."""
+    logits = x @ router.astype(cfg.compute_dtype)  # [B, T, E]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    if cfg.top_k < cfg.n_experts:
+        kth = jnp.sort(probs, axis=-1)[..., -cfg.top_k][..., None]
+        probs = jnp.where(probs >= kth, probs, 0.0)
+        probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    return probs
+
+
+def _moe_mlp(cfg: LlamaConfig, p, x):
+    """Top-k dense-dispatch MoE (all experts compute, gates mask).
+
+    Expert weights [E, d, f] are sharded over the ep axis; the weighted
+    combine sums over E, which XLA lowers to a psum across ep — expert
+    parallelism with zero ragged communication. Appropriate up to moderate
+    E; token-dropping capacity routing is the scale-up path.
+    """
+    cdt = cfg.compute_dtype
+    gates = moe_gates(cfg, p["router"], x).astype(cdt)  # [B, T, E]
+    gate = jnp.einsum("btd,edf->btef", x, p["w_gate"].astype(cdt))
+    up = jnp.einsum("btd,edf->btef", x, p["w_up"].astype(cdt))
+    y = jnp.einsum(
+        "btef,efd->bted", jax.nn.silu(gate) * up, p["w_down"].astype(cdt)
+    )
+    out = jnp.einsum("bted,bte->btd", y, gates)
+    return shard_constraint(out, ("batch", "seq", "embed"))
+
+
 def _attn_out_and_mlp(cfg: LlamaConfig, p, h, o):
-    """Shared wo projection + residual + SwiGLU MLP."""
+    """Shared wo projection + residual + MLP (SwiGLU dense or MoE)."""
     b, t, _ = h.shape
     hq, hd = cfg.n_heads, cfg.head_dim
     cdt = cfg.compute_dtype
@@ -173,6 +238,8 @@ def _attn_out_and_mlp(cfg: LlamaConfig, p, h, o):
         ("batch", "seq", "embed"),
     )
     x = rms_norm(h, p["mlp_norm"], cfg.rms_eps)
+    if cfg.n_experts > 0:
+        return h + _moe_mlp(cfg, p, x)
     gate = x @ p["w_gate"].astype(cdt)
     up = x @ p["w_up"].astype(cdt)
     y = (jax.nn.silu(gate) * up) @ p["w_down"].astype(cdt)

@@ -1,0 +1,13 @@
+"""ray_tpu.rl — RL training on the actor runtime (RLlib equivalent).
+
+Reference: rllib/ (algorithms/algorithm.py:150 Algorithm,
+core/learner/learner_group.py:61, evaluation/rollout_worker.py:166).
+TPU-native mapping: EnvRunner actors sample with host-side numpy policies;
+the Learner's update is one jitted jax function (minibatched PPO with a
+clipped objective + GAE), so gradients ride XLA — psum across a mesh when
+the learner group is sharded — instead of torch DDP.
+"""
+
+from ray_tpu.rl.ppo import PPO, PPOConfig  # noqa: F401
+from ray_tpu.rl.env_runner import EnvRunner  # noqa: F401
+from ray_tpu.rl.learner import Learner  # noqa: F401

@@ -1,0 +1,80 @@
+"""EnvRunner: environment-sampling actor.
+
+Reference: rllib/evaluation/rollout_worker.py:166 + sampler.py — an actor
+holding env instances and the current policy weights; sample() runs the
+env loop on host (numpy/jax CPU) and returns a batch dict. Env API is
+gym-like: reset() -> obs, step(a) -> (obs, reward, done, info).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import ray_tpu
+
+
+@ray_tpu.remote(num_cpus=1)
+class EnvRunner:
+    def __init__(self, env_creator_blob, obs_dim: int, n_actions: int,
+                 seed: int = 0):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from ray_tpu._private import serialization
+        from ray_tpu.rl import models
+
+        env_creator = serialization.unpack_payload(env_creator_blob)
+        self.env = env_creator()
+        self.models = models
+        self.rng = np.random.RandomState(seed)
+        self._obs = np.asarray(self.env.reset(), np.float32)
+        self._fwd = jax.jit(models.forward)
+
+    def set_weights(self, params):
+        self.params = params
+
+    def sample(self, n_steps: int) -> dict:
+        """Collect n_steps transitions with the current policy."""
+        import jax.numpy as jnp
+        import numpy as np  # noqa: F811 — worker-side import
+
+        obs_l, act_l, rew_l, done_l, logp_l, val_l = [], [], [], [], [], []
+        obs = self._obs
+        for _ in range(n_steps):
+            logits, value = self._fwd(self.params, jnp.asarray(obs[None]))
+            logits = np.asarray(logits[0], np.float64)
+            p = np.exp(logits - logits.max())
+            p /= p.sum()
+            a = int(self.rng.choice(len(p), p=p))
+            nxt, r, done, _ = self.env.step(a)
+            obs_l.append(obs)
+            act_l.append(a)
+            rew_l.append(float(r))
+            done_l.append(bool(done))
+            logp_l.append(float(np.log(p[a] + 1e-12)))
+            val_l.append(float(value[0]))
+            obs = (np.asarray(self.env.reset(), np.float32) if done
+                   else np.asarray(nxt, np.float32))
+        # bootstrap value of the final obs for GAE
+        _, last_v = self._fwd(self.params, jnp.asarray(obs[None]))
+        self._obs = obs
+        return {
+            "obs": np.stack(obs_l).astype(np.float32),
+            "actions": np.asarray(act_l, np.int32),
+            "rewards": np.asarray(rew_l, np.float32),
+            "dones": np.asarray(done_l, np.bool_),
+            "logp": np.asarray(logp_l, np.float32),
+            "values": np.asarray(val_l, np.float32),
+            "last_value": float(last_v[0]),
+            "episode_return_mean": _episode_return_mean(rew_l, done_l),
+        }
+
+
+def _episode_return_mean(rewards, dones) -> float:
+    returns, cur = [], 0.0
+    for r, d in zip(rewards, dones):
+        cur += r
+        if d:
+            returns.append(cur)
+            cur = 0.0
+    return float(np.mean(returns)) if returns else float(cur)

@@ -1,0 +1,32 @@
+"""Tiny jax policy/value networks for the RL stack."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def init_policy(key, obs_dim: int, n_actions: int, hidden: int = 64):
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+
+    def dense(k, i, o):
+        return {
+            "w": jax.random.normal(k, (i, o), jnp.float32) / jnp.sqrt(i),
+            "b": jnp.zeros((o,), jnp.float32),
+        }
+
+    return {
+        "torso": dense(k1, obs_dim, hidden),
+        "torso2": dense(k2, hidden, hidden),
+        "pi": dense(k3, hidden, n_actions),
+        "vf": dense(k4, hidden, 1),
+    }
+
+
+def forward(params, obs):
+    """obs [B, obs_dim] -> (logits [B, A], value [B])."""
+    h = jnp.tanh(obs @ params["torso"]["w"] + params["torso"]["b"])
+    h = jnp.tanh(h @ params["torso2"]["w"] + params["torso2"]["b"])
+    logits = h @ params["pi"]["w"] + params["pi"]["b"]
+    value = (h @ params["vf"]["w"] + params["vf"]["b"])[:, 0]
+    return logits, value

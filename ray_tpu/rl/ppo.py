@@ -1,0 +1,102 @@
+"""PPO: the Algorithm loop over EnvRunner actors + Learner.
+
+Reference: rllib/algorithms/ppo/ppo.py:394 training_step +
+algorithms/algorithm.py:765 (sample -> learn -> sync weights). The
+Algorithm object is Tune-compatible: train() returns a result dict, so
+`ray_tpu.tune.Tuner` can sweep its config.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu._private import serialization
+from ray_tpu.rl.env_runner import EnvRunner
+from ray_tpu.rl.learner import Learner, compute_gae
+
+
+@dataclass
+class PPOConfig:
+    """Reference: algorithms/algorithm_config.py builder, flattened."""
+
+    env_creator: Callable | None = None
+    obs_dim: int = 4
+    n_actions: int = 2
+    num_env_runners: int = 2
+    rollout_steps: int = 128  # per runner per iteration
+    lr: float = 3e-4
+    gamma: float = 0.99
+    gae_lambda: float = 0.95
+    clip: float = 0.2
+    entropy_coeff: float = 0.01
+    sgd_minibatches: int = 4
+    sgd_epochs: int = 4
+
+    def build(self) -> "PPO":
+        return PPO(self)
+
+
+class PPO:
+    def __init__(self, config: PPOConfig):
+        assert config.env_creator is not None, "set PPOConfig.env_creator"
+        self.config = config
+        self.learner = Learner(
+            config.obs_dim, config.n_actions, lr=config.lr,
+            clip=config.clip, entropy_coeff=config.entropy_coeff,
+        )
+        blob = serialization.pack_callable(config.env_creator)
+        self.runners = [
+            EnvRunner.remote(blob, config.obs_dim, config.n_actions,
+                             seed=i)
+            for i in range(config.num_env_runners)
+        ]
+        self._sync_weights()
+        self.iteration = 0
+
+    def _sync_weights(self):
+        w = self.learner.get_weights()
+        ray_tpu.get(
+            [r.set_weights.remote(w) for r in self.runners], timeout=120
+        )
+
+    def train(self) -> dict:
+        """One iteration: parallel sample -> GAE -> minibatch SGD -> sync."""
+        cfg = self.config
+        batches = ray_tpu.get(
+            [r.sample.remote(cfg.rollout_steps) for r in self.runners],
+            timeout=600,
+        )
+        merged = {k: [] for k in ("obs", "actions", "logp", "advantages",
+                                  "returns")}
+        ep_returns = []
+        for b in batches:
+            adv, ret = compute_gae(
+                b["rewards"], b["values"], b["dones"], b["last_value"],
+                gamma=cfg.gamma, lam=cfg.gae_lambda,
+            )
+            merged["obs"].append(b["obs"])
+            merged["actions"].append(b["actions"])
+            merged["logp"].append(b["logp"])
+            merged["advantages"].append(adv)
+            merged["returns"].append(ret)
+            ep_returns.append(b["episode_return_mean"])
+        batch = {k: np.concatenate(v) for k, v in merged.items()}
+        metrics = self.learner.update(
+            batch, minibatches=cfg.sgd_minibatches, epochs=cfg.sgd_epochs
+        )
+        self._sync_weights()
+        self.iteration += 1
+        metrics["episode_return_mean"] = float(np.mean(ep_returns))
+        metrics["training_iteration"] = self.iteration
+        return metrics
+
+    def stop(self):
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:  # noqa: BLE001
+                pass

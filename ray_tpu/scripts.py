@@ -1,0 +1,128 @@
+"""Cluster CLI: `python -m ray_tpu.scripts <cmd>`.
+
+Reference: python/ray/scripts/scripts.py (`ray start:535`, `ray stop:978`,
+`ray status`, `ray submit:1307`). Commands:
+
+  start --head [--port P] [--resources JSON]   run head (control plane +
+                                               node agent) in foreground
+  start --address HOST:PORT [--resources JSON] join as a worker node
+  status --address HOST:PORT                   cluster view
+  submit --address HOST:PORT script.py [args]  run a driver script with
+                                               RAY_TPU_ADDRESS exported
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import sys
+
+
+def _run_head(args):
+    from ray_tpu.core.control_plane import ControlPlane
+    from ray_tpu.core.node_agent import NodeAgent, detect_resources
+
+    async def _main():
+        cp = ControlPlane(host=args.host, port=args.port,
+                          persist_path=args.persist_path)
+        port = await cp.start()
+        res = json.loads(args.resources) if args.resources else \
+            detect_resources()
+        agent = NodeAgent(args.host, port, host=args.host, resources=res)
+        await agent.start()
+        print(f"ray_tpu head up: --address {args.host}:{port}", flush=True)
+        await asyncio.Event().wait()
+
+    asyncio.run(_main())
+
+
+def _run_node(args):
+    from ray_tpu.core.node_agent import NodeAgent, detect_resources
+
+    host, port = args.address.rsplit(":", 1)
+
+    async def _main():
+        res = json.loads(args.resources) if args.resources else \
+            detect_resources()
+        agent = NodeAgent(host, int(port), host=args.host, resources=res)
+        await agent.start()
+        print(f"ray_tpu node joined {args.address}", flush=True)
+        await asyncio.Event().wait()
+
+    asyncio.run(_main())
+
+
+def _status(args):
+    import ray_tpu
+
+    ray_tpu.init(address=args.address)
+    print(json.dumps({
+        "nodes": [
+            {
+                "node_id": n["node_id"].hex()[:12],
+                "alive": n["alive"],
+                "resources_total": n["resources_total"],
+                "resources_available": n["resources_available"],
+            }
+            for n in ray_tpu.nodes()
+        ],
+        "cluster_resources": ray_tpu.cluster_resources(),
+        "available_resources": ray_tpu.available_resources(),
+    }, indent=2, default=str))
+    ray_tpu.shutdown()
+
+
+def _submit(args):
+    env = dict(os.environ)
+    env["RAY_TPU_ADDRESS"] = args.address
+    # the driver script may live anywhere; keep the framework importable
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    prev = env.get("PYTHONPATH", "")
+    env["PYTHONPATH"] = (
+        f"{repo_root}{os.pathsep}{prev}" if prev else repo_root
+    )
+    os.execvpe(sys.executable, [sys.executable, args.script, *args.args],
+               env)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="ray_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    st = sub.add_parser("start", help="start a head or worker node")
+    st.add_argument("--head", action="store_true")
+    st.add_argument("--address", default=None, help="head HOST:PORT to join")
+    st.add_argument("--host", default="127.0.0.1")
+    st.add_argument("--port", type=int, default=0)
+    st.add_argument("--resources", default=None, help="JSON resource map")
+    st.add_argument("--persist-path", default=None,
+                    help="head snapshot file (GCS fault tolerance)")
+
+    ss = sub.add_parser("status", help="print the cluster view")
+    ss.add_argument("--address", required=True)
+
+    sm = sub.add_parser("submit", help="run a driver script")
+    sm.add_argument("--address", required=True)
+    sm.add_argument("script")
+    sm.add_argument("args", nargs=argparse.REMAINDER)
+
+    args = p.parse_args(argv)
+    if args.cmd == "start":
+        if args.head:
+            _run_head(args)
+        elif args.address:
+            _run_node(args)
+        else:
+            p.error("start needs --head or --address")
+    elif args.cmd == "status":
+        _status(args)
+    elif args.cmd == "submit":
+        _submit(args)
+
+
+if __name__ == "__main__":
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
+    main()

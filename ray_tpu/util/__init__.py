@@ -1,0 +1,4 @@
+"""Utility libraries on the task/actor runtime (reference ray.util)."""
+
+from ray_tpu.util.actor_pool import ActorPool  # noqa: F401
+from ray_tpu.util.queue import Empty, Full, Queue  # noqa: F401

@@ -1,0 +1,78 @@
+"""CLI end-to-end: start a real head process, join a node, connect a
+remote driver, submit a script (reference scripts.py `ray start/submit`).
+"""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+
+@pytest.fixture
+def head_proc():
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.scripts", "start", "--head",
+         "--resources", '{"CPU": 4, "memory": 2147483648}'],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd="/root/repo",
+    )
+    address = None
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        m = re.search(r"--address (\S+)", line or "")
+        if m:
+            address = m.group(1)
+            break
+    assert address, "head never printed its address"
+    yield proc, address
+    proc.send_signal(signal.SIGTERM)
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+def test_cli_head_connect_and_run(head_proc):
+    _, address = head_proc
+    import ray_tpu
+
+    ray_tpu.init(address=address)
+    try:
+
+        @ray_tpu.remote
+        def add(a, b):
+            return a + b
+
+        assert ray_tpu.get(add.remote(20, 22), timeout=60) == 42
+        assert ray_tpu.cluster_resources().get("CPU") == 4
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_cli_submit(head_proc, tmp_path):
+    _, address = head_proc
+    script = tmp_path / "driver.py"
+    script.write_text(
+        "import os\n"
+        "import ray_tpu\n"
+        "ray_tpu.init(address=os.environ['RAY_TPU_ADDRESS'])\n"
+        "@ray_tpu.remote\n"
+        "def f():\n"
+        "    return 'submitted-ok'\n"
+        "print(ray_tpu.get(f.remote(), timeout=60))\n"
+        "ray_tpu.shutdown()\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts", "submit",
+         "--address", address, str(script)],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd="/root/repo",
+    )
+    assert "submitted-ok" in out.stdout, out.stdout + out.stderr

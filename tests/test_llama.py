@@ -79,3 +79,42 @@ def test_num_params_matches(rng):
     cfg = _cfg()
     params = llama.init_params(cfg, rng)
     assert tree_num_params(params) == cfg.num_params()
+
+
+def test_moe_forward_and_loss_decreases(rng):
+    """MoE MLP (dense-dispatch, expert axis): forward shapes + learning."""
+    cfg = llama.llama2_size("moe-tiny")
+    params = llama.init_params(cfg, rng)
+    tokens = jax.random.randint(rng, (4, 33), 0, cfg.vocab_size)
+    batch = {"tokens": tokens}
+    opt = optax.adam(1e-2)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        (loss, _), grads = jax.value_and_grad(
+            lambda p: llama.loss_fn(p, batch, cfg), has_aux=True
+        )(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    losses = []
+    for _ in range(12):
+        params, opt_state, loss = step(params, opt_state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.8
+
+
+def test_moe_top_k_masks_experts(rng):
+    """top_k must zero all but k experts' gates, and rows renormalize."""
+    cfg = llama.llama2_size("moe-tiny")
+    for k in (1, 2):
+        kcfg = llama.LlamaConfig(**{**cfg.__dict__, "top_k": k})
+        params = llama.init_params(kcfg, rng)
+        x = jax.random.normal(rng, (2, 16, kcfg.d_model), jnp.float32)
+        gates = llama.moe_gates(kcfg, params["layers"]["router"][0], x)
+        nonzero = (np.asarray(gates) > 0).sum(axis=-1)
+        assert (nonzero == k).all()
+        np.testing.assert_allclose(
+            np.asarray(gates).sum(-1), 1.0, atol=1e-5
+        )

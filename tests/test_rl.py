@@ -1,0 +1,79 @@
+"""RL stack tests: EnvRunner sampling, PPO learning on a corridor env.
+
+Reference analogs: rllib/tests (scaled). The env is a 1-D corridor: agent
+starts at 0, action 1 moves right (+1 reward at the goal), action 0 moves
+left; optimal policy always moves right. PPO must clearly improve mean
+episode return within a few iterations.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.rl import PPO, PPOConfig
+from ray_tpu.rl.learner import compute_gae
+
+
+class Corridor:
+    """5-step corridor; obs = [pos/5, 1]; reward 1.0 at the right end."""
+
+    N = 5
+
+    def __init__(self):
+        self.pos = 0
+
+    def reset(self):
+        self.pos = 0
+        return self._obs()
+
+    def _obs(self):
+        return np.array([self.pos / self.N, 1.0], np.float32)
+
+    def step(self, action):
+        self.pos += 1 if action == 1 else -1
+        self.pos = max(0, self.pos)
+        done = self.pos >= self.N
+        reward = 1.0 if done else -0.05
+        return self._obs(), reward, done, {}
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(head_resources={"CPU": 4, "memory": 2 * 2**30})
+    c.connect()
+    yield c
+    c.shutdown()
+
+
+def test_gae_shapes_and_values():
+    rewards = np.array([0.0, 0.0, 1.0], np.float32)
+    values = np.array([0.5, 0.5, 0.5], np.float32)
+    dones = np.array([False, False, True])
+    adv, ret = compute_gae(rewards, values, dones, last_value=0.0,
+                           gamma=1.0, lam=1.0)
+    # terminal step: advantage = r - v = 0.5; returns = adv + values
+    np.testing.assert_allclose(adv[-1], 0.5, atol=1e-6)
+    np.testing.assert_allclose(ret, adv + values)
+
+
+def test_ppo_improves_on_corridor(cluster):
+    cfg = PPOConfig(
+        env_creator=Corridor,
+        obs_dim=2,
+        n_actions=2,
+        num_env_runners=2,
+        rollout_steps=200,
+        lr=5e-3,
+        entropy_coeff=0.0,
+    )
+    algo = cfg.build()
+    first = algo.train()
+    assert "episode_return_mean" in first
+    rets = [first["episode_return_mean"]]
+    for _ in range(8):
+        rets.append(algo.train()["episode_return_mean"])
+    algo.stop()
+    # optimal return = 1.0 - 4*0.05 = 0.8; random policy is far below
+    assert max(rets[-3:]) > max(rets[0], 0.0) or rets[-1] > 0.6
+    assert rets[-1] > rets[0]

@@ -1,0 +1,78 @@
+"""ActorPool, Queue, and DAG tests (reference ray.util + ray.dag)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util import ActorPool, Empty, Queue
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(head_resources={"CPU": 4, "memory": 2 * 2**30})
+    c.connect()
+    yield c
+    c.shutdown()
+
+
+@ray_tpu.remote(num_cpus=1)
+class Doubler:
+    def double(self, x):
+        return 2 * x
+
+
+def test_actor_pool_map_ordered(cluster):
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(8)))
+    assert out == [2 * i for i in range(8)]
+
+
+def test_actor_pool_unordered(cluster):
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    out = list(pool.map_unordered(lambda a, v: a.double.remote(v), range(6)))
+    assert sorted(out) == [2 * i for i in range(6)]
+
+
+def test_queue_roundtrip(cluster):
+    q = Queue(maxsize=4)
+    for i in range(4):
+        q.put(i)
+    assert q.full()
+    assert [q.get() for _ in range(4)] == [0, 1, 2, 3]
+    assert q.empty()
+    with pytest.raises(Empty):
+        q.get_nowait()
+    q.shutdown()
+
+
+def test_queue_producer_consumer(cluster):
+    q = Queue()
+
+    @ray_tpu.remote(num_cpus=1)
+    def producer(q, n):
+        for i in range(n):
+            q.put(i)
+        return True
+
+    ref = producer.remote(q, 10)
+    got = [q.get(timeout=30) for _ in range(10)]
+    assert got == list(range(10))
+    assert ray_tpu.get(ref, timeout=30)
+
+
+def test_dag_bind_execute(cluster):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    @ray_tpu.remote
+    def mul(a, b):
+        return a * b
+
+    from ray_tpu.dag import InputNode
+
+    x = InputNode(0)
+    s = add.bind(x, 10)
+    graph = mul.bind(s, s)  # shared node executes once
+    ref = graph.execute(5)
+    assert ray_tpu.get(ref, timeout=60) == 225  # (5+10)^2
