@@ -19,6 +19,7 @@ _DEFS: dict[str, Any] = {
     "object_transfer_chunk_bytes": 4 * 1024 * 1024,
     "idle_worker_cull_s": 60.0,          # ray_config_def.h:542 analog
     "task_spill_max_forwards": 2,
+    "locality_min_bytes": 1024 * 1024,  # prefer data-local nodes above this
     "dep_lost_reconstruct_s": 10.0,
     "spill_high_fraction": 0.8,          # spill primaries above this fill
     "spill_low_fraction": 0.5,           # ...until back under this

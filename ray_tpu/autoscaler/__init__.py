@@ -1,0 +1,17 @@
+"""Autoscaler: reconcile node count against queued demand.
+
+Reference: python/ray/autoscaler/_private/autoscaler.py:172
+(StandardAutoscaler.update) + monitor.py:249 (load polling) +
+node_provider.py (pluggable providers; the GCP provider even has
+first-class TPU nodes, gcp/node.py:111). Scaled v0: a provider interface
+with a LocalNodeProvider (in-process agents — the fake-multinode test
+provider analog) and a reconcile loop driven by the head's heartbeat load
+signal (queued tasks + free CPU).
+"""
+
+from ray_tpu.autoscaler.autoscaler import (  # noqa: F401
+    Autoscaler,
+    AutoscalerConfig,
+    LocalNodeProvider,
+    NodeProvider,
+)

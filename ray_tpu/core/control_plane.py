@@ -97,6 +97,9 @@ class NodeInfo:
         self.labels = labels or {}
         self.alive = True
         self.last_heartbeat = time.monotonic()
+        self.queued = 0  # tasks waiting (autoscaler demand signal)
+        self.running = 0
+        self.store_primaries = 0  # pinned primaries (scale-down gate)
         # Head-side placement deductions newer than ~2 heartbeats: applied
         # on top of agent reports so a fresh heartbeat (sent before the
         # agent processed the placement) can't make the head double-book
@@ -130,6 +133,9 @@ class NodeInfo:
             "resources_available": self.resources_available,
             "labels": self.labels,
             "alive": self.alive,
+            "queued": self.queued,
+            "running": self.running,
+            "store_primaries": self.store_primaries,
         }
 
 
@@ -338,6 +344,9 @@ class ControlPlane:
         if node is None:
             return {"unknown": True}  # tell agent to re-register
         node.last_heartbeat = time.monotonic()
+        node.queued = p.get("queued", 0)
+        node.running = p.get("running", 0)
+        node.store_primaries = p.get("store_primaries", 0)
         if "resources_available" in p:
             node.apply_report(
                 p["resources_available"], window_s=2.0
@@ -831,6 +840,15 @@ class ControlPlane:
         if entry:
             entry["locations"].discard(p["node_id"])
         return True
+
+    async def rpc_object_locations_bulk(self, conn, p):
+        out = {}
+        for oid in p["object_ids"]:
+            entry = self.objects.get(oid)
+            if entry:
+                out[oid] = {"locations": list(entry["locations"]),
+                            "size": entry.get("size", 0)}
+        return out
 
     async def rpc_object_locations(self, conn, p):
         entry = self.objects.get(p["object_id"])

@@ -270,6 +270,11 @@ class NodeAgent:
                 reply = await self.head.call("heartbeat", {
                     "node_id": self.node_id,
                     "resources_available": self.resources_available,
+                    # demand signal = WAITING work only (running tasks
+                    # don't need more nodes); primaries gate scale-down
+                    "queued": len(self.task_queue),
+                    "running": len(self.running),
+                    "store_primaries": len(self.primaries),
                 })
                 if reply.get("unknown"):
                     await self.head.call("register_node", {
@@ -527,7 +532,7 @@ class NodeAgent:
         """Entry from a local worker/driver or a spilling peer agent."""
         spec = p
         spec.setdefault("_spills", 0)
-        target = self._choose_node(spec)
+        target = await self._locality_target(spec) or self._choose_node(spec)
         if target is not None and target != self.node_id \
                 and spec["_spills"] < SPILL_MAX:
             spec["_spills"] += 1
@@ -563,6 +568,51 @@ class NodeAgent:
                 })
         except (rpc.ConnectionLost, rpc.RpcError, OSError):
             pass
+
+    async def _locality_target(self, spec: dict) -> bytes | None:
+        """Locality-aware placement (reference lease_policy.h +
+        hybrid_scheduling_policy's locality term): when a task's plasma
+        deps weigh more than locality_min_bytes, prefer the alive node
+        already holding the most dependency bytes — moving the task beats
+        moving the data."""
+        deps = spec.get("deps") or []
+        if not deps or spec.get("pg_id") or spec.get("scheduling_strategy") \
+                or spec.get("_spills", 0) >= SPILL_MAX:
+            return None
+        # cheap outs before a head round-trip: single-node clusters and
+        # all-deps-local submissions gain nothing from the directory
+        if not any(v.get("alive") and nid != self.node_id
+                   for nid, v in self.cluster_view.items()):
+            return None
+        if all(self.store.contains(d) for d in deps):
+            return None
+        try:
+            info = await self.head.call(
+                "object_locations_bulk", {"object_ids": list(deps)},
+                timeout=2.0,
+            )
+        except (rpc.ConnectionLost, rpc.RpcError, asyncio.TimeoutError):
+            return None
+        per_node: dict[bytes, float] = {}
+        for meta in info.values():
+            weight = float(meta.get("size") or 1)
+            for nid in meta["locations"]:
+                per_node[nid] = per_node.get(nid, 0.0) + weight
+        if not per_node:
+            return None
+        best, best_bytes = max(per_node.items(), key=lambda kv: kv[1])
+        if best_bytes < cfg.get("locality_min_bytes"):
+            return None
+        need = spec.get("resources", {})
+        if best == self.node_id:
+            return None  # local queueing path handles it
+        view = self.cluster_view.get(best)
+        if view is None or not view.get("alive"):
+            return None
+        if all(view.get("resources_total", {}).get(r, 0) >= v
+               for r, v in need.items()):
+            return best
+        return None
 
     def _choose_node(self, spec: dict) -> bytes | None:
         """Hybrid policy (hybrid_scheduling_policy.h:29): local first while
@@ -667,6 +717,19 @@ class NodeAgent:
                 continue
             need = spec.get("resources", {})
             if not self._fits(need, pool):
+                # A task this node can never satisfy re-evaluates the
+                # cluster as nodes join (autoscaled capacity) instead of
+                # queueing forever.
+                if (not spec.get("pg_id")
+                        and not self._fits(need, self.resources_total)
+                        and spec.get("_spills", 0) < SPILL_MAX):
+                    target = self._choose_node(spec)
+                    if target is not None and target != self.node_id:
+                        spec["_spills"] += 1
+                        if await self._forward_task(spec, target):
+                            progressed = True
+                            continue
+                        spec["_spills"] -= 1
                 self.task_queue.append(spec)
                 continue
             deps = spec.get("deps", [])

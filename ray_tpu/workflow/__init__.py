@@ -1,0 +1,12 @@
+"""Durable workflows: DAG execution with per-step persisted results.
+
+Reference: python/ray/workflow (workflow_executor.py,
+workflow_storage.py): every step's output is checkpointed to storage;
+re-running a workflow id skips completed steps, so a crashed driver
+resumes where it stopped.
+
+    result = workflow.run(dag, workflow_id="w1", storage="/path")
+    result = workflow.resume("w1", storage="/path")   # after a crash
+"""
+
+from ray_tpu.workflow.execution import resume, run  # noqa: F401

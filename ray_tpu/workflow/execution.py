@@ -1,0 +1,121 @@
+"""Workflow executor: DAG walk with step-level durability.
+
+Each DAGNode gets a deterministic step id (structural position + function
+name), mirroring the reference's workflow_state_from_dag step naming.
+Completed steps live as pickles under <storage>/<workflow_id>/; execution
+submits only missing steps as remote tasks (reference
+workflow_executor.py + workflow_storage.py, scaled to filesystem
+storage — the reference's default is the same local/NFS layout).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import cloudpickle
+from typing import Any
+
+import ray_tpu
+from ray_tpu.dag.dag_node import DAGNode, InputNode
+
+
+def _step_id(node: DAGNode, path: str) -> str:
+    name = getattr(node._remote_fn, "__name__", "step")
+    h = hashlib.blake2b(f"{path}:{name}".encode(), digest_size=8)
+    return f"{name}_{h.hexdigest()}"
+
+
+class _Store:
+    def __init__(self, storage: str, workflow_id: str):
+        self.dir = os.path.join(storage, workflow_id)
+        os.makedirs(self.dir, exist_ok=True)
+
+    def _path(self, step_id: str) -> str:
+        return os.path.join(self.dir, step_id + ".pkl")
+
+    def has(self, step_id: str) -> bool:
+        return os.path.exists(self._path(step_id))
+
+    def load(self, step_id: str):
+        with open(self._path(step_id), "rb") as f:
+            return cloudpickle.load(f)
+
+    def save(self, step_id: str, value) -> None:
+        tmp = self._path(step_id) + ".tmp"
+        with open(tmp, "wb") as f:
+            cloudpickle.dump(value, f)
+        os.replace(tmp, self._path(step_id))
+
+    def save_meta(self, key: str, value) -> None:
+        self.save("__" + key, value)
+
+    def load_meta(self, key: str):
+        sid = "__" + key
+        return self.load(sid) if self.has(sid) else None
+
+
+def _execute(node, store: _Store, input_args: tuple, path: str,
+             cache: dict, step_timeout_s: float | None) -> Any:
+    if not isinstance(node, DAGNode):
+        return node
+    if isinstance(node, InputNode):
+        return input_args[node._index]
+    if id(node) in cache:
+        return cache[id(node)]
+    sid = _step_id(node, path)
+    if store.has(sid):
+        value = store.load(sid)
+        cache[id(node)] = value
+        return value
+    args = tuple(
+        _execute(a, store, input_args, f"{path}/{i}", cache,
+                 step_timeout_s)
+        for i, a in enumerate(node._args)
+    )
+    kwargs = {
+        k: _execute(v, store, input_args, f"{path}/{k}", cache,
+                    step_timeout_s)
+        for k, v in node._kwargs.items()
+    }
+    value = ray_tpu.get(node._remote_fn.remote(*args, **kwargs),
+                        timeout=step_timeout_s)
+    store.save(sid, value)
+    cache[id(node)] = value
+    return value
+
+
+def run(dag: DAGNode, *, workflow_id: str, storage: str,
+        args: tuple = (), step_timeout_s: float | None = None) -> Any:
+    """Execute (or continue) the workflow; every completed step persists.
+
+    Reusing a workflow_id with different args is rejected (the persisted
+    step results were computed for the original args — reference behavior
+    for a live workflow id)."""
+    store = _Store(storage, workflow_id)
+    prev_args = store.load_meta("args")
+    if prev_args is not None and tuple(prev_args) != tuple(args):
+        raise ValueError(
+            f"workflow '{workflow_id}' already ran with args={prev_args}; "
+            "reuse requires identical args (or a new workflow_id)"
+        )
+    store.save_meta("dag", dag)
+    store.save_meta("args", args)
+    result = _execute(dag, store, args, "root", {}, step_timeout_s)
+    store.save_meta("result", result)
+    return result
+
+
+def resume(workflow_id: str, *, storage: str,
+           step_timeout_s: float | None = None) -> Any:
+    """Re-drive a previously-started workflow; finished steps are skipped
+    (reference workflow resume semantics)."""
+    store = _Store(storage, workflow_id)
+    done = store.load_meta("result")
+    if done is not None:
+        return done
+    dag = store.load_meta("dag")
+    if dag is None:
+        raise ValueError(f"unknown workflow id: {workflow_id}")
+    args = store.load_meta("args") or ()
+    return run(dag, workflow_id=workflow_id, storage=storage,
+               args=tuple(args), step_timeout_s=step_timeout_s)

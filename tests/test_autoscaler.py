@@ -1,0 +1,58 @@
+"""Autoscaler tests with the local (fake-multinode) provider.
+
+Reference analog: tests/test_autoscaler_fake_multinode.py, scaled: queued
+demand scales nodes up; idle nodes scale back down.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import Autoscaler, AutoscalerConfig, LocalNodeProvider
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster(head_resources={"CPU": 1, "memory": 2 * 2**30})
+    c.connect()
+    yield c
+    c.shutdown()
+
+
+def test_scale_up_then_down(cluster):
+    scaler = Autoscaler(
+        cluster._driver.head,
+        LocalNodeProvider(cluster),
+        AutoscalerConfig(
+            min_workers=0, max_workers=2,
+            worker_resources={"CPU": 2, "memory": 2 * 2**30},
+            idle_timeout_s=2.0, poll_interval_s=0.5,
+        ),
+    )
+    scaler.start()
+    try:
+
+        @ray_tpu.remote(num_cpus=2)  # cannot fit on the 1-CPU head node
+        def heavy(i):
+            import time as _t
+
+            _t.sleep(1.0)
+            return i
+
+        refs = [heavy.remote(i) for i in range(4)]
+        # demand forces scale-up beyond the head node
+        deadline = time.time() + 60
+        while time.time() < deadline and len(cluster.agents) < 2:
+            time.sleep(0.2)
+        assert len(cluster.agents) >= 2
+        assert sorted(ray_tpu.get(refs, timeout=120)) == [0, 1, 2, 3]
+
+        # idle nodes terminate back down to min_workers
+        deadline = time.time() + 60
+        while time.time() < deadline and len(cluster.agents) > 1:
+            time.sleep(0.5)
+        assert len(cluster.agents) == 1  # just the head node remains
+    finally:
+        scaler.stop()

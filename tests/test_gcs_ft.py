@@ -71,6 +71,15 @@ def test_head_restart_agents_reregister_and_schedule(cluster):
 def test_head_restart_objects_reannounced(cluster):
     ref = ray_tpu.put(np.arange(300_000))  # plasma-sized
     cluster.restart_head()
-    # the agent re-announces its primaries; the directory knows it again
-    out = ray_tpu.get(ref, timeout=60)
+    # wait for the agent to reconnect + re-register before fetching: the
+    # re-announce rides the reconnect path
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        try:
+            if any(n["alive"] for n in ray_tpu.nodes()):
+                break
+        except Exception:
+            pass
+        time.sleep(0.2)
+    out = ray_tpu.get(ref, timeout=90)
     assert out[-1] == 299_999
