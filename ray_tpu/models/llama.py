@@ -278,10 +278,26 @@ def forward(params, tokens, cfg: LlamaConfig, *, positions=None):
 
     layer_fn = lambda h_, p_: (_layer(cfg, h_, p_, sin, cos), None)
     if cfg.remat:
-        policy = (
-            jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-            if cfg.remat_policy == "dots" else None
-        )
+        if cfg.remat_policy == "dots":
+            policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        elif cfg.remat_policy == "dots_flash":
+            # dots + the flash kernel's named (out, lse) residuals: the
+            # backward reuses them instead of re-running the forward
+            # attention kernel — costs ~B*T*H*(D+1) extra saved floats
+            # per layer, so use when HBM headroom allows.
+            policy = jax.checkpoint_policies.save_from_both_policies(
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                jax.checkpoint_policies.save_only_these_names(
+                    "flash_out", "flash_lse"
+                ),
+            )
+        elif cfg.remat_policy == "nothing":
+            policy = None  # full remat: only layer inputs survive
+        else:
+            raise ValueError(
+                f"unknown remat_policy {cfg.remat_policy!r}; expected "
+                "'dots', 'dots_flash', or 'nothing'"
+            )
         layer_fn = jax.checkpoint(layer_fn, policy=policy)
     h, _ = jax.lax.scan(layer_fn, h, params["layers"])
 

@@ -431,26 +431,55 @@ def _flash_bwd(q, k, v, o, lse, do, *, causal, block_q, block_k, interpret):
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_k, interpret):
-    out, _ = _flash_fwd(q, k, v, causal=causal, block_q=block_q, block_k=block_k, interpret=interpret)
+# The forward kernel runs OUTSIDE the custom_vjp and its (out, lse) pass
+# through `_flash_apply` under stop_gradient: gradients flow only via the
+# apply's vjp (the flash-2 backward), while out/lse are plain graph
+# tensors that jax.checkpoint policies can save BY NAME ("flash_out" /
+# "flash_lse"). Under remat that skips re-running the forward kernel in
+# the backward pass (the biggest recompute in the layer) at the cost of
+# ~T*(d+1) floats per layer.
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_apply(q, k, v, out, lse, causal, block_q, block_k, interpret):
     return out
 
 
-def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out, lse = _flash_fwd(q, k, v, causal=causal, block_q=block_q, block_k=block_k, interpret=interpret)
+def _flash_apply_fwd(q, k, v, out, lse, causal, block_q, block_k,
+                     interpret):
     return out, (q, k, v, out, lse)
 
 
-def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, g):
+def _flash_apply_bwd(causal, block_q, block_k, interpret, res, g):
     q, k, v, o, lse = res
-    return _flash_bwd(
+    dq, dk, dv = _flash_bwd(
         q, k, v, o, lse, g, causal=causal, block_q=block_q,
         block_k=block_k, interpret=interpret,
     )
+    # out/lse arrive stop_gradiented; their cotangents are unused
+    return dq, dk, dv, jnp.zeros_like(o), jnp.zeros_like(lse)
 
 
-_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+_flash_apply.defvjp(_flash_apply_fwd, _flash_apply_bwd)
+
+
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    from jax.ad_checkpoint import checkpoint_name
+
+    # stop_gradient on the kernel inputs: no tangents may enter the
+    # pallas forward (it has no JVP rule and must not need one — all
+    # differentiation rides _flash_apply's custom_vjp)
+    out, lse = _flash_fwd(
+        jax.lax.stop_gradient(q), jax.lax.stop_gradient(k),
+        jax.lax.stop_gradient(v), causal=causal, block_q=block_q,
+        block_k=block_k, interpret=interpret,
+    )
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
+    return _flash_apply(
+        q, k, v, jax.lax.stop_gradient(out), jax.lax.stop_gradient(lse),
+        causal, block_q, block_k, interpret,
+    )
 
 
 def flash_attention(

@@ -118,3 +118,27 @@ def test_moe_top_k_masks_experts(rng):
         np.testing.assert_allclose(
             np.asarray(gates).sum(-1), 1.0, atol=1e-5
         )
+
+
+def test_remat_policies_agree(rng):
+    """dots vs dots_flash vs nothing: same gradients, different remat."""
+    tokens = jax.random.randint(rng, (2, 33), 0, 256)
+    batch = {"tokens": tokens}
+    grads = {}
+    for policy in ("dots", "dots_flash", "nothing"):
+        # use_flash=True: the flash kernel (interpret mode on CPU) must be
+        # in the graph or the flash_out/flash_lse plumbing goes untested
+        cfg = llama.LlamaConfig.tiny(
+            remat=True, remat_policy=policy, use_flash=True,
+            max_seq_len=32,
+        )
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        g = jax.grad(lambda p: llama.loss_fn(p, batch, cfg)[0])(params)
+        grads[policy] = g
+    for policy in ("dots_flash", "nothing"):
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4
+            ),
+            grads["dots"], grads[policy],
+        )

@@ -175,3 +175,51 @@ def test_checkpoint_roundtrip_sharded(cluster, tmp_path):
     np.testing.assert_allclose(np.asarray(got["w"]), np.asarray(x))
     assert got["step"] == 3
     assert Checkpoint(path).to_dict() == {"tag": "hi"}
+
+
+def test_trainer_consumes_streaming_dataset(cluster, tmp_path):
+    """Data->Train integration (VERDICT round-1 item 6 'done' bar): each
+    train worker consumes its own streaming_split shard."""
+    from ray_tpu import data as rdata
+
+    ds = rdata.range(64, parallelism=4, block_size=8)
+    shards = ds.streaming_split(NUM_WORKERS)
+
+    def data_loop(config):
+        import json
+        import os as _os
+
+        import numpy as np
+
+        from ray_tpu.train import session
+
+        rank = session.get_world_rank()
+        it = config["shards"][rank]
+        total = 0
+        seen = []
+        for block in it:
+            total += int(np.sum(block))
+            seen.extend(int(v) for v in block)
+        with open(_os.path.join(config["out"], f"rank{rank}.json"),
+                  "w") as f:
+            json.dump({"total": total, "seen": seen}, f)
+        session.report({"total": total, "blocks": len(seen)})
+
+    trainer = JaxTrainer(
+        data_loop,
+        train_loop_config={"shards": shards, "out": str(tmp_path)},
+        scaling_config=_scaling(),
+        run_config=RunConfig(name="data_train", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    import json
+
+    per_rank = [
+        json.load(open(tmp_path / f"rank{r}.json"))
+        for r in range(NUM_WORKERS)
+    ]
+    # disjoint shards covering the whole range exactly once
+    all_seen = sorted(v for p in per_rank for v in p["seen"])
+    assert all_seen == list(range(64))
+    assert sum(p["total"] for p in per_rank) == sum(range(64))
