@@ -234,3 +234,44 @@ def test_locality_aware_scheduling(cluster3):
     nodes = {n for n, _ in outs}
     assert victim_free.node_id.hex() in nodes
     assert all(v == 1.0 for _, v in outs)
+
+
+def test_tpu_slice_gang_placement():
+    """TPU-first scheduling: a STRICT_PACK gang over TPU chips + the
+    tpu-slice topology resource lands on the one node exposing that slice
+    (SURVEY §7: PG bundles map to ICI sub-meshes)."""
+    c = Cluster(head_resources={"CPU": 2, "memory": 2 * 2**30})
+    tpu_node = c.add_node(resources={
+        "CPU": 4, "memory": 2 * 2**30, "TPU": 4, "tpu-slice:v5e-4": 1,
+    })
+    # decoy: same chip count, DIFFERENT slice topology — the slice
+    # resource in bundle 0 must discriminate between them
+    c.add_node(resources={
+        "CPU": 4, "memory": 2 * 2**30, "TPU": 4, "tpu-slice:v5e-8": 1,
+    })
+    c.connect()
+    try:
+        pg = ray_tpu.placement_group(
+            [{"TPU": 2, "CPU": 1, "tpu-slice:v5e-4": 1},
+             {"TPU": 2, "CPU": 1}],
+            strategy="STRICT_PACK",
+        )
+        assert pg.ready(timeout=30)
+        assert set(pg.bundle_nodes) == {tpu_node.node_id}
+
+        @ray_tpu.remote(num_cpus=1, num_tpus=2)
+        def where():
+            import os
+
+            return os.environ["RAY_TPU_NODE_ID"]
+
+        homes = ray_tpu.get(
+            [where.options(placement_group=pg,
+                           placement_group_bundle_index=i).remote()
+             for i in range(2)],
+            timeout=120,
+        )
+        assert all(h == tpu_node.node_id.hex() for h in homes)
+        ray_tpu.remove_placement_group(pg)
+    finally:
+        c.shutdown()
