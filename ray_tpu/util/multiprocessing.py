@@ -1,0 +1,167 @@
+"""multiprocessing.Pool drop-in on tasks.
+
+Reference: python/ray/util/multiprocessing/ — the stdlib Pool surface
+(map/starmap/apply/imap, sync + async) executing as cluster tasks, so a
+`from ray_tpu.util.multiprocessing import Pool` swap distributes existing
+Pool-based code. Semantics matched to the stdlib: `processes` bounds
+in-flight tasks, imap is lazy, initializer runs once per worker process,
+closed pools reject work, and get() timeouts raise
+multiprocessing.TimeoutError.
+"""
+
+from __future__ import annotations
+
+from multiprocessing import TimeoutError as MpTimeoutError
+from typing import Any, Callable, Iterable
+
+import ray_tpu
+from ray_tpu._private.worker import GetTimeoutError
+
+# worker-process-local marker: which pool initializers already ran here
+_initialized_pools: set = set()
+
+
+def _run_with_init(pool_id, initializer, initargs, fn, *args, **kwargs):
+    if initializer is not None and pool_id not in _initialized_pools:
+        initializer(*initargs)
+        _initialized_pools.add(pool_id)
+    return fn(*args, **kwargs)
+
+
+class AsyncResult:
+    def __init__(self, refs, single: bool):
+        self._refs = refs
+        self._single = single
+
+    def get(self, timeout: float | None = None):
+        try:
+            out = ray_tpu.get(self._refs, timeout=timeout)
+        except GetTimeoutError as e:
+            raise MpTimeoutError(str(e)) from e
+        return out[0] if self._single else out
+
+    def wait(self, timeout: float | None = None):
+        ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                     timeout=timeout)
+
+    def ready(self) -> bool:
+        done, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                               timeout=0)
+        return len(done) == len(self._refs)
+
+
+class Pool:
+    """Task-backed process pool (ray.util.multiprocessing.Pool analog)."""
+
+    def __init__(self, processes: int | None = None, initializer=None,
+                 initargs: tuple = (), maxtasksperchild=None):
+        # maxtasksperchild is accepted for drop-in compatibility; worker
+        # recycling is the runtime's policy, not the pool's
+        self._limit = processes or 8
+        self._initializer = initializer
+        self._initargs = tuple(initargs)
+        self._pool_id = id(self)
+        self._closed = False
+
+    def _check_open(self):
+        if self._closed:
+            raise ValueError("Pool not running")
+
+    def _remote(self, fn: Callable):
+        import functools
+
+        task = ray_tpu.remote(num_cpus=1)(
+            functools.partial(
+                _run_with_init, self._pool_id, self._initializer,
+                self._initargs, fn,
+            )
+        )
+        return task
+
+    def _submit_windowed(self, task, arglists) -> list:
+        """Submit with at most `processes` unfinished tasks in flight."""
+        refs, in_flight = [], []
+        for args in arglists:
+            if len(in_flight) >= self._limit:
+                _, in_flight = ray_tpu.wait(
+                    in_flight, num_returns=1, timeout=None
+                )
+            ref = task.remote(*args)
+            refs.append(ref)
+            in_flight.append(ref)
+        return refs
+
+    # -- sync --
+
+    def map(self, fn: Callable, iterable: Iterable) -> list:
+        return self.map_async(fn, iterable).get()
+
+    def starmap(self, fn: Callable, iterable: Iterable) -> list:
+        return self.starmap_async(fn, iterable).get()
+
+    def apply(self, fn: Callable, args: tuple = (),
+              kwds: dict | None = None):
+        return self.apply_async(fn, args, kwds).get()
+
+    def imap(self, fn: Callable, iterable: Iterable):
+        """Lazy: submits up to `processes` ahead, yields in order."""
+        self._check_open()
+        task = self._remote(fn)
+        from collections import deque
+
+        it = iter(iterable)
+        window: deque = deque()
+        try:
+            while len(window) < self._limit:
+                window.append(task.remote(next(it)))
+        except StopIteration:
+            it = None
+        while window:
+            yield ray_tpu.get(window.popleft())
+            if it is not None:
+                try:
+                    window.append(task.remote(next(it)))
+                except StopIteration:
+                    it = None
+
+    # -- async --
+
+    def map_async(self, fn: Callable, iterable: Iterable) -> AsyncResult:
+        self._check_open()
+        task = self._remote(fn)
+        return AsyncResult(
+            self._submit_windowed(task, ((x,) for x in iterable)),
+            single=False,
+        )
+
+    def starmap_async(self, fn: Callable,
+                      iterable: Iterable) -> AsyncResult:
+        self._check_open()
+        task = self._remote(fn)
+        return AsyncResult(
+            self._submit_windowed(task, iterable), single=False
+        )
+
+    def apply_async(self, fn: Callable, args: tuple = (),
+                    kwds: dict | None = None) -> AsyncResult:
+        self._check_open()
+        task = self._remote(fn)
+        return AsyncResult([task.remote(*args, **(kwds or {}))],
+                           single=True)
+
+    # -- lifecycle --
+
+    def close(self):
+        self._closed = True
+
+    def terminate(self):
+        self._closed = True
+
+    def join(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

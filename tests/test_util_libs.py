@@ -76,3 +76,56 @@ def test_dag_bind_execute(cluster):
     graph = mul.bind(s, s)  # shared node executes once
     ref = graph.execute(5)
     assert ray_tpu.get(ref, timeout=60) == 225  # (5+10)^2
+
+
+def test_multiprocessing_pool_shim(cluster):
+    from ray_tpu.util.multiprocessing import Pool
+
+    def sq(x):
+        return x * x
+
+    def add(a, b):
+        return a + b
+
+    with Pool(processes=4) as p:
+        assert p.map(sq, range(6)) == [0, 1, 4, 9, 16, 25]
+        assert p.starmap(add, [(1, 2), (3, 4)]) == [3, 7]
+        assert p.apply(add, (20, 22)) == 42
+        r = p.map_async(sq, [2, 3])
+        assert r.get(timeout=60) == [4, 9]
+        assert list(p.imap(sq, [5])) == [25]
+    # closed pool rejects work (stdlib semantics)
+    with pytest.raises(ValueError, match="not running"):
+        p.map(sq, [1])
+
+
+def test_multiprocessing_pool_initializer_and_lazy_imap(cluster):
+    from ray_tpu.util.multiprocessing import Pool
+
+    def init_env(tag):
+        import os as _os
+
+        _os.environ["POOL_TAG"] = tag
+
+    def read_tag(_):
+        import os as _os
+
+        return _os.environ.get("POOL_TAG")
+
+    with Pool(processes=2, initializer=init_env,
+              initargs=("hello",)) as p:
+        assert p.map(read_tag, range(3)) == ["hello"] * 3
+
+        # lazy imap: pulls from the generator incrementally
+        pulled = []
+
+        def gen():
+            for i in range(6):
+                pulled.append(i)
+                yield i
+
+        out_iter = p.imap(lambda x: x + 1, gen())
+        first = next(out_iter)
+        assert first == 1
+        assert len(pulled) <= 4  # window of `processes`+1, not all 6
+        assert list(out_iter) == [2, 3, 4, 5, 6]
