@@ -25,6 +25,7 @@ from ray_tpu.ops.attention import attention
 from ray_tpu.ops.losses import softmax_cross_entropy
 from ray_tpu.ops.norms import rms_norm
 from ray_tpu.ops.rope import apply_rotary, rotary_embedding
+from ray_tpu.parallel.pipeline import pipeline_apply, pipeline_stages
 from ray_tpu.parallel.sharding import shard_constraint
 
 
@@ -53,6 +54,10 @@ class LlamaConfig:
     # parallelism; the reference has no MoE at all, SURVEY §2.7).
     n_experts: int = 0
     top_k: int = 2
+    # GPipe microbatch count when the ambient mesh has a pp axis > 1
+    # (parallel/pipeline.py). 0 = auto (4 microbatches per stage, capped at
+    # the batch size). Ignored on pp=1 meshes.
+    pipeline_microbatches: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -299,7 +304,23 @@ def forward(params, tokens, cfg: LlamaConfig, *, positions=None):
                 "'dots', 'dots_flash', or 'nothing'"
             )
         layer_fn = jax.checkpoint(layer_fn, policy=policy)
-    h, _ = jax.lax.scan(layer_fn, h, params["layers"])
+
+    pp = pipeline_stages()
+    if pp > 1:
+        # Layer stack sharded over pp (rule "layers" -> "pp"): stream
+        # microbatches through the stages instead of scanning a stack that
+        # GSPMD would have to all-gather every iteration.
+        mb = cfg.pipeline_microbatches
+        if not mb:  # auto: largest divisor of the batch <= 4 stages' worth
+            mb = max(d_ for d_ in range(1, min(b, 4 * pp) + 1) if b % d_ == 0)
+        h = pipeline_apply(
+            lambda c, p_: layer_fn(c, p_)[0],
+            params["layers"],
+            h,
+            num_microbatches=mb,
+        )
+    else:
+        h, _ = jax.lax.scan(layer_fn, h, params["layers"])
 
     h = rms_norm(h, params["final_norm"], cfg.rms_eps)
     w_out = (

@@ -2,10 +2,15 @@
 
 The reference framework has no native model/sequence parallelism (SURVEY.md §2.7:
 DP arrives via torch DDP in `train/torch/config.py`, TP/PP only via out-of-tree
-Alpa, SP absent). Here every strategy is a mesh axis: dp / fsdp / ep / sp / tp
-(+ pp reserved), and GSPMD inserts the collectives.
+Alpa, SP absent). Here every strategy is a mesh axis: dp / pp / fsdp / ep / sp /
+tp, and GSPMD inserts the collectives (pp is the one manual axis — a GPipe
+microbatch pipeline in parallel/pipeline.py).
 """
 
+from ray_tpu.parallel.pipeline import (  # noqa: F401
+    pipeline_apply,
+    pipeline_stages,
+)
 from ray_tpu.parallel.mesh import (  # noqa: F401
     AXES,
     MeshConfig,

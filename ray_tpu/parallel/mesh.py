@@ -20,11 +20,13 @@ import numpy as np
 from jax.sharding import Mesh
 
 # Canonical mesh axis order. dp outermost (pure data parallel, gradients
-# all-reduced), fsdp (data parallel + fully-sharded params, ZeRO-3 analog),
-# ep (expert parallel for MoE), sp (sequence/context parallel), tp innermost
-# (tensor parallel — highest-traffic axis, so it should map to the
-# fastest/nearest ICI neighbors).
-AXES = ("dp", "fsdp", "ep", "sp", "tp")
+# all-reduced), pp next (pipeline stages — lowest-bandwidth traffic, one
+# activation ppermute per microbatch tick, so it maps to DCN across slices),
+# fsdp (data parallel + fully-sharded params, ZeRO-3 analog), ep (expert
+# parallel for MoE), sp (sequence/context parallel), tp innermost (tensor
+# parallel — highest-traffic axis, so it should map to the fastest/nearest
+# ICI neighbors).
+AXES = ("dp", "pp", "fsdp", "ep", "sp", "tp")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -32,6 +34,7 @@ class MeshConfig:
     """Sizes for each mesh axis. Product must equal the device count."""
 
     dp: int = 1
+    pp: int = 1
     fsdp: int = 1
     ep: int = 1
     sp: int = 1
@@ -39,7 +42,7 @@ class MeshConfig:
 
     @property
     def shape(self) -> tuple[int, ...]:
-        return (self.dp, self.fsdp, self.ep, self.sp, self.tp)
+        return (self.dp, self.pp, self.fsdp, self.ep, self.sp, self.tp)
 
     @property
     def size(self) -> int:
@@ -72,12 +75,14 @@ def auto_mesh_config(
     want_tp: int = 0,
     want_sp: int = 0,
     want_ep: int = 0,
+    want_pp: int = 0,
     prefer_fsdp: bool = True,
 ) -> MeshConfig:
     """Factor ``n_devices`` into a sensible mesh.
 
     Defaults put everything on fsdp (ZeRO-3-style) which is the robust choice
-    for single-slice training; callers can reserve explicit tp/sp/ep factors.
+    for single-slice training; callers can reserve explicit tp/sp/ep/pp
+    factors.
     """
     rem = n_devices
     tp = _take_factor(rem, want_tp)
@@ -86,11 +91,13 @@ def auto_mesh_config(
     rem //= sp
     ep = _take_factor(rem, want_ep)
     rem //= ep
+    pp = _take_factor(rem, want_pp)
+    rem //= pp
     if prefer_fsdp:
         fsdp, dp = rem, 1
     else:
         dp, fsdp = rem, 1
-    return MeshConfig(dp=dp, fsdp=fsdp, ep=ep, sp=sp, tp=tp)
+    return MeshConfig(dp=dp, pp=pp, fsdp=fsdp, ep=ep, sp=sp, tp=tp)
 
 
 def _take_factor(n: int, want: int) -> int:

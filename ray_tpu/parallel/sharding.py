@@ -30,7 +30,7 @@ DEFAULT_RULES: LogicalRules = (
     ("head_dim", None),
     ("mlp", "tp"),
     ("vocab", "tp"),
-    ("layers", None),
+    ("layers", "pp"),
     ("expert", "ep"),
     ("norm", None),
 )

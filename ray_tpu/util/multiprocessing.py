@@ -14,11 +14,20 @@ from __future__ import annotations
 from multiprocessing import TimeoutError as MpTimeoutError
 from typing import Any, Callable, Iterable
 
+import itertools
+import os
+import threading
+
 import ray_tpu
 from ray_tpu._private.worker import GetTimeoutError
 
 # worker-process-local marker: which pool initializers already ran here
 _initialized_pools: set = set()
+
+# Pool ids must never collide across live-or-dead pools in one driver
+# (id(self) can be recycled by the allocator); pid guards against forked
+# drivers sharing a counter state.
+_pool_counter = itertools.count()
 
 
 def _run_with_init(pool_id, initializer, initargs, fn, *args, **kwargs):
@@ -29,11 +38,23 @@ def _run_with_init(pool_id, initializer, initargs, fn, *args, **kwargs):
 
 
 class AsyncResult:
-    def __init__(self, refs, single: bool):
+    def __init__(self, refs, single: bool,
+                 submitter: threading.Thread | None = None):
         self._refs = refs
         self._single = single
+        self._submitter = submitter
+
+    def _join_submitter(self, block: bool = True) -> bool:
+        """True once every task has been submitted (refs list final)."""
+        if self._submitter is not None:
+            self._submitter.join(None if block else 0)
+            if self._submitter.is_alive():
+                return False
+            self._submitter = None
+        return True
 
     def get(self, timeout: float | None = None):
+        self._join_submitter()
         try:
             out = ray_tpu.get(self._refs, timeout=timeout)
         except GetTimeoutError as e:
@@ -41,10 +62,13 @@ class AsyncResult:
         return out[0] if self._single else out
 
     def wait(self, timeout: float | None = None):
+        self._join_submitter()
         ray_tpu.wait(self._refs, num_returns=len(self._refs),
                      timeout=timeout)
 
     def ready(self) -> bool:
+        if not self._join_submitter(block=False):
+            return False
         done, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs),
                                timeout=0)
         return len(done) == len(self._refs)
@@ -60,7 +84,7 @@ class Pool:
         self._limit = processes or 8
         self._initializer = initializer
         self._initargs = tuple(initargs)
-        self._pool_id = id(self)
+        self._pool_id = f"{os.getpid()}-{next(_pool_counter)}"
         self._closed = False
 
     def _check_open(self):
@@ -78,18 +102,30 @@ class Pool:
         )
         return task
 
-    def _submit_windowed(self, task, arglists) -> list:
-        """Submit with at most `processes` unfinished tasks in flight."""
-        refs, in_flight = [], []
-        for args in arglists:
-            if len(in_flight) >= self._limit:
-                _, in_flight = ray_tpu.wait(
-                    in_flight, num_returns=1, timeout=None
-                )
-            ref = task.remote(*args)
-            refs.append(ref)
-            in_flight.append(ref)
-        return refs
+    def _submit_windowed(self, task, arglists) -> AsyncResult:
+        """Submit with at most `processes` unfinished tasks in flight.
+
+        Windowing runs on a daemon thread so the *_async entry points
+        return immediately (stdlib contract); AsyncResult joins the
+        thread before resolving results.
+        """
+        args_all = list(arglists)
+        refs: list = []
+
+        def pump():
+            in_flight: list = []
+            for args in args_all:
+                if len(in_flight) >= self._limit:
+                    _, in_flight = ray_tpu.wait(
+                        in_flight, num_returns=1, timeout=None
+                    )
+                ref = task.remote(*args)
+                refs.append(ref)
+                in_flight.append(ref)
+
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+        return AsyncResult(refs, single=False, submitter=t)
 
     # -- sync --
 
@@ -129,18 +165,13 @@ class Pool:
     def map_async(self, fn: Callable, iterable: Iterable) -> AsyncResult:
         self._check_open()
         task = self._remote(fn)
-        return AsyncResult(
-            self._submit_windowed(task, ((x,) for x in iterable)),
-            single=False,
-        )
+        return self._submit_windowed(task, ((x,) for x in iterable))
 
     def starmap_async(self, fn: Callable,
                       iterable: Iterable) -> AsyncResult:
         self._check_open()
         task = self._remote(fn)
-        return AsyncResult(
-            self._submit_windowed(task, iterable), single=False
-        )
+        return self._submit_windowed(task, iterable)
 
     def apply_async(self, fn: Callable, args: tuple = (),
                     kwds: dict | None = None) -> AsyncResult:
