@@ -54,12 +54,16 @@ def main():
             "max_seq_len": seq,
             "dtype": "bfloat16",
             "remat": True,
+            # save the flash kernel's (out, lse) residuals: the backward
+            # reuses them instead of re-running the forward attention
+            "remat_policy": "dots_flash",
         }
     )
     n_params = cfg.num_params()
 
     mesh = build_mesh(MeshConfig(), jax.devices()[:1])
-    opt = optax.adamw(1e-4, weight_decay=0.01)
+    # bf16 first moment: halves mu HBM traffic; nu stays f32 for stability
+    opt = optax.adamw(1e-4, weight_decay=0.01, mu_dtype=jnp.bfloat16)
     state, state_sh = init_train_state(
         lambda k: llama.init_params(cfg, k),
         llama.param_logical_axes(cfg),
@@ -84,10 +88,19 @@ def main():
 
     with use_mesh(mesh):
         data = jax.device_put(data, batch_sharding(mesh))
-        # Warmup / compile.
-        for _ in range(2):
-            state, metrics = step(state, data)
-        sync(metrics)
+        # Warmup / compile. The axon remote-compile helper intermittently
+        # 500s on large fresh programs; retry before giving up (cached
+        # compiles are unaffected).
+        for attempt in range(4):
+            try:
+                for _ in range(2):
+                    state, metrics = step(state, data)
+                sync(metrics)
+                break
+            except Exception:
+                if attempt == 3:
+                    raise
+                time.sleep(20)
         t0 = time.perf_counter()
         sync(metrics)
         sync_overhead = time.perf_counter() - t0

@@ -1,0 +1,50 @@
+"""Native (C++) runtime components, built lazily with g++.
+
+The reference implements its scheduler/raylet substrate in C++
+(reference: src/ray/raylet/scheduling/, common/scheduling/fixed_point.h);
+this package holds the TPU build's native equivalents, compiled on first
+use the same way as the C++ shared-memory object store
+(core/object_store/_build.py). Every consumer degrades gracefully to a
+pure-Python path if a toolchain is missing.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_lock = threading.Lock()
+
+
+def ensure_built(stem: str) -> str:
+    """Compile ``{stem}.cc`` in this directory to ``_{stem}.so`` (cached)."""
+    src = os.path.join(_DIR, f"{stem}.cc")
+    so = os.path.join(_DIR, f"_{stem}.so")
+
+    def stale() -> bool:
+        return (
+            not os.path.exists(so)
+            or os.path.getmtime(so) < os.path.getmtime(src)
+        )
+
+    with _lock:
+        if not stale():
+            return so
+        with open(so + ".lock", "w") as lf:
+            fcntl.flock(lf, fcntl.LOCK_EX)
+            try:
+                if not stale():  # built while we waited
+                    return so
+                tmp = f"{so}.{os.getpid()}.tmp"
+                cmd = [
+                    "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                    "-o", tmp, src,
+                ]
+                subprocess.run(cmd, check=True, capture_output=True)
+                os.replace(tmp, so)
+                return so
+            finally:
+                fcntl.flock(lf, fcntl.LOCK_UN)

@@ -1,0 +1,206 @@
+// Native cluster-resource scheduler core.
+//
+// TPU-native re-design of the reference raylet's scheduling substrate
+// (reference: src/ray/raylet/scheduling/cluster_resource_scheduler.h:44,
+// policy/hybrid_scheduling_policy.h:29, common/scheduling/fixed_point.h):
+// fixed-point resource vectors (no float drift in repeated grant/return
+// cycles) and the hybrid placement policy — prefer the local node while its
+// critical-resource utilization stays under a threshold, otherwise rank
+// feasible nodes by utilization score and pick uniformly among the top-k
+// (seeded, so placement is reproducible for tests).
+//
+// Exposed as a C ABI consumed via ctypes (no pybind11 in this image); the
+// Python agent keeps PG / affinity / locality shortcuts and delegates the
+// general ranking decision here.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <random>
+#include <string>
+#include <vector>
+
+namespace {
+
+using FixedPoint = int64_t;            // value * kScale, round-to-nearest
+constexpr int64_t kScale = 10000;
+
+FixedPoint FromDouble(double v) {
+  return static_cast<FixedPoint>(v * kScale + (v >= 0 ? 0.5 : -0.5));
+}
+
+struct NodeEntry {
+  bool alive = true;
+  std::map<std::string, FixedPoint> total;
+  std::map<std::string, FixedPoint> available;
+};
+
+struct Scheduler {
+  std::map<std::string, NodeEntry> nodes;
+};
+
+FixedPoint GetOr0(const std::map<std::string, FixedPoint>& m,
+                  const std::string& k) {
+  auto it = m.find(k);
+  return it == m.end() ? 0 : it->second;
+}
+
+bool Fits(const NodeEntry& node,
+          const std::map<std::string, FixedPoint>& demand, bool use_available) {
+  for (const auto& [name, amt] : demand) {
+    if (amt <= 0) continue;
+    const auto& pool = use_available ? node.available : node.total;
+    if (GetOr0(pool, name) < amt) return false;
+  }
+  return true;
+}
+
+// Critical-resource utilization in [0, 1]: the max over demanded resources of
+// (used + demand) / total. Lower is better (reference scores by utilization
+// the same way; nodes near-idle on every demanded resource score ~0).
+double Score(const NodeEntry& node,
+             const std::map<std::string, FixedPoint>& demand) {
+  double worst = 0.0;
+  for (const auto& [name, amt] : demand) {
+    FixedPoint total = GetOr0(node.total, name);
+    if (total <= 0) continue;
+    FixedPoint avail = GetOr0(node.available, name);
+    double util =
+        static_cast<double>(total - avail + amt) / static_cast<double>(total);
+    worst = std::max(worst, util);
+  }
+  return worst;
+}
+
+std::map<std::string, FixedPoint> BuildDemand(const char** names,
+                                              const double* amounts, int n) {
+  std::map<std::string, FixedPoint> demand;
+  for (int i = 0; i < n; ++i) demand[names[i]] += FromDouble(amounts[i]);
+  return demand;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* sched_new() { return new Scheduler(); }
+
+void sched_free(void* h) { delete static_cast<Scheduler*>(h); }
+
+// Replace a node's resource view. names/totals/availables are parallel
+// arrays of length n.
+void sched_upsert_node(void* h, const char* node_id, int alive,
+                       const char** names, const double* totals,
+                       const double* availables, int n) {
+  auto* s = static_cast<Scheduler*>(h);
+  NodeEntry e;
+  e.alive = alive != 0;
+  for (int i = 0; i < n; ++i) {
+    e.total[names[i]] = FromDouble(totals[i]);
+    e.available[names[i]] = FromDouble(availables[i]);
+  }
+  s->nodes[node_id] = std::move(e);
+}
+
+void sched_remove_node(void* h, const char* node_id) {
+  static_cast<Scheduler*>(h)->nodes.erase(node_id);
+}
+
+int sched_num_nodes(void* h) {
+  return static_cast<int>(static_cast<Scheduler*>(h)->nodes.size());
+}
+
+// Acquire (deduct) demand from a node's availability. Returns 1 on success,
+// 0 if it no longer fits (nothing deducted).
+int sched_acquire(void* h, const char* node_id, const char** names,
+                  const double* amounts, int n) {
+  auto* s = static_cast<Scheduler*>(h);
+  auto it = s->nodes.find(node_id);
+  if (it == s->nodes.end()) return 0;
+  auto demand = BuildDemand(names, amounts, n);
+  if (!Fits(it->second, demand, /*use_available=*/true)) return 0;
+  for (const auto& [name, amt] : demand) it->second.available[name] -= amt;
+  return 1;
+}
+
+// Return (restore) resources to a node, clamped to its total.
+void sched_release(void* h, const char* node_id, const char** names,
+                   const double* amounts, int n) {
+  auto* s = static_cast<Scheduler*>(h);
+  auto it = s->nodes.find(node_id);
+  if (it == s->nodes.end()) return;
+  auto demand = BuildDemand(names, amounts, n);
+  for (const auto& [name, amt] : demand) {
+    FixedPoint& avail = it->second.available[name];
+    avail = std::min(avail + amt, GetOr0(it->second.total, name));
+  }
+}
+
+double sched_available(void* h, const char* node_id, const char* resource) {
+  auto* s = static_cast<Scheduler*>(h);
+  auto it = s->nodes.find(node_id);
+  if (it == s->nodes.end()) return 0.0;
+  return static_cast<double>(GetOr0(it->second.available, resource)) / kScale;
+}
+
+// Hybrid policy pick. Writes the chosen node id (NUL-terminated) into
+// out/out_len. Returns:
+//   1 = placed (out = node id), 0 = infeasible everywhere (no node's TOTAL
+//   fits), 2 = feasible-but-busy (out = best queue target: the feasible
+//   node with the lowest score).
+// local_node_id: "" for a detached (head-side) decision.
+// spread != 0 ranks purely by score (no local preference) — the SPREAD
+// strategy; threshold is the local-preference utilization cap.
+int sched_pick(void* h, const char* local_node_id, const char** names,
+               const double* amounts, int n, double threshold, int top_k,
+               int spread, uint64_t seed, char* out, int out_len) {
+  auto* s = static_cast<Scheduler*>(h);
+  auto demand = BuildDemand(names, amounts, n);
+
+  const NodeEntry* local = nullptr;
+  auto lit = s->nodes.find(local_node_id);
+  if (lit != s->nodes.end() && lit->second.alive) local = &lit->second;
+
+  // Local-first: run here while the local node both fits the demand now and
+  // stays under the utilization threshold.
+  if (!spread && local && Fits(*local, demand, true) &&
+      Score(*local, demand) <= threshold) {
+    std::snprintf(out, out_len, "%s", local_node_id);
+    return 1;
+  }
+
+  std::vector<std::pair<double, const std::string*>> ready;   // avail fits
+  std::vector<std::pair<double, const std::string*>> feasible;  // total fits
+  for (const auto& [id, node] : s->nodes) {
+    if (!node.alive) continue;
+    if (!Fits(node, demand, /*use_available=*/false)) continue;
+    double sc = Score(node, demand);
+    feasible.emplace_back(sc, &id);
+    if (Fits(node, demand, /*use_available=*/true)) ready.emplace_back(sc, &id);
+  }
+  if (feasible.empty()) {
+    out[0] = '\0';
+    return 0;
+  }
+  auto pick_top_k = [&](std::vector<std::pair<double, const std::string*>>& c) {
+    std::sort(c.begin(), c.end(),
+              [](const auto& a, const auto& b) {
+                return a.first != b.first ? a.first < b.first
+                                          : *a.second < *b.second;
+              });
+    size_t k = std::min<size_t>(std::max(top_k, 1), c.size());
+    std::mt19937_64 rng(seed);
+    return *c[rng() % k].second;
+  };
+  if (!ready.empty()) {
+    std::snprintf(out, out_len, "%s", pick_top_k(ready).c_str());
+    return 1;
+  }
+  // Feasible in total but busy everywhere: queue at the least-utilized
+  // feasible node.
+  std::snprintf(out, out_len, "%s", pick_top_k(feasible).c_str());
+  return 2;
+}
+
+}  // extern "C"

@@ -1,0 +1,137 @@
+"""ctypes bindings for the native cluster-resource scheduler.
+
+Mirrors the reference's C++ ClusterResourceScheduler + hybrid policy
+(reference: src/ray/raylet/scheduling/cluster_resource_scheduler.h:44,
+policy/hybrid_scheduling_policy.h:29) as a small C ABI: fixed-point
+resource accounting and seeded top-k hybrid placement. `NativeScheduler`
+raises on construction if the toolchain is unavailable; callers fall back
+to their Python policy.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+from ray_tpu._native import ensure_built
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(ensure_built("scheduler"))
+        lib.sched_new.restype = ctypes.c_void_p
+        lib.sched_free.argtypes = [ctypes.c_void_p]
+        lib.sched_upsert_node.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int,
+        ]
+        lib.sched_remove_node.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.sched_num_nodes.argtypes = [ctypes.c_void_p]
+        lib.sched_num_nodes.restype = ctypes.c_int
+        lib.sched_acquire.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int,
+        ]
+        lib.sched_acquire.restype = ctypes.c_int
+        lib.sched_release.argtypes = lib.sched_acquire.argtypes
+        lib.sched_release.restype = None
+        lib.sched_available.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p
+        ]
+        lib.sched_available.restype = ctypes.c_double
+        lib.sched_pick.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int, ctypes.c_double, ctypes.c_int, ctypes.c_int,
+            ctypes.c_uint64, ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.sched_pick.restype = ctypes.c_int
+        _lib = lib
+    return _lib
+
+
+def _marshal(resources: dict[str, float]):
+    names = (ctypes.c_char_p * len(resources))(
+        *(k.encode() for k in resources)
+    )
+    vals = (ctypes.c_double * len(resources))(*resources.values())
+    return names, vals, len(resources)
+
+
+PICK_INFEASIBLE = 0   # no node's total capacity fits
+PICK_PLACED = 1       # chosen node can run it now
+PICK_QUEUE = 2        # feasible somewhere, busy everywhere: queue at out
+
+
+class NativeScheduler:
+    """Cluster resource view + hybrid top-k placement, in C++."""
+
+    def __init__(self):
+        self._lib = _load()
+        self._h = self._lib.sched_new()
+
+    def __del__(self):
+        try:
+            self._lib.sched_free(self._h)
+        except Exception:
+            pass
+
+    def upsert_node(self, node_id: str, total: dict, available: dict,
+                    alive: bool = True):
+        keys = {**total, **available}
+        names = (ctypes.c_char_p * len(keys))(*(k.encode() for k in keys))
+        tot = (ctypes.c_double * len(keys))(
+            *(float(total.get(k, 0.0)) for k in keys)
+        )
+        av = (ctypes.c_double * len(keys))(
+            *(float(available.get(k, 0.0)) for k in keys)
+        )
+        self._lib.sched_upsert_node(
+            self._h, node_id.encode(), int(alive), names, tot, av, len(keys)
+        )
+
+    def remove_node(self, node_id: str):
+        self._lib.sched_remove_node(self._h, node_id.encode())
+
+    def num_nodes(self) -> int:
+        return self._lib.sched_num_nodes(self._h)
+
+    def acquire(self, node_id: str, demand: dict) -> bool:
+        names, vals, n = _marshal(demand)
+        return bool(
+            self._lib.sched_acquire(self._h, node_id.encode(), names, vals, n)
+        )
+
+    def release(self, node_id: str, demand: dict):
+        names, vals, n = _marshal(demand)
+        self._lib.sched_release(self._h, node_id.encode(), names, vals, n)
+
+    def available(self, node_id: str, resource: str) -> float:
+        return self._lib.sched_available(
+            self._h, node_id.encode(), resource.encode()
+        )
+
+    def pick(
+        self,
+        demand: dict,
+        *,
+        local_node_id: str = "",
+        threshold: float = 0.75,
+        top_k: int = 3,
+        spread: bool = False,
+        seed: int = 0,
+    ) -> tuple[int, str | None]:
+        """Returns (status, node_id|None); see PICK_* constants."""
+        names, vals, n = _marshal(demand)
+        out = ctypes.create_string_buffer(128)
+        status = self._lib.sched_pick(
+            self._h, local_node_id.encode(), names, vals, n,
+            float(threshold), int(top_k), int(spread),
+            ctypes.c_uint64(seed), out, len(out),
+        )
+        node = out.value.decode() or None
+        return status, node

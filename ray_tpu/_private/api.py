@@ -349,10 +349,12 @@ class ActorMethod:
         self._handle = handle
         self._name = name
         self._num_returns = 1
+        self._concurrency_group = None
 
-    def options(self, num_returns=1, **_):
+    def options(self, num_returns=1, concurrency_group=None, **_):
         m = ActorMethod(self._handle, self._name)
         m._num_returns = num_returns
+        m._concurrency_group = concurrency_group
         return m
 
     def remote(self, *args, **kwargs):
@@ -360,6 +362,7 @@ class ActorMethod:
         ids = w.submit_actor_task(
             self._handle._actor_id, self._name, args, kwargs,
             num_returns=self._num_returns,
+            concurrency_group=self._concurrency_group,
         )
         refs = [ObjectRef(i) for i in ids]
         return refs[0] if self._num_returns == 1 else refs
@@ -412,7 +415,8 @@ class ActorHandle:
 
 class ActorClass:
     def __init__(self, cls, *, num_cpus=1.0, num_tpus=0.0, resources=None,
-                 max_restarts=0, max_concurrency=1, runtime_env=None):
+                 max_restarts=0, max_concurrency=1, runtime_env=None,
+                 concurrency_groups=None):
         self._cls = cls
         self._opts = {
             "num_cpus": num_cpus, "num_tpus": num_tpus,
@@ -421,6 +425,7 @@ class ActorClass:
             "namespace": None, "lifetime": None, "get_if_exists": False,
             "placement_group": None, "placement_group_bundle_index": -1,
             "runtime_env": runtime_env,
+            "concurrency_groups": concurrency_groups or {},
         }
 
     def options(self, **kw) -> "ActorClass":
@@ -451,6 +456,17 @@ class ActorClass:
             max_concurrency=o["max_concurrency"],
             get_if_exists=o["get_if_exists"],
             runtime_env=o.get("runtime_env"),
+            concurrency_groups=o.get("concurrency_groups"),
+            # walk the full class (incl. inherited methods) for
+            # @method(concurrency_group=...) annotations
+            method_groups={
+                name: fn.__ray_tpu_method_opts__["concurrency_group"]
+                for name in dir(self._cls)
+                for fn in [getattr(self._cls, name, None)]
+                if getattr(fn, "__ray_tpu_method_opts__", {}).get(
+                    "concurrency_group"
+                )
+            },
         )
         owns = o["name"] is None and o["lifetime"] != "detached" \
             and not reply.get("existing")
@@ -477,6 +493,7 @@ def remote(*args, **kwargs):
                 max_restarts=kwargs.get("max_restarts", 0),
                 max_concurrency=kwargs.get("max_concurrency", 1),
                 runtime_env=kwargs.get("runtime_env"),
+                concurrency_groups=kwargs.get("concurrency_groups"),
             )
         return RemoteFunction(
             target,

@@ -20,6 +20,11 @@ _DEFS: dict[str, Any] = {
     "idle_worker_cull_s": 60.0,          # ray_config_def.h:542 analog
     "task_spill_max_forwards": 2,
     "locality_min_bytes": 1024 * 1024,  # prefer data-local nodes above this
+    # hybrid policy (hybrid_scheduling_policy.h:29 analog): stay local under
+    # this critical-resource utilization; tie-break among top-k by seed
+    "scheduler_hybrid_threshold": 0.75,
+    "scheduler_top_k": 3,
+    "scheduler_use_native": True,        # C++ picker; False = pure Python
     "dep_lost_reconstruct_s": 10.0,
     "spill_high_fraction": 0.8,          # spill primaries above this fill
     "spill_low_fraction": 0.5,           # ...until back under this

@@ -838,7 +838,9 @@ class CoreWorker:
                        max_restarts=0, resources=None, pg_id=None,
                        bundle_index=-1, max_concurrency=1,
                        get_if_exists=False,
-                       runtime_env: dict | None = None) -> dict:
+                       runtime_env: dict | None = None,
+                       concurrency_groups: dict | None = None,
+                       method_groups: dict | None = None) -> dict:
         spec = serialization.pack_payload((cls, args, kwargs))
         reply = self.head.call("register_actor", {
             "actor_id": actor_id, "job_id": self.job_id,
@@ -850,6 +852,8 @@ class CoreWorker:
             "max_concurrency": max_concurrency,
             "get_if_exists": get_if_exists,
             "runtime_env": runtime_env,
+            "concurrency_groups": concurrency_groups or {},
+            "method_groups": method_groups or {},
         })
         return reply
 
@@ -889,7 +893,8 @@ class CoreWorker:
         )
 
     def submit_actor_task(self, actor_id: bytes, method_name: str,
-                          args, kwargs, *, num_returns: int = 1) -> list[bytes]:
+                          args, kwargs, *, num_returns: int = 1,
+                          concurrency_group: str | None = None) -> list[bytes]:
         seq = self._actor_seq.setdefault(actor_id, _Counter()).next()
         task_id = TaskID.for_actor_task(ActorID(actor_id), seq).binary()
         args_spec, deps, inline_values = self._pack_args(args, kwargs)
@@ -903,6 +908,7 @@ class CoreWorker:
             "num_returns": num_returns,
             "owner": self.owner_address,
             "seq": seq,
+            "concurrency_group": concurrency_group,
         }
         return_ids = [
             ObjectID.for_task_return(TaskID(task_id), i).binary()

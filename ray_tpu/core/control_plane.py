@@ -449,6 +449,8 @@ class ControlPlane:
             "pg_id": p.get("pg_id"),
             "bundle_index": p.get("bundle_index", -1),
             "max_concurrency": p.get("max_concurrency", 1),
+            "concurrency_groups": p.get("concurrency_groups") or {},
+            "method_groups": p.get("method_groups") or {},
             "runtime_env": p.get("runtime_env"),
             "death_reason": None,
         }
@@ -506,6 +508,8 @@ class ControlPlane:
                 "spec": actor["spec"],
                 "resources": need,
                 "max_concurrency": actor["max_concurrency"],
+                "concurrency_groups": actor.get("concurrency_groups") or {},
+                "method_groups": actor.get("method_groups") or {},
                 "pg_id": actor.get("pg_id"),
                 "bundle_index": actor.get("bundle_index", -1),
                 "runtime_env": actor.get("runtime_env"),

@@ -157,6 +157,16 @@ class NodeAgent:
         )
         self._spilling = False
         self._bg: list[asyncio.Task] = []
+        # Native (C++) hybrid placement core; None falls back to the pure-
+        # Python policy in _choose_node (e.g. no g++ on the host).
+        self._native_sched = None
+        if cfg.get("scheduler_use_native"):
+            try:
+                from ray_tpu._native.scheduler import NativeScheduler
+
+                self._native_sched = NativeScheduler()
+            except Exception:
+                self._native_sched = None
         self._install_routes()
         self._dead = False
 
@@ -630,6 +640,9 @@ class NodeAgent:
         strategy = spec.get("scheduling_strategy")
         if isinstance(strategy, dict) and strategy.get("node_id"):
             return strategy["node_id"]  # node affinity
+        if self._native_sched is not None:
+            return self._native_choose(spec, need,
+                                       spread=(strategy == "SPREAD"))
         if self._fits(need, self.resources_available):
             return self.node_id
         if not self._fits(need, self.resources_total):
@@ -657,6 +670,56 @@ class NodeAgent:
         if best is not None:
             return best
         return self.node_id  # queue locally
+
+    def _native_choose(self, spec: dict, need: dict,
+                       spread: bool = False) -> bytes | None:
+        """Hybrid top-k placement via the C++ core (_native/scheduler.cc).
+
+        The native view is resynced from the gossiped cluster_view each
+        decision (tens of nodes x a handful of resources — microseconds in
+        C++), so there is exactly one source of truth and no incremental-
+        update drift.
+        """
+        sched = self._native_sched
+        local_hex = self.node_id.hex()
+        sched.upsert_node(local_hex, self.resources_total,
+                          self.resources_available)
+        seen = {local_hex}
+        for nid, view in self.cluster_view.items():
+            if nid == self.node_id:
+                continue
+            hid = nid.hex()
+            seen.add(hid)
+            sched.upsert_node(
+                hid,
+                view.get("resources_total", {}),
+                view.get("resources_available", {}),
+                alive=bool(view.get("alive")),
+            )
+        for hid in (self._native_known or set()) - seen:  # departed nodes
+            sched.remove_node(hid)
+        self._native_known = seen
+        from ray_tpu._native.scheduler import PICK_PLACED, PICK_QUEUE
+
+        status, node = sched.pick(
+            need,
+            local_node_id=local_hex,
+            threshold=cfg.get("scheduler_hybrid_threshold"),
+            top_k=cfg.get("scheduler_top_k"),
+            spread=spread,
+            seed=int.from_bytes(spec.get("task_id", b"\0")[:8], "little"),
+        )
+        if status == PICK_PLACED and node:
+            return bytes.fromhex(node)
+        if status == PICK_QUEUE:
+            # Busy everywhere: queue locally when this node could ever run
+            # it, else queue at the least-utilized feasible node.
+            if self._fits(need, self.resources_total):
+                return self.node_id
+            return bytes.fromhex(node) if node else None
+        return None  # infeasible cluster-wide
+
+    _native_known: set | None = None
 
     async def _forward_task(self, spec: dict, node_id: bytes) -> bool:
         cli = await self._peer_agent(node_id)
@@ -870,6 +933,8 @@ class NodeAgent:
             await w.client.call("create_actor", {
                 "actor_id": p["actor_id"], "spec": p["spec"],
                 "max_concurrency": p.get("max_concurrency", 1),
+                "concurrency_groups": p.get("concurrency_groups") or {},
+                "method_groups": p.get("method_groups") or {},
             }, timeout=120.0)
             await self.head.call("actor_started", {
                 "actor_id": p["actor_id"], "addr": w.addr, "port": w.port,

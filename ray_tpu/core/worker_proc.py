@@ -37,6 +37,14 @@ class Executor(CoreWorker):
         self._actor = None
         self._actor_id: bytes | None = None
         self._owner_hints: dict[bytes, dict] = {}
+        # Async-actor event loop + per-concurrency-group pools (reference
+        # core_worker/transport/concurrency_group_manager.cc + fiber.h):
+        # created lazily in _create_actor from the actor's options.
+        self._actor_loop = None
+        self._async_sem = None
+        self._group_pools: dict[str, object] = {}
+        self._group_sems: dict[str, object] = {}
+        self._method_groups: dict[str, str] = {}
         super().__init__(**kw)
         self._start_exec_threads(1)
 
@@ -88,6 +96,42 @@ class Executor(CoreWorker):
         )
 
     async def rpc_actor_call(self, conn, call):
+        import inspect
+
+        group = call.get("concurrency_group") or self._method_groups.get(
+            call.get("method", "")
+        )
+        if group and group not in self._group_pools:
+            # fail loudly (reference raises on undeclared groups) — silently
+            # serializing on the default queue would drop the isolation the
+            # caller asked for
+            err = serialization.pack_payload(RayTaskError(
+                f"actor method {call.get('method')!r} requested undeclared "
+                f"concurrency group {group!r}; declared: "
+                f"{sorted(self._group_pools)}"
+            ))
+            # _push_results opens a blocking peer connection — never run it
+            # on this RPC event loop
+            import asyncio
+
+            asyncio.get_running_loop().run_in_executor(
+                None, self._push_results, call, call["owner"], None, err
+            )
+            return True
+        method = getattr(self._actor, call.get("method", ""), None)
+        if self._actor_loop is not None and (
+            inspect.iscoroutinefunction(method)
+        ):
+            # async actor method: runs on the actor's event loop, bounded
+            # by its group's semaphore (or max_concurrency for ungrouped
+            # calls); out-of-order completion is the contract, like the
+            # reference's fiber-based async actors
+            self._schedule_async_call(call, group)
+            return True
+        pool = self._group_pools.get(group) if group else None
+        if pool is not None:
+            pool.submit(self._execute_actor_call, call)
+            return True
         self._exec_queue.put(("actor_call", call, None))
         return True
 
@@ -283,9 +327,84 @@ class Executor(CoreWorker):
                 pass
 
     def _create_actor(self, p):
+        import asyncio
+        import concurrent.futures
+        import inspect
+
         cls, args, kwargs = serialization.unpack_payload(p["spec"])
         self._actor_id = p["actor_id"]
+        self._method_groups = dict(p.get("method_groups") or {})
+        self._group_sems: dict[str, asyncio.Semaphore] = {}
+        for name, limit in (p.get("concurrency_groups") or {}).items():
+            self._group_pools[name] = concurrent.futures.ThreadPoolExecutor(
+                max_workers=int(limit), thread_name_prefix=f"cg-{name}"
+            )
+            # async methods in this group share the same bound
+            self._group_sems[name] = asyncio.Semaphore(int(limit))
+        if any(
+            inspect.iscoroutinefunction(fn)
+            for _, fn in inspect.getmembers(cls, inspect.isfunction)
+        ):
+            loop = asyncio.new_event_loop()
+
+            def drive():
+                asyncio.set_event_loop(loop)
+                loop.run_forever()
+
+            threading.Thread(
+                target=drive, name="ray_tpu-actor-loop", daemon=True
+            ).start()
+            self._actor_loop = loop
+            # py3.10+ asyncio primitives bind their loop lazily at first
+            # await, so creating off-loop is safe
+            self._async_sem = asyncio.Semaphore(
+                max(1, int(p.get("max_concurrency", 1)))
+            )
         self._actor = cls(*args, **kwargs)
+
+    def _schedule_async_call(self, call, group: str | None = None):
+        import asyncio
+
+        sem = self._group_sems.get(group) if group else None
+        if sem is None:
+            sem = self._async_sem
+
+        async def run():
+            t_start = time.time()
+            loop = asyncio.get_running_loop()
+            async with sem:
+                try:
+                    method = getattr(self._actor, call["method"])
+                    args, kwargs = await loop.run_in_executor(
+                        None, self._resolve_args, call
+                    )
+                    results = await method(*args, **kwargs)
+                    n = call.get("num_returns", 1)
+                    if n > 1:
+                        results = tuple(results)
+                    await loop.run_in_executor(
+                        None, self._push_results, call, call["owner"], results
+                    )
+                    self._emit_task_event(call, "FINISHED", t_start,
+                                          time.time(),
+                                          name=call.get("method"))
+                except BaseException as e:  # noqa: BLE001
+                    tb = traceback.format_exc()
+                    logger.warning("async actor call %s failed: %s",
+                                   call.get("method"), tb)
+                    err = serialization.pack_payload(
+                        e if _picklable(e) else
+                        RayTaskError(f"{type(e).__name__}: {e}\n{tb}")
+                    )
+                    await loop.run_in_executor(
+                        None, self._push_results, call, call["owner"],
+                        None, err,
+                    )
+                    self._emit_task_event(call, "FAILED", t_start,
+                                          time.time(),
+                                          name=call.get("method"))
+
+        asyncio.run_coroutine_threadsafe(run(), self._actor_loop)
 
     def _execute_actor_call(self, call):
         owner = call["owner"]

@@ -39,22 +39,32 @@ def _run_with_init(pool_id, initializer, initargs, fn, *args, **kwargs):
 
 class AsyncResult:
     def __init__(self, refs, single: bool,
-                 submitter: threading.Thread | None = None):
+                 submitter: threading.Thread | None = None,
+                 submit_error: list | None = None):
         self._refs = refs
         self._single = single
         self._submitter = submitter
+        self._submit_error = submit_error if submit_error is not None else []
 
-    def _join_submitter(self, block: bool = True) -> bool:
-        """True once every task has been submitted (refs list final)."""
+    def _join_submitter(self, timeout: float | None = None) -> bool:
+        """True once every task has been submitted (refs list final).
+
+        Re-raises any error the submission thread hit (serialization
+        failure, cluster gone) so callers never see silently-partial
+        results.
+        """
         if self._submitter is not None:
-            self._submitter.join(None if block else 0)
+            self._submitter.join(timeout)
             if self._submitter.is_alive():
                 return False
             self._submitter = None
+        if self._submit_error:
+            raise self._submit_error[0]
         return True
 
     def get(self, timeout: float | None = None):
-        self._join_submitter()
+        if not self._join_submitter(timeout):
+            raise MpTimeoutError("tasks still being submitted")
         try:
             out = ray_tpu.get(self._refs, timeout=timeout)
         except GetTimeoutError as e:
@@ -62,12 +72,13 @@ class AsyncResult:
         return out[0] if self._single else out
 
     def wait(self, timeout: float | None = None):
-        self._join_submitter()
+        if not self._join_submitter(timeout):
+            return
         ray_tpu.wait(self._refs, num_returns=len(self._refs),
                      timeout=timeout)
 
     def ready(self) -> bool:
-        if not self._join_submitter(block=False):
+        if not self._join_submitter(timeout=0):
             return False
         done, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs),
                                timeout=0)
@@ -111,21 +122,26 @@ class Pool:
         """
         args_all = list(arglists)
         refs: list = []
+        submit_error: list = []
 
         def pump():
             in_flight: list = []
-            for args in args_all:
-                if len(in_flight) >= self._limit:
-                    _, in_flight = ray_tpu.wait(
-                        in_flight, num_returns=1, timeout=None
-                    )
-                ref = task.remote(*args)
-                refs.append(ref)
-                in_flight.append(ref)
+            try:
+                for args in args_all:
+                    if len(in_flight) >= self._limit:
+                        _, in_flight = ray_tpu.wait(
+                            in_flight, num_returns=1, timeout=None
+                        )
+                    ref = task.remote(*args)
+                    refs.append(ref)
+                    in_flight.append(ref)
+            except BaseException as e:  # noqa: BLE001 — re-raised at join
+                submit_error.append(e)
 
         t = threading.Thread(target=pump, daemon=True)
         t.start()
-        return AsyncResult(refs, single=False, submitter=t)
+        return AsyncResult(refs, single=False, submitter=t,
+                           submit_error=submit_error)
 
     # -- sync --
 

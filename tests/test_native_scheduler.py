@@ -1,0 +1,113 @@
+"""Native (C++) cluster-resource scheduler core.
+
+Pins the semantics the node agent delegates to _native/scheduler.cc
+(reference analog: src/ray/raylet/scheduling/cluster_resource_scheduler.h
++ policy/hybrid_scheduling_policy.h): fixed-point accounting (no float
+drift), hybrid local-preference, top-k seeded tie-breaks, spread mode,
+and the placed / queue / infeasible status triage.
+"""
+
+import pytest
+
+from ray_tpu._native.scheduler import (
+    NativeScheduler,
+    PICK_INFEASIBLE,
+    PICK_PLACED,
+    PICK_QUEUE,
+)
+
+
+@pytest.fixture()
+def sched():
+    s = NativeScheduler()
+    s.upsert_node("aa", {"CPU": 4}, {"CPU": 4})
+    s.upsert_node("bb", {"CPU": 8, "TPU": 4}, {"CPU": 8, "TPU": 4})
+    return s
+
+
+def test_local_preference_under_threshold(sched):
+    status, node = sched.pick({"CPU": 1}, local_node_id="aa")
+    assert (status, node) == (PICK_PLACED, "aa")
+
+
+def test_spills_when_local_would_saturate(sched):
+    # 4 CPUs on an idle 4-CPU node = utilization 1.0 > threshold; the
+    # idle 8-CPU peer scores lower and wins.
+    status, node = sched.pick({"CPU": 4}, local_node_id="aa", threshold=0.75)
+    assert (status, node) == (PICK_PLACED, "bb")
+
+
+def test_resource_type_routing(sched):
+    status, node = sched.pick({"TPU": 2}, local_node_id="aa")
+    assert (status, node) == (PICK_PLACED, "bb")
+
+
+def test_infeasible(sched):
+    status, node = sched.pick({"GPU": 1}, local_node_id="aa")
+    assert status == PICK_INFEASIBLE and node is None
+
+
+def test_queue_when_busy_everywhere(sched):
+    assert sched.acquire("bb", {"CPU": 8})
+    status, node = sched.pick({"CPU": 6}, local_node_id="aa")
+    assert status == PICK_QUEUE and node == "bb"
+
+
+def test_dead_nodes_excluded(sched):
+    sched.upsert_node("bb", {"CPU": 8}, {"CPU": 8}, alive=False)
+    status, _ = sched.pick({"CPU": 6}, local_node_id="aa")
+    assert status == PICK_INFEASIBLE
+
+
+def test_acquire_release_roundtrip(sched):
+    assert sched.acquire("aa", {"CPU": 3})
+    assert sched.available("aa", "CPU") == 1.0
+    assert not sched.acquire("aa", {"CPU": 2})
+    sched.release("aa", {"CPU": 3})
+    assert sched.available("aa", "CPU") == 4.0
+
+
+def test_fixed_point_no_drift(sched):
+    for _ in range(10_000):
+        assert sched.acquire("aa", {"CPU": 0.1})
+        sched.release("aa", {"CPU": 0.1})
+    assert sched.available("aa", "CPU") == 4.0
+
+
+def test_release_clamped_to_total(sched):
+    sched.release("aa", {"CPU": 99})
+    assert sched.available("aa", "CPU") == 4.0
+
+
+def test_top_k_seeded_and_bounded():
+    s = NativeScheduler()
+    for i in range(8):
+        s.upsert_node(f"n{i}", {"CPU": 4}, {"CPU": 4})
+    picks = {s.pick({"CPU": 1}, seed=seed, top_k=3)[1] for seed in range(64)}
+    # ids sort lexicographically; equal scores -> only the first k eligible
+    assert picks <= {"n0", "n1", "n2"}
+    assert len(picks) > 1  # the seed actually varies the choice
+    # deterministic for a fixed seed
+    assert all(
+        s.pick({"CPU": 1}, seed=7)[1] == s.pick({"CPU": 1}, seed=7)[1]
+        for _ in range(5)
+    )
+
+
+def test_spread_ignores_local_preference():
+    s = NativeScheduler()
+    s.upsert_node("aa", {"CPU": 4}, {"CPU": 2})  # local, half used
+    s.upsert_node("bb", {"CPU": 4}, {"CPU": 4})  # idle peer
+    status, node = s.pick(
+        {"CPU": 1}, local_node_id="aa", spread=True, top_k=1
+    )
+    assert (status, node) == (PICK_PLACED, "bb")
+
+
+def test_remove_node():
+    s = NativeScheduler()
+    s.upsert_node("aa", {"CPU": 4}, {"CPU": 4})
+    s.upsert_node("bb", {"CPU": 4}, {"CPU": 4})
+    s.remove_node("bb")
+    assert s.num_nodes() == 1
+    assert s.pick({"CPU": 1})[1] == "aa"
