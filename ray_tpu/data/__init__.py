@@ -1,18 +1,46 @@
 """ray_tpu.data — streaming datasets on the task/object runtime.
 
 Reference: python/ray/data (dataset.py:176 Dataset,
-_internal/execution/streaming_executor.py:48). Scaled v0: block-based
-datasets whose transforms run as pipelined remote tasks with bounded
-in-flight blocks; consumed blocks are freed by the distributed GC as their
-refs drop, which is what keeps long streams memory-bounded.
+_internal/execution/streaming_executor.py:48). Block-based datasets whose
+transforms run as pipelined remote tasks with bounded in-flight blocks;
+consumed blocks are freed by the distributed GC as their refs drop, which
+is what keeps long streams memory-bounded. Shuffle ops (sort / groupby /
+random_shuffle) run as a two-phase map/reduce exchange
+(push_based_shuffle.py analog, data/shuffle.py); file IO fans out one
+read task per file (data/datasource.py).
 """
 
 from ray_tpu.data.dataset import (  # noqa: F401
     DataIterator,
     Dataset,
+    GroupedDataset,
     from_items,
     from_numpy,
     range as range_,  # `range` shadows the builtin; both names exported
 )
+from ray_tpu.data.datasource import (  # noqa: F401
+    read_csv,
+    read_json,
+    read_numpy,
+    read_parquet,
+    read_text,
+)
 
 range = range_  # noqa: A001 — mirrors ray.data.range
+
+
+def from_pandas(dfs, parallelism: int = 8) -> Dataset:
+    """One block per DataFrame (or split a single frame)."""
+    import numpy as np
+
+    import ray_tpu
+
+    if not isinstance(dfs, (list, tuple)):
+        n = max(1, min(parallelism, len(dfs)))
+        edges = np.linspace(0, len(dfs), n + 1).astype(int)
+        dfs = [
+            dfs.iloc[lo:hi]
+            for lo, hi in zip(edges[:-1], edges[1:])
+            if hi > lo
+        ]
+    return Dataset([ray_tpu.put(df) for df in dfs])

@@ -1,0 +1,52 @@
+"""Block row-view helpers.
+
+Blocks come in three shapes (reference block.py's Arrow/pandas/simple
+split): list-of-rows, numpy arrays (rows along axis 0), and pandas
+DataFrames (from the file datasources). Row-oriented ops (sort, groupby,
+limit, aggregates) go through these helpers so every block type yields
+*rows* — iterating a DataFrame directly would yield column labels.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import pickle
+from typing import Any
+
+
+def block_rows(block) -> list:
+    """Rows of a block: dicts for DataFrames, items otherwise."""
+    try:
+        import pandas as pd
+
+        if isinstance(block, pd.DataFrame):
+            return block.to_dict("records")
+    except ImportError:  # pragma: no cover
+        pass
+    return list(block)
+
+
+def build_like(proto, rows: list):
+    """Rebuild a block of `proto`'s type from a row list."""
+    import numpy as np
+
+    try:
+        import pandas as pd
+
+        if isinstance(proto, pd.DataFrame):
+            return pd.DataFrame(rows, columns=proto.columns)
+    except ImportError:  # pragma: no cover
+        pass
+    if isinstance(proto, np.ndarray):
+        return np.asarray(rows, dtype=proto.dtype)
+    return rows
+
+
+def stable_hash(key: Any) -> int:
+    """Deterministic across processes (python's hash() is per-process
+    salted for str/bytes, which would scatter one group key over several
+    hash partitions depending on which worker ran the map task)."""
+    payload = pickle.dumps(key, protocol=4)
+    return int.from_bytes(
+        hashlib.blake2b(payload, digest_size=8).digest(), "little"
+    )

@@ -22,6 +22,7 @@ from typing import Any, Callable, Iterable, Iterator
 import numpy as np
 
 import ray_tpu
+from ray_tpu.data.block import block_rows, build_like
 
 DEFAULT_PARALLELISM = 8
 DEFAULT_INFLIGHT = 4
@@ -58,7 +59,8 @@ class Dataset:
 
     def count(self) -> int:
         return sum(
-            len(b) for b in ray_tpu.get(list(self._blocks), timeout=300)
+            len(block_rows(b))
+            for b in ray_tpu.get(list(self._blocks), timeout=300)
         )
 
     def __repr__(self):
@@ -115,12 +117,12 @@ class Dataset:
 
     def iter_rows(self) -> Iterator[Any]:
         for block in self.iter_batches():
-            yield from block
+            yield from block_rows(block)
 
     def take(self, n: int = 20) -> list:
         out = []
         for block in self.iter_batches():
-            for row in block:
+            for row in block_rows(block):
                 out.append(row)
                 if len(out) >= n:
                     return out
@@ -142,21 +144,169 @@ class Dataset:
         ]
 
     def repartition(self, num_blocks: int) -> "Dataset":
-        rows = self.materialize()
+        mats = self.materialize()
         flat: list = []
-        for b in rows:
-            flat.extend(list(b))
+        for b in mats:
+            flat.extend(block_rows(b))
         if not flat:
             return Dataset([])
-        is_np = isinstance(rows[0], np.ndarray)
+        proto = mats[0]
         chunk = max(1, (len(flat) + num_blocks - 1) // num_blocks)
         blocks = []
         for i in builtins.range(0, len(flat), chunk):
-            part = flat[i:i + chunk]
-            blocks.append(
-                ray_tpu.put(np.asarray(part) if is_np else part)
-            )
+            blocks.append(ray_tpu.put(build_like(proto, flat[i:i + chunk])))
         return Dataset(blocks)
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        blocks = list(self._blocks)
+        for o in others:
+            blocks.extend(o._blocks)
+        return Dataset(blocks)
+
+    def limit(self, n: int) -> "Dataset":
+        """First n rows (pulls only the blocks it needs)."""
+        out, got = [], 0
+        for ref in self._blocks:
+            if got >= n:
+                break
+            block = ray_tpu.get(ref, timeout=300)
+            rows = block_rows(block)
+            take = rows[: n - got]
+            got += len(take)
+            out.append(ray_tpu.put(build_like(block, take)))
+        return Dataset(out)
+
+    # -- shuffle family (data/shuffle.py: 2-phase map/reduce exchange) --
+
+    def sort(self, key=None, *, descending: bool = False,
+             num_blocks: int | None = None) -> "Dataset":
+        """Distributed sample-sort (push_based_shuffle.py analog)."""
+        from ray_tpu.data.shuffle import sort_blocks
+
+        return Dataset(
+            sort_blocks(self._blocks, key, descending, num_blocks)
+        )
+
+    def random_shuffle(self, *, seed: int | None = None,
+                       num_blocks: int | None = None) -> "Dataset":
+        from ray_tpu.data.shuffle import shuffle_blocks
+
+        return Dataset(shuffle_blocks(self._blocks, seed, num_blocks))
+
+    def groupby(self, key) -> "GroupedDataset":
+        return GroupedDataset(self, key)
+
+    # -- aggregates --
+
+    def _reduce_rows(self, fn, initial):
+        acc = initial
+        for block in self.iter_batches():
+            for row in block_rows(block):
+                acc = fn(acc, row)
+        return acc
+
+    def sum(self, key=None):
+        from ray_tpu.data.shuffle import _keyfn
+
+        kf = _keyfn(key)
+        return self._reduce_rows(lambda a, r: a + kf(r), 0)
+
+    def min(self, key=None):
+        from ray_tpu.data.shuffle import _keyfn
+
+        kf = _keyfn(key)
+        vals = [kf(r) for b in self.iter_batches() for r in block_rows(b)]
+        return builtins.min(vals)
+
+    def max(self, key=None):
+        from ray_tpu.data.shuffle import _keyfn
+
+        kf = _keyfn(key)
+        vals = [kf(r) for b in self.iter_batches() for r in block_rows(b)]
+        return builtins.max(vals)
+
+    def mean(self, key=None):
+        from ray_tpu.data.shuffle import _keyfn
+
+        kf = _keyfn(key)
+        total, n = 0.0, 0
+        for b in self.iter_batches():
+            for r in block_rows(b):
+                total += kf(r)
+                n += 1
+        return total / n if n else float("nan")
+
+    # -- interchange --
+
+    def to_pandas(self):
+        import pandas as pd
+
+        frames = []
+        for block in self.iter_batches():
+            frames.append(
+                block if isinstance(block, pd.DataFrame)
+                else pd.DataFrame(block)
+            )
+        return pd.concat(frames, ignore_index=True) if frames else \
+            pd.DataFrame()
+
+    def iter_torch_batches(self, *, dtype=None):
+        """Blocks as torch tensors (reference iter_torch_batches)."""
+        import torch
+
+        for block in self.iter_batches():
+            # plasma blocks are zero-copy read-only views; torch needs a
+            # writable buffer, so copy
+            t = torch.tensor(np.asarray(block))
+            yield t.to(dtype) if dtype is not None else t
+
+    # -- sinks (data/datasource.py) --
+
+    def write_parquet(self, dirname: str) -> list:
+        from ray_tpu.data.datasource import write_blocks
+
+        return write_blocks(self._blocks, dirname, "parquet", "parquet")
+
+    def write_csv(self, dirname: str) -> list:
+        from ray_tpu.data.datasource import write_blocks
+
+        return write_blocks(self._blocks, dirname, "csv", "csv")
+
+    def write_json(self, dirname: str) -> list:
+        from ray_tpu.data.datasource import write_blocks
+
+        return write_blocks(self._blocks, dirname, "json", "jsonl")
+
+
+class GroupedDataset:
+    """`ds.groupby(key)` handle (reference grouped_data.py)."""
+
+    def __init__(self, ds: Dataset, key):
+        self._ds = ds
+        self._key = key
+
+    def aggregate(self, agg: Callable[[Any, list], Any],
+                  num_blocks: int | None = None) -> Dataset:
+        """agg(key_value, rows) -> one output row per group."""
+        from ray_tpu.data.shuffle import groupby_blocks
+
+        return Dataset(
+            groupby_blocks(self._ds._blocks, self._key, agg, num_blocks)
+        )
+
+    def count(self) -> Dataset:
+        return self.aggregate(lambda k, rows: (k, len(rows)))
+
+    def sum(self, value_key=None) -> Dataset:
+        from ray_tpu.data.shuffle import _keyfn
+
+        vf = _keyfn(value_key)
+        return self.aggregate(
+            lambda k, rows: (k, builtins.sum(vf(r) for r in rows))
+        )
+
+    def map_groups(self, fn: Callable[[list], Any]) -> Dataset:
+        return self.aggregate(lambda k, rows: fn(rows))
 
 
 class DataIterator:

@@ -1,0 +1,133 @@
+"""File datasources + sinks for ray_tpu.data.
+
+Reference: data/datasource/ (parquet/csv/json/numpy readers with
+partitioned parallel reads) — here each file (or row-group range) is one
+read task, so reads scale with the cluster and blocks land in plasma on
+the worker that read them. Tabular blocks are pandas DataFrames; text is
+lists of str; numpy is arrays.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import TYPE_CHECKING
+
+import ray_tpu
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ray_tpu.data.dataset import Dataset
+
+
+def _expand(paths) -> list[str]:
+    if isinstance(paths, (str, os.PathLike)):
+        paths = [paths]
+    out: list[str] = []
+    for p in paths:
+        p = os.fspath(p)
+        if os.path.isdir(p):
+            out.extend(sorted(
+                f for f in _glob.glob(os.path.join(p, "**"), recursive=True)
+                if os.path.isfile(f)
+            ))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files match {paths!r}")
+    return out
+
+
+@ray_tpu.remote(num_cpus=1)
+def _read_csv_file(path: str, kw: dict):
+    import pandas as pd
+
+    return pd.read_csv(path, **kw)
+
+
+@ray_tpu.remote(num_cpus=1)
+def _read_json_file(path: str, kw: dict):
+    import pandas as pd
+
+    return pd.read_json(path, lines=kw.pop("lines", True), **kw)
+
+
+@ray_tpu.remote(num_cpus=1)
+def _read_parquet_file(path: str, kw: dict):
+    import pandas as pd
+
+    return pd.read_parquet(path, **kw)
+
+
+@ray_tpu.remote(num_cpus=1)
+def _read_text_file(path: str, encoding: str):
+    with open(path, encoding=encoding) as f:
+        return [line.rstrip("\n") for line in f]
+
+
+@ray_tpu.remote(num_cpus=1)
+def _read_numpy_file(path: str):
+    import numpy as np
+
+    return np.load(path, allow_pickle=False)
+
+
+def _mk(refs) -> "Dataset":
+    from ray_tpu.data.dataset import Dataset
+
+    return Dataset(list(refs))
+
+
+def read_csv(paths, **kw) -> "Dataset":
+    return _mk(_read_csv_file.remote(p, kw) for p in _expand(paths))
+
+
+def read_json(paths, **kw) -> "Dataset":
+    """JSONL by default (lines=True); pass lines=False for array files."""
+    return _mk(_read_json_file.remote(p, kw) for p in _expand(paths))
+
+
+def read_parquet(paths, **kw) -> "Dataset":
+    return _mk(_read_parquet_file.remote(p, kw) for p in _expand(paths))
+
+
+def read_text(paths, *, encoding: str = "utf-8") -> "Dataset":
+    return _mk(_read_text_file.remote(p, encoding) for p in _expand(paths))
+
+
+def read_numpy(paths) -> "Dataset":
+    return _mk(_read_numpy_file.remote(p) for p in _expand(paths))
+
+
+# ---------------- sinks ----------------
+
+@ray_tpu.remote(num_cpus=1)
+def _write_block(block, path: str, fmt: str):
+    import numpy as np
+    import pandas as pd
+
+    df = block if isinstance(block, pd.DataFrame) else pd.DataFrame(block)
+    if fmt == "parquet":
+        df.to_parquet(path)
+    elif fmt == "csv":
+        df.to_csv(path, index=False)
+    elif fmt == "json":
+        df.to_json(path, orient="records", lines=True)
+    elif fmt == "numpy":
+        np.save(path, np.asarray(block))
+    else:  # pragma: no cover
+        raise ValueError(fmt)
+    return path
+
+
+def write_blocks(blocks: list, dirname: str, fmt: str, ext: str) -> list[str]:
+    """One file per block under dirname; returns written paths."""
+    os.makedirs(dirname, exist_ok=True)
+    refs = [
+        _write_block.remote(
+            b, os.path.join(dirname, f"block_{i:05d}.{ext}"), fmt
+        )
+        for i, b in enumerate(blocks)
+    ]
+    return ray_tpu.get(refs, timeout=600)

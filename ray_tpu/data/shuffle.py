@@ -1,0 +1,187 @@
+"""Distributed shuffle ops: sample-sort, hash groupby, random shuffle.
+
+Reference: data/_internal/push_based_shuffle.py + planner/exchange/ — the
+two-phase map/reduce exchange. Same topology here, on the task runtime:
+map tasks partition each block (by sampled range boundaries, hash, or
+seeded permutation), reduce tasks combine one partition each. All
+phase-2 inputs are plasma refs, so nothing gathers on the driver.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu._private import serialization
+from ray_tpu.data.block import block_rows, build_like, stable_hash
+
+
+def _keyfn(key):
+    """Normalize a sort/group key: None, attr/column name, or callable."""
+    if key is None:
+        return lambda row: row
+    if callable(key):
+        return key
+    return lambda row, k=key: row[k]
+
+
+@ray_tpu.remote(num_cpus=1)
+def _partition_block(block, mode: str, spec_blob):
+    """Phase 1: split one block into num_parts pieces.
+
+    mode "range": spec = (key_blob, boundaries) — piece i holds rows in
+    (b[i-1], b[i]]. mode "hash": spec = (key_blob, num_parts). mode
+    "random": spec = (seed, num_parts).
+    """
+    spec = serialization.unpack_payload(spec_blob)
+    rows = block_rows(block)
+    if mode == "range":
+        key_blob, bounds = spec
+        key = serialization.unpack_payload(key_blob)
+        kf = _keyfn(key)
+        parts: list[list] = [[] for _ in range(len(bounds) + 1)]
+        import bisect
+
+        for row in rows:
+            parts[bisect.bisect_left(bounds, kf(row))].append(row)
+    elif mode == "hash":
+        key_blob, n = spec
+        key = serialization.unpack_payload(key_blob)
+        kf = _keyfn(key)
+        parts = [[] for _ in range(n)]
+        for row in rows:
+            parts[stable_hash(kf(row)) % n].append(row)
+    elif mode == "random":
+        seed, n = spec
+        rng = np.random.default_rng(seed)
+        parts = [[] for _ in range(n)]
+        for row, dest in zip(rows, rng.integers(0, n, len(rows))):
+            parts[dest].append(row)
+    else:  # pragma: no cover
+        raise ValueError(mode)
+    return tuple(build_like(block, p) for p in parts)
+
+
+@ray_tpu.remote(num_cpus=1)
+def _sample_keys(block, key_blob, per_block: int = 16):
+    """Boundary sampling for the range exchange (driver never sees rows)."""
+    key = serialization.unpack_payload(key_blob)
+    kf = _keyfn(key)
+    rows = block_rows(block)
+    step = max(1, len(rows) // per_block)
+    return [kf(r) for r in rows[::step]]
+
+
+@ray_tpu.remote(num_cpus=1)
+def _reduce_sorted(key_blob, descending, *parts):
+    """Phase 2 (sort): merge one range partition and sort it."""
+    key = serialization.unpack_payload(key_blob)
+    rows: list = []
+    for p in parts:
+        rows.extend(block_rows(p))
+    rows.sort(key=_keyfn(key), reverse=descending)
+    return build_like(parts[0] if parts else rows, rows)
+
+
+@ray_tpu.remote(num_cpus=1)
+def _reduce_concat(seed, *parts):
+    """Phase 2 (random_shuffle): concat one partition, shuffle locally."""
+    rows: list = []
+    for p in parts:
+        rows.extend(block_rows(p))
+    rng = np.random.default_rng(seed)
+    order = rng.permutation(len(rows))
+    out = [rows[i] for i in order]
+    return build_like(parts[0] if parts else out, out)
+
+
+@ray_tpu.remote(num_cpus=1)
+def _reduce_groups(key_blob, agg_blob, *parts):
+    """Phase 2 (groupby): group one hash partition, apply the aggregator."""
+    key = serialization.unpack_payload(key_blob)
+    agg = serialization.unpack_payload(agg_blob)
+    kf = _keyfn(key)
+    groups: dict = {}
+    for p in parts:
+        for row in block_rows(p):
+            groups.setdefault(kf(row), []).append(row)
+    return [agg(k, rows) for k, rows in sorted(groups.items())]
+
+
+def _exchange(blocks: list, mode: str, spec, num_parts: int) -> list[list]:
+    """Run phase 1 over all blocks; returns per-partition ref lists."""
+    if num_parts == 1:
+        # partitioning into one part is the identity: feed every block
+        # straight to the single reducer
+        return [list(blocks)]
+    spec_blob = serialization.pack_payload(spec)
+    part_refs = [
+        _partition_block.options(num_returns=num_parts).remote(
+            b, mode, spec_blob
+        )
+        for b in blocks
+    ]
+    # transpose: partition i gathers piece i of every block
+    return [[refs[i] for refs in part_refs] for i in range(num_parts)]
+
+
+def sort_blocks(blocks: list, key, descending: bool,
+                num_parts: int | None = None) -> list:
+    """Distributed sample-sort; returns sorted block refs."""
+    if not blocks:
+        return []
+    num_parts = num_parts or len(blocks)
+    key_blob = serialization.pack_callable(key) if callable(key) else \
+        serialization.pack_payload(key)
+    # sample ~16 keys per block REMOTELY (capped at 32 blocks) — only the
+    # sampled keys travel to the driver, never whole blocks
+    sample: list = []
+    sample_refs = [
+        _sample_keys.remote(b, key_blob)
+        for b in blocks[:32]
+    ]
+    for keys in ray_tpu.get(sample_refs, timeout=300):
+        sample.extend(keys)
+    sample.sort()
+    if not sample:
+        return list(blocks)
+    bounds = [
+        sample[(i + 1) * len(sample) // num_parts - 1]
+        for i in range(num_parts - 1)
+    ]
+    parts = _exchange(blocks, "range", (key_blob, bounds), num_parts)
+    out = [
+        _reduce_sorted.remote(key_blob, descending, *p) for p in parts
+    ]
+    return out if not descending else list(reversed(out))
+
+
+def shuffle_blocks(blocks: list, seed: int | None,
+                   num_parts: int | None = None) -> list:
+    if not blocks:
+        return []
+    num_parts = num_parts or len(blocks)
+    seed = 0x5EED if seed is None else seed
+    parts = _exchange(blocks, "random", (seed, num_parts), num_parts)
+    return [
+        _reduce_concat.remote(seed + 1 + i, *p)
+        for i, p in enumerate(parts)
+    ]
+
+
+def groupby_blocks(blocks: list, key, agg: Callable[[Any, list], Any],
+                   num_parts: int | None = None) -> list:
+    """Hash-partition by key, then group+aggregate each partition.
+
+    agg(key_value, rows) -> one output row per group.
+    """
+    if not blocks:
+        return []
+    num_parts = num_parts or min(len(blocks), 8)
+    key_blob = serialization.pack_callable(key) if callable(key) else \
+        serialization.pack_payload(key)
+    agg_blob = serialization.pack_callable(agg)
+    parts = _exchange(blocks, "hash", (key_blob, num_parts), num_parts)
+    return [_reduce_groups.remote(key_blob, agg_blob, *p) for p in parts]

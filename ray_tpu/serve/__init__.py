@@ -13,9 +13,16 @@ _BatchQueue, _private/replica.py:296). v0 surface:
     serve.batch(...)                    # dynamic request batching
     serve.shutdown()
 
-No HTTP proxy layer yet — the handle API is the TPU-relevant data path
-(reference serve's own composition path; HTTP rides dashboard infra we
-don't have)."""
+HTTP ingress (http_proxy.py — raw-asyncio analog of the reference's
+uvicorn proxy), long-poll config push (long_poll.py), queue-metric
+autoscaling (autoscaling_config=...), and model multiplexing
+(multiplex.py) ride on top:
+
+    serve.start_http_proxy()            # (host, port); routes by prefix
+    @serve.multiplexed(max_num_models_per_replica=3)
+    def load(mid): ...
+    h.options(multiplexed_model_id="m1").remote(x)
+"""
 
 from ray_tpu.serve.api import (  # noqa: F401
     deployment,
@@ -23,5 +30,10 @@ from ray_tpu.serve.api import (  # noqa: F401
     run,
     shutdown,
     start,
+    start_http_proxy,
 )
 from ray_tpu.serve.batching import batch  # noqa: F401
+from ray_tpu.serve.multiplex import (  # noqa: F401
+    get_multiplexed_model_id,
+    multiplexed,
+)

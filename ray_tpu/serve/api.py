@@ -36,7 +36,12 @@ class _ReplicaActor:
         cls = serialization.unpack_payload(cls_blob)
         self._user = cls(*init_args, **init_kwargs)
 
-    def handle_request(self, method: str, args, kwargs):
+    def handle_request(self, method: str, args, kwargs, model_id: str = ""):
+        from ray_tpu.serve.multiplex import _set_model_id
+
+        # set unconditionally: pooled executor threads would otherwise leak
+        # a previous request's model id into non-multiplexed requests
+        _set_model_id(model_id)
         fn = (self._user if method == "__call__"
               else getattr(self._user, method))
         return fn(*args, **kwargs)
@@ -50,46 +55,94 @@ class _ReplicaActor:
         return True
 
 
-@ray_tpu.remote(num_cpus=0)
+@ray_tpu.remote(num_cpus=0, concurrency_groups={"poll": 32, "metrics": 4})
 class _Controller:
-    """Deployment table + replica reconciliation (controller.py:79)."""
+    """Deployment table + replica reconciliation (controller.py:79) with a
+    long-poll push channel (long_poll.py:186 analog) and queue-metric
+    autoscaling (autoscaling_policy.py:10 analog, driven by handle-side
+    in-flight reports)."""
+
+    AUTOSCALE_PERIOD_S = 1.0
 
     def __init__(self):
+        import threading as th
+
+        from ray_tpu.serve.long_poll import LongPollHost
+
         self.deployments: dict[str, dict] = {}
+        self.routes: dict[str, str] = {}  # route_prefix -> deployment
+        self.long_poll_host = LongPollHost()
+        self._metrics: dict[str, dict] = {}  # name -> {handle_id: (t, n)}
+        self._lock = th.RLock()
+        self._stop = th.Event()
+        th.Thread(target=self._autoscale_loop, daemon=True).start()
+
+    # -- control --
 
     def deploy(self, name: str, cls_blob, init_args, init_kwargs,
                num_replicas: int, max_concurrent_queries: int,
-               version: str, resources: dict):
+               version: str, resources: dict,
+               route_prefix: str | None = None,
+               autoscaling_config: dict | None = None):
         import ray_tpu as rt
+
+        with self._lock:
+            old = self.deployments.get(name)
+            if autoscaling_config:
+                num_replicas = autoscaling_config.get(
+                    "min_replicas", num_replicas
+                )
+            replicas = [
+                self._start_replica(
+                    cls_blob, init_args, init_kwargs, resources,
+                    max_concurrent_queries,
+                )
+                for _ in range(num_replicas)
+            ]
+            # wait for constructors (health check) before flipping traffic
+            rt.get([r.health.remote() for r in replicas], timeout=300)
+            self.deployments[name] = {
+                "replicas": replicas,
+                "version": version,
+                "max_concurrent_queries": max_concurrent_queries,
+                "cls_blob": cls_blob,
+                "init_args": init_args,
+                "init_kwargs": init_kwargs,
+                "resources": resources,
+                "autoscaling": autoscaling_config,
+            }
+            if route_prefix:
+                self.routes[route_prefix] = name
+                self.long_poll_host.set("routes", dict(self.routes))
+            self._publish(name)
+            if old is not None:
+                for r in old["replicas"]:  # rolling-replace: drain = kill
+                    try:
+                        rt.kill(r)
+                    except Exception:  # noqa: BLE001
+                        pass
+        return num_replicas
+
+    def _start_replica(self, cls_blob, init_args, init_kwargs, resources,
+                       max_concurrent_queries):
         from ray_tpu.serve.api import _ReplicaActor
 
-        old = self.deployments.get(name)
-        replicas = []
-        opts = {
-            "num_cpus": resources.get("CPU", 0),
-            "num_tpus": resources.get("TPU", 0),
-            "max_concurrency": max_concurrent_queries,
-        }
-        for i in range(num_replicas):
-            replicas.append(
-                _ReplicaActor.options(**opts).remote(
-                    cls_blob, init_args, init_kwargs
-                )
-            )
-        # wait for constructors (health check) before flipping traffic
-        rt.get([r.health.remote() for r in replicas], timeout=300)
-        self.deployments[name] = {
-            "replicas": replicas,
-            "version": version,
-            "max_concurrent_queries": max_concurrent_queries,
-        }
-        if old is not None:
-            for r in old["replicas"]:  # rolling-replace: drain = kill (v0)
-                try:
-                    rt.kill(r)
-                except Exception:  # noqa: BLE001
-                    pass
-        return len(replicas)
+        return _ReplicaActor.options(
+            num_cpus=resources.get("CPU", 0),
+            num_tpus=resources.get("TPU", 0),
+            max_concurrency=max_concurrent_queries,
+        ).remote(cls_blob, init_args, init_kwargs)
+
+    def _publish(self, name: str):
+        d = self.deployments.get(name)
+        if d is None:
+            self.long_poll_host.drop(f"replicas:{name}")
+            return
+        self.long_poll_host.set(f"replicas:{name}", {
+            "actor_ids": [r._actor_id for r in d["replicas"]],
+            "max_concurrent_queries": d["max_concurrent_queries"],
+            "version": d["version"],
+        })
 
     def get_replicas(self, name: str):
         d = self.deployments.get(name)
@@ -101,6 +154,9 @@ class _Controller:
             "version": d["version"],
         }
 
+    def get_routes(self):
+        return dict(self.routes)
+
     def list_deployments(self):
         return {
             name: {"num_replicas": len(d["replicas"]),
@@ -111,14 +167,84 @@ class _Controller:
     def delete(self, name: str):
         import ray_tpu as rt
 
-        d = self.deployments.pop(name, None)
-        if d:
-            for r in d["replicas"]:
-                try:
-                    rt.kill(r)
-                except Exception:  # noqa: BLE001
-                    pass
-        return d is not None
+        with self._lock:
+            d = self.deployments.pop(name, None)
+            for prefix, dep in list(self.routes.items()):
+                if dep == name:
+                    del self.routes[prefix]
+            self.long_poll_host.set("routes", dict(self.routes))
+            self._publish(name)
+            if d:
+                for r in d["replicas"]:
+                    try:
+                        rt.kill(r)
+                    except Exception:  # noqa: BLE001
+                        pass
+            return d is not None
+
+    # -- long poll (dedicated group so blocked polls never starve control)
+
+    @ray_tpu.method(concurrency_group="poll")
+    def long_poll(self, snapshot: dict, timeout: float = 10.0):
+        return self.long_poll_host.poll(snapshot, timeout)
+
+    # -- autoscaling --
+
+    @ray_tpu.method(concurrency_group="metrics")
+    def report_metrics(self, name: str, handle_id: str, in_flight: int):
+        import time as t
+
+        self._metrics.setdefault(name, {})[handle_id] = (t.time(), in_flight)
+
+    def _autoscale_loop(self):
+        while not self._stop.wait(self.AUTOSCALE_PERIOD_S):
+            try:
+                self._autoscale_once()
+            except Exception:  # noqa: BLE001
+                logger.exception("autoscale tick failed")
+
+    def _autoscale_once(self):
+        import math
+        import time as t
+
+        import ray_tpu as rt
+
+        with self._lock:
+            for name, d in list(self.deployments.items()):
+                cfg = d.get("autoscaling")
+                if not cfg:
+                    continue
+                now = t.time()
+                reports = self._metrics.get(name, {})
+                total = sum(
+                    n for (ts, n) in reports.values() if now - ts < 5.0
+                )
+                target = cfg.get("target_num_ongoing_requests_per_replica",
+                                 2)
+                desired = math.ceil(total / max(target, 1e-9))
+                desired = max(cfg.get("min_replicas", 1),
+                              min(cfg.get("max_replicas", 8), desired))
+                cur = len(d["replicas"])
+                if desired > cur:
+                    new = [
+                        self._start_replica(
+                            d["cls_blob"], d["init_args"], d["init_kwargs"],
+                            d["resources"], d["max_concurrent_queries"],
+                        )
+                        for _ in range(desired - cur)
+                    ]
+                    rt.get([r.health.remote() for r in new], timeout=300)
+                    d["replicas"].extend(new)
+                    self._publish(name)
+                elif desired < cur:
+                    victims = d["replicas"][desired:]
+                    d["replicas"] = d["replicas"][:desired]
+                    self._publish(name)
+                    for r in victims:
+                        try:
+                            rt.kill(r)
+                        except Exception:  # noqa: BLE001
+                            pass
 
 
 # ---------------- driver-side API ----------------
@@ -138,7 +264,37 @@ def _controller():
     return ray_tpu.get_actor(CONTROLLER_NAME)
 
 
+PROXY_NAME = "__serve_http_proxy__"
+
+
+def start_http_proxy(host: str = "127.0.0.1",
+                     port: int = 0) -> tuple[str, int]:
+    """Start (or connect to) the HTTP ingress; returns (host, port).
+
+    reference http_proxy.py:481 HTTPProxyActor — one ingress actor; routes
+    come from @serve.deployment(route_prefix=...) via controller long-poll.
+    """
+    from ray_tpu.serve.http_proxy import HTTPProxyActor
+
+    start()
+    try:
+        proxy = ray_tpu.get_actor(PROXY_NAME)
+    except ValueError:
+        proxy = HTTPProxyActor.options(
+            name=PROXY_NAME, lifetime="detached"
+        ).remote(host, port)
+    return tuple(ray_tpu.get(proxy.address.remote(), timeout=120))
+
+
 def shutdown():
+    for h in _handle_cache.values():
+        h.close()
+    _handle_cache.clear()
+    try:
+        proxy = ray_tpu.get_actor(PROXY_NAME)
+        ray_tpu.kill(proxy)
+    except ValueError:
+        pass
     try:
         c = _controller()
     except ValueError:
@@ -152,12 +308,15 @@ class Deployment:
     """Result of @serve.deployment on a class."""
 
     def __init__(self, cls, *, num_replicas=1, max_concurrent_queries=8,
-                 resources=None, name=None):
+                 resources=None, name=None, route_prefix=None,
+                 autoscaling_config=None):
         self._cls = cls
         self.num_replicas = num_replicas
         self.max_concurrent_queries = max_concurrent_queries
         self.resources = resources or {"CPU": 0}
         self.name = name or cls.__name__
+        self.route_prefix = route_prefix
+        self.autoscaling_config = autoscaling_config
 
     def options(self, **kw) -> "Deployment":
         merged = {
@@ -165,6 +324,8 @@ class Deployment:
             "max_concurrent_queries": self.max_concurrent_queries,
             "resources": self.resources,
             "name": self.name,
+            "route_prefix": self.route_prefix,
+            "autoscaling_config": self.autoscaling_config,
         }
         merged.update(kw)
         return Deployment(self._cls, **merged)
@@ -195,14 +356,25 @@ def run(dep: Deployment, *, name: str | None = None, init_args=(),
             name, cls_blob, list(init_args), init_kwargs or {},
             dep.num_replicas, dep.max_concurrent_queries, version,
             dep.resources,
+            dep.route_prefix or f"/{name}",
+            dep.autoscaling_config,
         ),
         timeout=600,
     )
     return get_handle(name)
 
 
+_handle_cache: dict[str, "DeploymentHandle"] = {}
+
+
 def get_handle(name: str) -> "DeploymentHandle":
-    return DeploymentHandle(name)
+    """Handles are cached per deployment: each one owns a long-poll
+    thread, so per-request construction would leak threads and saturate
+    the controller's poll group."""
+    h = _handle_cache.get(name)
+    if h is None or h._closed:
+        h = _handle_cache[name] = DeploymentHandle(name)
+    return h
 
 
 class DeploymentHandle:
@@ -213,13 +385,22 @@ class DeploymentHandle:
     """
 
     def __init__(self, name: str):
+        import os
+
         self.name = name
+        self._handle_id = os.urandom(6).hex()
         self._replicas: list = []
         self._max_q = 8
         self._inflight: dict[int, int] = {}
         self._lock = threading.Lock()
         self._version = None
+        self._poll_version = 0
+        self._closed = False
         self._refresh()
+        # LongPollClient analog (long_poll.py:68): learn about redeploys/
+        # autoscaling pushes; doubles as the queue-metrics reporter that
+        # feeds the controller's autoscaler.
+        threading.Thread(target=self._poll_loop, daemon=True).start()
 
     def _refresh(self):
         info = ray_tpu.get(
@@ -227,26 +408,78 @@ class DeploymentHandle:
         )
         if info is None:
             raise ValueError(f"no deployment named '{self.name}'")
-        self._replicas = [
-            ray_tpu.ActorHandle(aid) for aid in info["actor_ids"]
-        ]
-        self._max_q = info["max_concurrent_queries"]
-        self._version = info["version"]
-        self._inflight = {i: 0 for i in range(len(self._replicas))}
+        self._apply(info)
+
+    def _apply(self, info: dict):
+        with self._lock:
+            old_ids = [r._actor_id for r in self._replicas]
+            old_counts = dict(self._inflight)
+            self._replicas = [
+                ray_tpu.ActorHandle(aid) for aid in info["actor_ids"]
+            ]
+            self._max_q = info["max_concurrent_queries"]
+            self._version = info["version"]
+            # carry in-flight counts across by replica identity — a scale
+            # event must not zero the accounting for surviving replicas
+            by_id = {aid: old_counts.get(i, 0)
+                     for i, aid in enumerate(old_ids)}
+            self._inflight = {
+                i: by_id.get(aid, 0)
+                for i, aid in enumerate(info["actor_ids"])
+            }
+
+    def _poll_loop(self):
+        key = f"replicas:{self.name}"
+        while not self._closed:
+            try:
+                c = _controller()
+                with self._lock:
+                    total = sum(self._inflight.values())
+                c.report_metrics.remote(
+                    self.name, self._handle_id, total
+                )
+                changed = ray_tpu.get(
+                    c.long_poll.remote(
+                        {key: self._poll_version}, 2.0
+                    ),
+                    timeout=30,
+                )
+                if key in changed:
+                    version, info = changed[key]
+                    self._poll_version = version
+                    if info is not None:
+                        self._apply(info)
+            except Exception:  # noqa: BLE001 — controller down/rolling
+                time.sleep(1.0)
+
+    def close(self):
+        self._closed = True
 
     def method(self, method_name: str) -> "_HandleMethod":
         return _HandleMethod(self, method_name)
 
+    def options(self, *, multiplexed_model_id: str = "",
+                method_name: str = "__call__") -> "_HandleMethod":
+        return _HandleMethod(self, method_name,
+                             model_id=multiplexed_model_id)
+
     def remote(self, *args, **kwargs):
         return self.method("__call__").remote(*args, **kwargs)
 
-    def _assign(self) -> int:
+    def _assign(self, model_id: str = "") -> int:
         """Pick a replica (two random choices, fewer in-flight wins);
-        blocks while every replica is at max_concurrent_queries."""
+        blocks while every replica is at max_concurrent_queries. A
+        multiplexed model id hashes to a preferred replica so its LRU
+        cache stays warm (reference multiplex routing hint)."""
         deadline = time.monotonic() + 60.0
         while True:
             with self._lock:
                 n = len(self._replicas)
+                if model_id:
+                    pref = hash(model_id) % n
+                    if self._inflight[pref] < self._max_q:
+                        self._inflight[pref] += 1
+                        return pref
                 idxs = random.sample(range(n), min(2, n))
                 idx = min(idxs, key=lambda i: self._inflight[i])
                 if self._inflight[idx] < self._max_q:
@@ -261,22 +494,28 @@ class DeploymentHandle:
 
     def _done(self, idx: int):
         with self._lock:
-            self._inflight[idx] -= 1
+            # the index may be gone after a scale-down/redeploy push; the
+            # departed replica's count went with it
+            if idx in self._inflight:
+                self._inflight[idx] -= 1
 
 
 class _HandleMethod:
-    def __init__(self, handle: DeploymentHandle, method: str):
+    def __init__(self, handle: DeploymentHandle, method: str,
+                 model_id: str = ""):
         self._h = handle
         self._method = method
+        self._model_id = model_id
 
     def remote(self, *args, **kwargs):
         h = self._h
         for attempt in (0, 1):
-            idx = h._assign()
+            idx = h._assign(self._model_id)
             try:
                 replica = h._replicas[idx]
                 ref = replica.handle_request.remote(self._method,
-                                                    list(args), kwargs)
+                                                    list(args), kwargs,
+                                                    self._model_id)
             except Exception:
                 h._done(idx)
                 if attempt == 0:

@@ -1,0 +1,186 @@
+"""HTTP ingress for serve deployments.
+
+Reference: serve/_private/http_proxy.py:255 HTTPProxy (+ :173
+LongestPrefixRouter) — an actor per ingress node running an HTTP server
+that resolves the route prefix to a deployment and forwards the request
+through a DeploymentHandle. The reference embeds uvicorn/ASGI; this image
+has no uvicorn, so the server is a raw asyncio HTTP/1.1 implementation —
+~line-for-capability: longest-prefix routing, JSON bodies, query params,
+404/500 mapping, route table refreshed by long-poll from the controller.
+
+GET /prefix?a=1 -> handle.remote({query params})
+POST /prefix    -> handle.remote(json_body)
+Response: JSON-encoded return value, 200; unknown route 404; user
+exception 500 with the error string.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from urllib.parse import parse_qs, urlsplit
+
+import ray_tpu
+
+logger = logging.getLogger(__name__)
+
+
+def _match_route(routes: dict[str, str], path: str) -> str | None:
+    """Longest matching prefix (LongestPrefixRouter:173)."""
+    best = None
+    for prefix in routes:
+        clean = prefix.rstrip("/") or "/"
+        if path == clean or path.startswith(clean + "/") or clean == "/":
+            if best is None or len(clean) > len(best):
+                best = prefix
+    return best
+
+
+class _ProxyServer:
+    """The in-process server; lives inside the proxy actor's worker."""
+
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self.routes: dict[str, str] = {}
+        self._handles: dict[str, object] = {}
+        self._ready = threading.Event()
+        self._loop = None
+        threading.Thread(target=self._drive, daemon=True).start()
+
+    def _drive(self):
+        import asyncio
+
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+
+        async def boot():
+            server = await asyncio.start_server(
+                self._serve_conn, self.host, self.port
+            )
+            self.port = server.sockets[0].getsockname()[1]
+            self._ready.set()
+
+        self._loop.run_until_complete(boot())
+        self._loop.run_forever()
+
+    def wait_ready(self, timeout: float = 30.0) -> int:
+        if not self._ready.wait(timeout):
+            raise TimeoutError("http proxy failed to bind")
+        return self.port
+
+    def _handle_for(self, name: str):
+        from ray_tpu.serve.api import get_handle
+
+        h = self._handles.get(name)
+        if h is None:
+            h = self._handles[name] = get_handle(name)
+        return h
+
+    async def _serve_conn(self, reader, writer):
+        import asyncio
+
+        try:
+            while True:
+                line = await reader.readline()
+                if not line or line in (b"\r\n", b"\n"):
+                    break
+                method, target, _ = line.decode().split(" ", 2)
+                headers = {}
+                while True:
+                    h = await reader.readline()
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = h.decode().partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                body = b""
+                n = int(headers.get("content-length", 0))
+                if n:
+                    body = await reader.readexactly(n)
+                status, payload = await asyncio.get_running_loop() \
+                    .run_in_executor(None, self._dispatch, method,
+                                     target, body)
+                data = json.dumps(payload).encode()
+                writer.write(
+                    f"HTTP/1.1 {status}\r\n"
+                    f"Content-Type: application/json\r\n"
+                    f"Content-Length: {len(data)}\r\n"
+                    "Connection: keep-alive\r\n\r\n".encode() + data
+                )
+                await writer.drain()
+                if headers.get("connection", "").lower() == "close":
+                    break
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _dispatch(self, method: str, target: str, body: bytes):
+        """Blocking route->handle call; runs on the executor pool."""
+        parts = urlsplit(target)
+        route = _match_route(self.routes, parts.path)
+        if route is None:
+            return "404 Not Found", {"error": f"no route for {parts.path}"}
+        name = self.routes[route]
+        if body:
+            try:
+                arg = json.loads(body)
+            except json.JSONDecodeError:
+                arg = body.decode(errors="replace")
+        else:
+            arg = {
+                k: v[0] if len(v) == 1 else v
+                for k, v in parse_qs(parts.query).items()
+            }
+        try:
+            handle = self._handle_for(name)
+            result = ray_tpu.get(handle.remote(arg), timeout=120)
+            return "200 OK", result
+        except Exception as e:  # noqa: BLE001 — user errors -> 500
+            logger.warning("proxy request to %s failed: %s", name, e)
+            return "500 Internal Server Error", {"error": str(e)}
+
+
+@ray_tpu.remote(num_cpus=0)
+class HTTPProxyActor:
+    """reference http_proxy.py:481 HTTPProxyActor."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._server = _ProxyServer(host, port)
+        self._server.wait_ready()
+        self._stop = threading.Event()
+        threading.Thread(target=self._route_loop, daemon=True).start()
+
+    def _route_loop(self):
+        """Track the controller's route table via long-poll."""
+        from ray_tpu.serve.api import _controller
+
+        version = 0
+        while not self._stop.wait(0.0):
+            try:
+                c = _controller()
+                if version == 0:
+                    self._server.routes = ray_tpu.get(
+                        c.get_routes.remote(), timeout=30
+                    )
+                changed = ray_tpu.get(
+                    c.long_poll.remote({"routes": version}, 5.0),
+                    timeout=30,
+                )
+                if "routes" in changed:
+                    version, routes = changed["routes"]
+                    self._server.routes = routes or {}
+            except Exception:  # noqa: BLE001
+                import time
+
+                time.sleep(1.0)
+
+    def address(self) -> tuple[str, int]:
+        return self._server.host, self._server.port
+
+    def ready(self) -> bool:
+        return True

@@ -1,0 +1,61 @@
+"""Versioned-key long-poll push channel (controller -> clients).
+
+Reference: serve/_private/long_poll.py:68 LongPollClient / :186
+LongPollHost — clients send {key: last_seen_version} and block until any
+key advances, then get the new (version, value) snapshots. Handles and
+HTTP proxies use it to learn about redeploys/scaling without polling
+per-request.
+
+The host is a plain thread-safe object embedded in the serve controller;
+`poll` calls run on a dedicated actor concurrency group so blocked polls
+never starve deploy/control calls (the same isolation the reference gets
+from asyncio).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+
+class LongPollHost:
+    def __init__(self):
+        self._versions: dict[str, int] = {}
+        self._values: dict[str, Any] = {}
+        self._cond = threading.Condition()
+
+    def set(self, key: str, value: Any):
+        with self._cond:
+            self._versions[key] = self._versions.get(key, 0) + 1
+            self._values[key] = value
+            self._cond.notify_all()
+
+    def drop(self, key: str):
+        with self._cond:
+            self._versions[key] = self._versions.get(key, 0) + 1
+            self._values.pop(key, None)
+            self._cond.notify_all()
+
+    def get(self, key: str):
+        with self._cond:
+            return self._versions.get(key, 0), self._values.get(key)
+
+    def poll(self, snapshot: dict[str, int], timeout: float = 30.0) -> dict:
+        """Block until some key in `snapshot` differs from the given
+        version (or timeout); returns {key: (version, value)} for every
+        changed key. Unknown keys are treated as version 0."""
+        deadline = threading.TIMEOUT_MAX if timeout is None else timeout
+
+        def changed():
+            return {
+                k: (self._versions.get(k, 0), self._values.get(k))
+                for k, v in snapshot.items()
+                if self._versions.get(k, 0) != v
+            }
+
+        with self._cond:
+            out = changed()
+            if out:
+                return out
+            self._cond.wait(deadline)
+            return changed()

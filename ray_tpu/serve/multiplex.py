@@ -1,0 +1,62 @@
+"""Model multiplexing: many models per replica with LRU residency.
+
+Reference: serve/multiplex.py — `@serve.multiplexed` wraps a model-load
+function with a per-replica LRU cache, and requests carry a model id the
+replica reads via `serve.get_multiplexed_model_id()` (context-local, set
+by the replica before invoking user code).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+from collections import OrderedDict
+from typing import Callable
+
+_model_id: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "serve_multiplexed_model_id", default=""
+)
+
+
+def get_multiplexed_model_id() -> str:
+    """Inside a replica: the model id the current request was routed
+    with (handle.options(multiplexed_model_id=...))."""
+    return _model_id.get()
+
+
+def _set_model_id(mid: str):
+    _model_id.set(mid)
+
+
+def multiplexed(max_num_models_per_replica: int = 3):
+    """Decorator for a model-load callable/method.
+
+    The wrapped function becomes an LRU-cached loader keyed by model id:
+    at most `max_num_models_per_replica` models stay resident; loading an
+    (N+1)-th evicts the least recently used.
+    """
+
+    def deco(load_fn: Callable):
+        cache: OrderedDict = OrderedDict()
+        lock = threading.Lock()
+
+        def wrapper(*args):
+            mid = args[-1] if args and isinstance(args[-1], str) else \
+                get_multiplexed_model_id()
+            with lock:
+                if mid in cache:
+                    cache.move_to_end(mid)
+                    return cache[mid]
+            model = load_fn(*args)
+            with lock:
+                cache[mid] = model
+                cache.move_to_end(mid)
+                while len(cache) > max_num_models_per_replica:
+                    cache.popitem(last=False)
+            return model
+
+        wrapper.__wrapped__ = load_fn
+        wrapper._cache = cache  # introspectable for tests
+        return wrapper
+
+    return deco

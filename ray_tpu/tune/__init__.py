@@ -18,3 +18,16 @@ from ray_tpu.tune.tuner import (  # noqa: F401
     report,
     uniform,
 )
+from ray_tpu.tune.schedulers import (  # noqa: F401
+    HyperBandScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+)
+
+
+def get_checkpoint():
+    """Inside a trainable: the checkpoint to resume from (set when PBT
+    exploits a donor trial, or on restore)."""
+    from ray_tpu.train import session as S
+
+    return S.get_checkpoint()

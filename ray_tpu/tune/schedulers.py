@@ -1,0 +1,161 @@
+"""Trial schedulers: median stopping, HyperBand brackets, PBT.
+
+Reference: tune/schedulers/ — async_hyperband.py (ASHA, in tuner.py),
+median_stopping_rule.py, hyperband.py, pbt.py. Decisions are returned
+from `on_result(trial_id, iteration, value)`:
+
+  "continue"              keep training
+  "stop"                  kill the trial (underperformer / budget done)
+  ("exploit", donor_id)   PBT only — clone the donor's config+checkpoint,
+                          mutate, and restart this trial from it
+
+The Tuner drives these synchronously at report boundaries (the reference
+does the same from TuneController.step).
+"""
+
+from __future__ import annotations
+
+import math
+import random as _random
+from typing import Any, Callable
+
+
+class MedianStoppingRule:
+    """Stop a trial whose running average is worse than the median of the
+    running averages of all trials at the same point (reference
+    schedulers/median_stopping_rule.py)."""
+
+    def __init__(self, *, metric: str | None = None, mode: str = "min",
+                 grace_period: int = 3, min_samples_required: int = 3):
+        self.metric = metric
+        self.mode = mode
+        self.grace = grace_period
+        self.min_samples = min_samples_required
+        self._sums: dict[str, float] = {}
+        self._counts: dict[str, int] = {}
+
+    def _avg(self, tid: str) -> float:
+        return self._sums[tid] / self._counts[tid]
+
+    def on_result(self, trial_id: str, iteration: int, value: float):
+        score = value if self.mode == "min" else -value
+        self._sums[trial_id] = self._sums.get(trial_id, 0.0) + score
+        self._counts[trial_id] = self._counts.get(trial_id, 0) + 1
+        if iteration < self.grace:
+            return "continue"
+        others = [self._avg(t) for t in self._sums if t != trial_id]
+        if len(others) < self.min_samples:
+            return "continue"
+        others.sort()
+        median = others[len(others) // 2]
+        return "stop" if self._avg(trial_id) > median else "continue"
+
+
+class HyperBandScheduler:
+    """Bracketed successive halving (reference schedulers/hyperband.py).
+
+    Trials round-robin across brackets; bracket b gives its trials a
+    longer grace period (grace * eta^b) in exchange for a harsher cut at
+    each rung — the classic explore/exploit tradeoff over budgets. Each
+    bracket's rung logic is ASHA (tuner.py)."""
+
+    def __init__(self, *, metric: str | None = None, mode: str = "min",
+                 max_t: int = 81, reduction_factor: int = 3,
+                 num_brackets: int = 3):
+        from ray_tpu.tune.tuner import ASHAScheduler
+
+        self.metric = metric
+        self.mode = mode
+        self._brackets = [
+            ASHAScheduler(
+                metric=metric, mode=mode, max_t=max_t,
+                grace_period=max(1, reduction_factor ** b),
+                reduction_factor=reduction_factor,
+            )
+            for b in range(num_brackets)
+        ]
+        self._assignment: dict[str, int] = {}
+        self._next = 0
+
+    def __setattr__(self, k, v):
+        # keep bracket metric/mode in sync when the Tuner fills them in
+        super().__setattr__(k, v)
+        if k in ("metric", "mode") and getattr(self, "_brackets", None):
+            for b in self._brackets:
+                setattr(b, k, v)
+
+    def on_result(self, trial_id: str, iteration: int, value: float):
+        b = self._assignment.get(trial_id)
+        if b is None:
+            b = self._assignment[trial_id] = self._next
+            self._next = (self._next + 1) % len(self._brackets)
+        return self._brackets[b].on_result(trial_id, iteration, value)
+
+
+class PopulationBasedTraining:
+    """PBT (reference schedulers/pbt.py): at each perturbation interval,
+    bottom-quantile trials clone a top-quantile trial's config+checkpoint
+    and mutate (explore); the Tuner performs the actual clone/restart."""
+
+    def __init__(self, *, metric: str | None = None, mode: str = "min",
+                 perturbation_interval: int = 4,
+                 hyperparam_mutations: dict[str, Any] | None = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: int | None = None):
+        self.metric = metric
+        self.mode = mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_p = resample_probability
+        self._rng = _random.Random(seed)
+        self._latest: dict[str, float] = {}  # trial -> latest score (min-is-better)
+        self.num_perturbations = 0
+
+    def on_result(self, trial_id: str, iteration: int, value: float):
+        score = value if self.mode == "min" else -value
+        self._latest[trial_id] = score
+        if self.interval <= 0 or iteration % self.interval:
+            return "continue"
+        ranked = sorted(self._latest, key=self._latest.get)
+        n = len(ranked)
+        k = max(1, int(n * self.quantile))
+        if n < 2 * k:
+            return "continue"  # population too small to cut yet
+        if trial_id in ranked[-k:]:  # bottom quantile
+            donor = self._rng.choice(ranked[:k])
+            if donor != trial_id:
+                self.num_perturbations += 1
+                return ("exploit", donor)
+        return "continue"
+
+    def explore(self, config: dict) -> dict:
+        """Mutate a cloned config (reference pbt.py explore): numeric
+        hyperparams jitter x0.8 / x1.2 (or resample), samplers/lists
+        resample."""
+        from ray_tpu.tune.tuner import _Sampler
+
+        out = dict(config)
+        for key, spec in self.mutations.items():
+            cur = out.get(key)
+            resample = self._rng.random() < self.resample_p or not \
+                isinstance(cur, (int, float))
+            if isinstance(spec, _Sampler):
+                if resample:
+                    out[key] = spec.sample(self._rng)
+                else:
+                    out[key] = cur * self._rng.choice((0.8, 1.2))
+            elif isinstance(spec, (list, tuple)):
+                if resample or cur not in spec:
+                    out[key] = self._rng.choice(list(spec))
+                else:
+                    i = list(spec).index(cur)
+                    j = min(len(spec) - 1, max(0, i + self._rng.choice(
+                        (-1, 1))))
+                    out[key] = list(spec)[j]
+            elif callable(spec):
+                out[key] = spec()
+            elif isinstance(cur, (int, float)):
+                out[key] = cur * self._rng.choice((0.8, 1.2))
+        return out

@@ -109,14 +109,15 @@ def report(metrics: dict, checkpoint=None):
 class _TrialActor:
     """FunctionTrainable host (function_trainable.py:284)."""
 
-    def start(self, fn_blob, config: dict):
+    def start(self, fn_blob, config: dict, resume_checkpoint=None):
         import threading
 
         from ray_tpu._private import serialization
         from ray_tpu.train import session as S
 
         fn = serialization.unpack_payload(fn_blob)
-        self._sess = S._init_session(world_rank=0, world_size=1)
+        self._sess = S._init_session(world_rank=0, world_size=1,
+                                     resume_checkpoint=resume_checkpoint)
         sess = self._sess
 
         def _run():
@@ -279,11 +280,15 @@ class Tuner:
         running: dict[int, dict] = {}  # idx -> {actor, iter, last, ckpt}
         results: dict[int, Result] = {}
 
-        def _launch(idx, config):
+        def _launch(idx, config, resume_checkpoint=None, iteration=0):
             actor = _TrialActor.remote()
-            ray_tpu.get(actor.start.remote(fn_blob, config), timeout=120)
+            ray_tpu.get(
+                actor.start.remote(fn_blob, config, resume_checkpoint),
+                timeout=120,
+            )
             running[idx] = {"actor": actor, "config": config,
-                            "iteration": 0, "last": None, "ckpt": None}
+                            "iteration": iteration, "last": None,
+                            "ckpt": resume_checkpoint}
 
         def _finish(idx, error=None):
             st = running.pop(idx)
@@ -342,3 +347,24 @@ class Tuner:
                         )
                         if decision == "stop":
                             _finish(idx)
+                        elif (isinstance(decision, tuple)
+                              and decision[0] == "exploit"):
+                            # PBT: clone the donor's config+checkpoint,
+                            # mutate, restart this trial from it
+                            donor_idx = int(decision[1].rsplit("_", 1)[1])
+                            donor = running.get(donor_idx)
+                            if donor is None and donor_idx in results:
+                                d = results[donor_idx]
+                                donor = {"config": d.config,
+                                         "ckpt": d.checkpoint}
+                            if donor is not None:
+                                new_cfg = sched.explore(donor["config"])
+                                it = st["iteration"]
+                                try:
+                                    ray_tpu.kill(st["actor"])
+                                except Exception:  # noqa: BLE001
+                                    pass
+                                running.pop(idx, None)
+                                _launch(idx, new_cfg,
+                                        resume_checkpoint=donor["ckpt"],
+                                        iteration=it)
