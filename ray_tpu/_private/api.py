@@ -245,6 +245,11 @@ def _print_worker_log(p):
     stream = sys.stderr if p.get("kind") == "err" else sys.stdout
     wid = p.get("worker_id", b"").hex()[:6]
     line = p.get("line", "")
+    # structured tqdm_ray progress lines render in place, not as logs
+    from ray_tpu.experimental.tqdm_ray import maybe_render
+
+    if maybe_render(line):
+        return
     # jax/XLA emit volumes of WARNING noise; keep driver output readable
     print(f"({wid}) {line}", file=stream)
 

@@ -36,6 +36,9 @@ _DEFS: dict[str, Any] = {
     "inline_object_max_bytes": 100 * 1024,
     "put_pressure_retry_s": 10.0,
     "fetch_retry_timeout_s": 60.0,
+    # -- pallas kernels --
+    "flash_block_q": 256,   # v5e-tuned (see ops/flash_attention.py)
+    "flash_block_k": 1024,
     # -- memory monitor --
     "memory_monitor_interval_s": 2.0,
     "memory_usage_kill_fraction": 0.95,  # memory_monitor.h:52 analog

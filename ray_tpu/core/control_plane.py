@@ -100,6 +100,7 @@ class NodeInfo:
         self.queued = 0  # tasks waiting (autoscaler demand signal)
         self.running = 0
         self.store_primaries = 0  # pinned primaries (scale-down gate)
+        self.stats: dict = {}  # psutil node stats from the agent
         # Head-side placement deductions newer than ~2 heartbeats: applied
         # on top of agent reports so a fresh heartbeat (sent before the
         # agent processed the placement) can't make the head double-book
@@ -136,6 +137,7 @@ class NodeInfo:
             "queued": self.queued,
             "running": self.running,
             "store_primaries": self.store_primaries,
+            "stats": self.stats,
         }
 
 
@@ -169,6 +171,8 @@ class ControlPlane:
         import collections
 
         self.task_events: collections.deque = collections.deque(maxlen=50_000)
+        # per-reporter metric series (rpc_record_metrics)
+        self.metrics: dict[bytes, dict] = {}
         self._agent_clients: dict[bytes, rpc.AsyncRpcClient] = {}
         from ray_tpu._private import config as cfg
 
@@ -347,6 +351,8 @@ class ControlPlane:
         node.queued = p.get("queued", 0)
         node.running = p.get("running", 0)
         node.store_primaries = p.get("store_primaries", 0)
+        if p.get("stats"):
+            node.stats = p["stats"]
         if "resources_available" in p:
             node.apply_report(
                 p["resources_available"], window_s=2.0
@@ -1022,6 +1028,42 @@ class ControlPlane:
             events = [e for e in events if e.get("job_id") == job_id]
         limit = p.get("limit", 10_000)
         return events[-limit:]
+
+    # -- metrics (reference stats substrate, SURVEY §2.1: OpenCensus ->
+    # agent exporter; here processes push cumulative series and the head
+    # aggregates across reporters for the dashboard's /metrics) --
+
+    async def rpc_record_metrics(self, conn, p):
+        reporter = p.get("reporter", b"?")
+        store = self.metrics.setdefault(reporter, {})
+        now = time.time()
+        for name, kind, desc, tags, value in p["rows"]:
+            store[(name, tuple(map(tuple, tags)))] = (
+                kind, desc, float(value), now
+            )
+        return True
+
+    async def rpc_get_metrics(self, conn, p):
+        """Aggregated across reporters: counters/histograms sum; gauges
+        sum live reporters only (stale gauge series age out)."""
+        now = time.time()
+        agg: dict = {}
+        for reporter, series in self.metrics.items():
+            for (name, tags), (kind, desc, value, ts) in series.items():
+                if kind == "gauge" and now - ts > 120.0:
+                    continue
+                key = (name, tags)
+                if key in agg:
+                    agg[key][2] += value
+                else:
+                    agg[key] = [kind, desc, value]
+        return [
+            {"name": name, "tags": [list(t) for t in tags],
+             "kind": kind, "description": desc, "value": value}
+            for (name, tags), (kind, desc, value) in (
+                (k, tuple(v)) for k, v in sorted(agg.items())
+            )
+        ]
 
     async def rpc_list_objects(self, conn, p):
         out = []

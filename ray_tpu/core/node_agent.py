@@ -285,6 +285,9 @@ class NodeAgent:
                     "queued": len(self.task_queue),
                     "running": len(self.running),
                     "store_primaries": len(self.primaries),
+                    # reporter-agent analog (reporter_agent.py:266):
+                    # physical node stats for the dashboard/state API
+                    "stats": self._node_stats(),
                 })
                 if reply.get("unknown"):
                     await self.head.call("register_node", {
@@ -299,6 +302,22 @@ class NodeAgent:
             except (rpc.ConnectionLost, rpc.RpcError):
                 pass
             await asyncio.sleep(1.0)
+
+    def _node_stats(self) -> dict:
+        """psutil node stats (reference reporter_agent.py:266 — cpu/mem
+        plus this framework's store occupancy)."""
+        try:
+            import psutil
+
+            vm = psutil.virtual_memory()
+            return {
+                "cpu_percent": psutil.cpu_percent(interval=None),
+                "mem_total": vm.total,
+                "mem_available": vm.available,
+                "num_workers": len(self.workers),
+            }
+        except Exception:  # noqa: BLE001 — stats are best-effort
+            return {"num_workers": len(self.workers)}
 
     # ---------------- worker pool ----------------
 
@@ -851,6 +870,21 @@ class NodeAgent:
             w.busy_task = None
             self._free_task_resources(spec)
             await self._notify_task_failed(spec, f"dispatch failed: {e}")
+
+    async def rpc_dump_stacks(self, conn, p):
+        """Aggregate thread stacks across this node's workers (dashboard
+        profiling endpoint; reference reporter_agent.py:348)."""
+        out = []
+        for w in list(self.workers.values()):
+            if w.client is None or w.client.closed:
+                continue
+            try:
+                out.append(await w.client.call("dump_stacks", {},
+                                               timeout=5.0))
+            except (rpc.ConnectionLost, rpc.RpcError,
+                    asyncio.TimeoutError):
+                pass
+        return {"node_id": self.node_id, "workers": out}
 
     async def rpc_task_done(self, conn, p):
         """Worker reports completion; frees resources, worker back to pool."""

@@ -138,6 +138,19 @@ class Executor(CoreWorker):
     async def rpc_ping(self, conn, p):
         return "pong"
 
+    async def rpc_dump_stacks(self, conn, p):
+        """py-spy analog (reference reporter_agent.py:348 GetTraceback):
+        formatted stacks of every thread in this worker."""
+        import traceback as tb
+
+        frames = sys._current_frames()
+        out = {}
+        for t in threading.enumerate():
+            f = frames.get(t.ident)
+            if f is not None:
+                out[t.name] = "".join(tb.format_stack(f))
+        return {"worker_id": self.worker_id, "stacks": out}
+
     async def rpc_exit(self, conn, p):
         os._exit(0)
 

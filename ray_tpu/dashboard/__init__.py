@@ -1,0 +1,9 @@
+"""ray_tpu.dashboard — observability HTTP backend.
+
+Reference: dashboard/head.py + state_aggregator.py + modules/metrics +
+modules/reporter (SURVEY §2.15). No React frontend — the backend serves
+the same data as JSON plus a Prometheus /metrics endpoint, which is what
+the reference's Grafana integration actually scrapes.
+"""
+
+from ray_tpu.dashboard.head import DashboardHead, start_dashboard  # noqa: F401

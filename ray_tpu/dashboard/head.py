@@ -1,0 +1,234 @@
+"""Dashboard head: HTTP server over the control plane's state.
+
+Reference: dashboard/head.py (aiohttp app + module loader),
+state_aggregator.py:133 (list endpoints), modules/metrics (Prometheus),
+modules/reporter (node stats + stack dumps). Endpoints:
+
+  GET /api/nodes     cluster nodes incl. psutil stats
+  GET /api/actors    actor table
+  GET /api/jobs      job table
+  GET /api/tasks     recent task events
+  GET /api/objects   object directory sample
+  GET /api/cluster   summary (alive nodes, resource totals)
+  GET /api/stacks    thread stacks of every worker (py-spy analog)
+  GET /metrics       Prometheus text format (cluster + user metrics)
+
+Runs inside the driver (or any process with cluster access) on a
+background thread; `ray_tpu.scripts start --head` can host it next to
+the control plane.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from urllib.parse import urlsplit
+
+import ray_tpu
+
+
+def _prom_escape(s: str) -> str:
+    return s.replace("\\", r"\\").replace('"', r'\"').replace("\n", r"\n")
+
+
+def _to_prometheus(rows: list[dict], cluster: dict) -> str:
+    """Render aggregated metric rows + built-in cluster gauges."""
+    lines: list[str] = []
+    builtins_ = [
+        ("ray_tpu_cluster_nodes_alive", "gauge",
+         "Alive nodes", [], cluster["nodes_alive"]),
+        ("ray_tpu_cluster_cpus_total", "gauge",
+         "Total CPUs", [], cluster["cpus_total"]),
+        ("ray_tpu_cluster_cpus_available", "gauge",
+         "Available CPUs", [], cluster["cpus_available"]),
+        ("ray_tpu_cluster_tasks_queued", "gauge",
+         "Queued tasks", [], cluster["tasks_queued"]),
+    ]
+    seen_help: set[str] = set()
+    for row in builtins_ + [
+        (r["name"], r["kind"], r["description"], r["tags"], r["value"])
+        for r in rows
+    ]:
+        name, kind, desc, tags, value = row
+        stat = None
+        clean_tags = []
+        for k, v in tags:
+            if k == "__stat__":
+                stat = v
+            else:
+                clean_tags.append((k, v))
+        metric = name
+        if stat == "sum":
+            metric = f"{name}_sum"
+        elif any(k == "le" for k, _ in clean_tags):
+            metric = f"{name}_bucket"
+        if name not in seen_help:
+            seen_help.add(name)
+            lines.append(f"# HELP {name} {_prom_escape(desc or name)}")
+            lines.append(f"# TYPE {name} {kind}")
+        label = ",".join(
+            f'{k}="{_prom_escape(str(v))}"' for k, v in clean_tags
+        )
+        lines.append(
+            f"{metric}{{{label}}} {value}" if label else f"{metric} {value}"
+        )
+    return "\n".join(lines) + "\n"
+
+
+class DashboardHead:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._ready = threading.Event()
+        threading.Thread(target=self._drive, daemon=True,
+                         name="ray_tpu-dashboard").start()
+
+    # -- state access (all through the connected worker's head client) --
+
+    def _head(self):
+        from ray_tpu._private.api import _get_worker
+
+        return _get_worker().head
+
+    def _cluster_summary(self) -> dict:
+        nodes = self._head().call("get_cluster_view", {})["nodes"]
+        alive = [n for n in nodes if n["alive"]]
+        return {
+            "nodes_alive": len(alive),
+            "nodes_total": len(nodes),
+            "cpus_total": sum(
+                n["resources_total"].get("CPU", 0) for n in alive
+            ),
+            "cpus_available": sum(
+                n["resources_available"].get("CPU", 0) for n in alive
+            ),
+            "tpus_total": sum(
+                n["resources_total"].get("TPU", 0) for n in alive
+            ),
+            "tasks_queued": sum(n.get("queued", 0) for n in alive),
+            "tasks_running": sum(n.get("running", 0) for n in alive),
+        }
+
+    def _api(self, path: str, query: dict):
+        head = self._head()
+        if path == "/api/nodes":
+            return head.call("get_cluster_view", {})["nodes"]
+        if path == "/api/actors":
+            return head.call("list_actors", {})
+        if path == "/api/jobs":
+            return head.call("list_jobs", {})
+        if path == "/api/tasks":
+            return head.call("list_task_events",
+                             {"limit": int(query.get("limit", 1000))})
+        if path == "/api/objects":
+            return head.call("list_objects",
+                             {"limit": int(query.get("limit", 1000))})
+        if path == "/api/cluster":
+            return self._cluster_summary()
+        if path == "/api/stacks":
+            nodes = head.call("get_cluster_view", {})["nodes"]
+            out = []
+            for n in nodes:
+                if not n["alive"]:
+                    continue
+                try:
+                    from ray_tpu._private import rpc as _rpc
+                    from ray_tpu._private.api import _get_worker
+
+                    cli = _rpc.SyncRpcClient(
+                        n["addr"], n["port"], _get_worker().io
+                    )
+                    out.append(cli.call("dump_stacks", {}, timeout=10.0))
+                    cli.close()
+                except Exception as e:  # noqa: BLE001
+                    out.append({"node_id": n["node_id"],
+                                "error": str(e)})
+            return out
+        return None
+
+    # -- http plumbing (same raw-asyncio pattern as serve's proxy) --
+
+    def _drive(self):
+        import asyncio
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+
+        async def boot():
+            server = await asyncio.start_server(
+                self._serve_conn, self.host, self.port
+            )
+            self.port = server.sockets[0].getsockname()[1]
+            self._ready.set()
+
+        loop.run_until_complete(boot())
+        loop.run_forever()
+
+    def wait_ready(self, timeout: float = 30.0) -> tuple[str, int]:
+        if not self._ready.wait(timeout):
+            raise TimeoutError("dashboard failed to bind")
+        return self.host, self.port
+
+    async def _serve_conn(self, reader, writer):
+        import asyncio
+
+        try:
+            while True:
+                line = await reader.readline()
+                if not line or line in (b"\r\n", b"\n"):
+                    break
+                _method, target, _ = line.decode().split(" ", 2)
+                while True:  # drain headers
+                    h = await reader.readline()
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                status, ctype, payload = await asyncio.get_running_loop() \
+                    .run_in_executor(None, self._dispatch, target)
+                writer.write(
+                    f"HTTP/1.1 {status}\r\nContent-Type: {ctype}\r\n"
+                    f"Content-Length: {len(payload)}\r\n"
+                    "Connection: keep-alive\r\n\r\n".encode() + payload
+                )
+                await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _dispatch(self, target: str):
+        parts = urlsplit(target)
+        query = {
+            k: v for k, v in
+            (kv.split("=", 1) for kv in parts.query.split("&") if "=" in kv)
+        }
+        try:
+            if parts.path == "/metrics":
+                rows = self._head().call("get_metrics", {})
+                text = _to_prometheus(rows, self._cluster_summary())
+                return "200 OK", "text/plain; version=0.0.4", text.encode()
+            data = self._api(parts.path, query)
+            if data is None:
+                return ("404 Not Found", "application/json",
+                        json.dumps({"error": parts.path}).encode())
+            return ("200 OK", "application/json",
+                    json.dumps(data, default=_jsonable).encode())
+        except Exception as e:  # noqa: BLE001
+            return ("500 Internal Server Error", "application/json",
+                    json.dumps({"error": str(e)}).encode())
+
+
+def _jsonable(o):
+    if isinstance(o, bytes):
+        return o.hex()
+    return repr(o)
+
+
+def start_dashboard(host: str = "127.0.0.1",
+                    port: int = 0) -> tuple[str, int]:
+    """Start the dashboard in this (cluster-connected) process; returns
+    its (host, port)."""
+    d = DashboardHead(host, port)
+    return d.wait_ready()

@@ -9,9 +9,7 @@ limit, aggregates) go through these helpers so every block type yields
 
 from __future__ import annotations
 
-import hashlib
-import pickle
-from typing import Any
+from ray_tpu.utils.hashing import stable_hash  # noqa: F401 — re-export
 
 
 def block_rows(block) -> list:
@@ -40,13 +38,3 @@ def build_like(proto, rows: list):
     if isinstance(proto, np.ndarray):
         return np.asarray(rows, dtype=proto.dtype)
     return rows
-
-
-def stable_hash(key: Any) -> int:
-    """Deterministic across processes (python's hash() is per-process
-    salted for str/bytes, which would scatter one group key over several
-    hash partitions depending on which worker ran the map task)."""
-    payload = pickle.dumps(key, protocol=4)
-    return int.from_bytes(
-        hashlib.blake2b(payload, digest_size=8).digest(), "little"
-    )

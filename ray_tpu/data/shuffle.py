@@ -110,18 +110,24 @@ def _reduce_groups(key_blob, agg_blob, *parts):
     return [agg(k, rows) for k, rows in sorted(groups.items())]
 
 
-def _exchange(blocks: list, mode: str, spec, num_parts: int) -> list[list]:
-    """Run phase 1 over all blocks; returns per-partition ref lists."""
+def _exchange(blocks: list, mode: str, specs, num_parts: int) -> list[list]:
+    """Run phase 1 over all blocks; returns per-partition ref lists.
+
+    `specs` is either one spec for every block or a per-block list
+    (random mode derives a distinct seed per block — a shared seed would
+    send the same intra-block offsets to the same partitions every time).
+    """
     if num_parts == 1:
         # partitioning into one part is the identity: feed every block
         # straight to the single reducer
         return [list(blocks)]
-    spec_blob = serialization.pack_payload(spec)
+    if not isinstance(specs, list):
+        specs = [specs] * len(blocks)
     part_refs = [
         _partition_block.options(num_returns=num_parts).remote(
-            b, mode, spec_blob
+            b, mode, serialization.pack_payload(spec)
         )
-        for b in blocks
+        for b, spec in zip(blocks, specs)
     ]
     # transpose: partition i gathers piece i of every block
     return [[refs[i] for refs in part_refs] for i in range(num_parts)]
@@ -147,6 +153,11 @@ def sort_blocks(blocks: list, key, descending: bool,
     sample.sort()
     if not sample:
         return list(blocks)
+    # more partitions than sampled keys would index bounds negatively and
+    # wrap; clamp so the bounds list stays monotone
+    num_parts = min(num_parts, len(sample))
+    if num_parts == 1:
+        return [_reduce_sorted.remote(key_blob, descending, *blocks)]
     bounds = [
         sample[(i + 1) * len(sample) // num_parts - 1]
         for i in range(num_parts - 1)
@@ -164,7 +175,11 @@ def shuffle_blocks(blocks: list, seed: int | None,
         return []
     num_parts = num_parts or len(blocks)
     seed = 0x5EED if seed is None else seed
-    parts = _exchange(blocks, "random", (seed, num_parts), num_parts)
+    parts = _exchange(
+        blocks, "random",
+        [(seed + 7919 * i, num_parts) for i in range(len(blocks))],
+        num_parts,
+    )
     return [
         _reduce_concat.remote(seed + 1 + i, *p)
         for i, p in enumerate(parts)

@@ -1,0 +1,3 @@
+"""ray_tpu.experimental — conveniences mirroring ray.experimental."""
+
+from ray_tpu.experimental import tqdm_ray  # noqa: F401

@@ -1,0 +1,99 @@
+"""Distributed-safe progress bars.
+
+Reference: python/ray/experimental/tqdm_ray.py — tqdm instances inside
+tasks/actors write interleaved garbage to the driver terminal; this shim
+routes structured progress updates through the runtime's log channel
+(worker stdout is already forwarded line-wise to the driver), one JSON
+state line per update, deduplicated driver-side by bar id.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+_MAGIC = "__ray_tpu_tqdm__"
+_MIN_INTERVAL_S = 0.1
+
+
+class tqdm:  # noqa: N801 — mirrors tqdm's API
+    """Drop-in subset: iteration, update(), set_description(), close()."""
+
+    _counter = 0
+    _lock = threading.Lock()
+
+    def __init__(self, iterable=None, desc: str = "", total: int | None = None,
+                 **_kw):
+        self._iterable = iterable
+        self.desc = desc
+        if total is None and iterable is not None:
+            try:
+                total = len(iterable)
+            except TypeError:
+                total = None
+        self.total = total
+        self.n = 0
+        with tqdm._lock:
+            tqdm._counter += 1
+            self._uuid = f"{os.getpid()}-{tqdm._counter}"
+        self._last_emit = 0.0
+        self._emit(force=True)
+
+    def __iter__(self):
+        for item in self._iterable:
+            yield item
+            self.update(1)
+        self.close()
+
+    def update(self, n: int = 1):
+        self.n += n
+        self._emit()
+
+    def set_description(self, desc: str):
+        self.desc = desc
+        self._emit()
+
+    def close(self):
+        self._emit(force=True, closed=True)
+
+    def _emit(self, force: bool = False, closed: bool = False):
+        now = time.monotonic()
+        if not force and now - self._last_emit < _MIN_INTERVAL_S:
+            return
+        self._last_emit = now
+        state = {
+            "bar": self._uuid, "desc": self.desc, "n": self.n,
+            "total": self.total, "closed": closed,
+        }
+        print(f"{_MAGIC}{json.dumps(state)}", flush=True)
+
+
+_bars: dict = {}
+_render_lock = threading.Lock()
+
+
+def maybe_render(line: str, out=None) -> bool:
+    """Driver-side hook: if `line` is a tqdm state line, render it and
+    return True (callers then skip normal log printing)."""
+    if _MAGIC not in line:
+        return False
+    out = out or sys.stderr
+    try:
+        state = json.loads(line.split(_MAGIC, 1)[1])
+    except (json.JSONDecodeError, IndexError):
+        return False
+    with _render_lock:
+        _bars[state["bar"]] = state
+        if state.get("closed"):
+            _bars.pop(state["bar"], None)
+        total = state.get("total")
+        frac = f"{state['n']}/{total}" if total else str(state["n"])
+        desc = state.get("desc") or "progress"
+        out.write(f"\r[{desc}] {frac}")
+        out.flush()
+        if state.get("closed"):
+            out.write("\n")
+    return True

@@ -1,0 +1,151 @@
+"""Job submission API: run driver scripts on the cluster with tracked
+status and captured logs.
+
+Reference: dashboard/modules/job/ (job_manager.py JobManager + the
+per-job JobSupervisor actor; sdk.py:40 JobSubmissionClient). Same shape:
+`submit_job` starts a detached supervisor actor that runs the entrypoint
+as a subprocess, streams its output into a buffer, and records terminal
+status; the submission registry lives in the internal KV so any client
+connected to the cluster can list/poll jobs.
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+
+import ray_tpu
+
+JOB_KV_PREFIX = "__job__:"
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+SUCCEEDED = "SUCCEEDED"
+FAILED = "FAILED"
+STOPPED = "STOPPED"
+
+
+@ray_tpu.remote(num_cpus=0, max_concurrency=4)
+class _JobSupervisor:
+    """reference job_manager.py JobSupervisor: one per submission."""
+
+    def __init__(self, submission_id: str):
+        self._id = submission_id
+        self._status = PENDING
+        self._lines: list[str] = []
+        self._proc = None
+        self._message = ""
+
+    def run(self, entrypoint: str, env_vars: dict | None = None) -> bool:
+        import subprocess
+        import threading
+
+        env = dict(os.environ)
+        env.update(env_vars or {})
+        self._status = RUNNING
+        self._proc = subprocess.Popen(
+            entrypoint, shell=True, env=env, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+
+        def pump():
+            for line in self._proc.stdout:
+                self._lines.append(line)
+            rc = self._proc.wait()
+            if self._status != STOPPED:
+                self._status = SUCCEEDED if rc == 0 else FAILED
+                self._message = f"exit code {rc}"
+
+        threading.Thread(target=pump, daemon=True).start()
+        return True
+
+    def status(self) -> dict:
+        return {"submission_id": self._id, "status": self._status,
+                "message": self._message}
+
+    def logs(self) -> str:
+        return "".join(self._lines)
+
+    def stop(self) -> bool:
+        if self._proc is not None and self._proc.poll() is None:
+            self._status = STOPPED
+            self._message = "stopped by user"
+            self._proc.terminate()
+            return True
+        return False
+
+
+class JobSubmissionClient:
+    """reference sdk.py:40 — driver-side client; requires a connected
+    ray_tpu (ray_tpu.init() or an existing cluster connection)."""
+
+    def __init__(self):
+        self._w = ray_tpu._private.api._get_worker()
+
+    def _kv_put(self, sid: str, value: str):
+        self._w.head.call("kv_put", {
+            "ns": "job", "key": (JOB_KV_PREFIX + sid).encode(),
+            "value": value.encode(),
+        })
+
+    def submit_job(self, *, entrypoint: str,
+                   env_vars: dict | None = None,
+                   submission_id: str | None = None) -> str:
+        sid = submission_id or f"raysubmit_{uuid.uuid4().hex[:12]}"
+        sup = _JobSupervisor.options(
+            name=f"__job_supervisor_{sid}__", lifetime="detached"
+        ).remote(sid)
+        ray_tpu.get(sup.run.remote(entrypoint, env_vars), timeout=60)
+        self._kv_put(sid, "submitted")
+        return sid
+
+    def _sup(self, sid: str):
+        return ray_tpu.get_actor(f"__job_supervisor_{sid}__")
+
+    def get_job_status(self, sid: str) -> str:
+        return ray_tpu.get(self._sup(sid).status.remote(),
+                           timeout=30)["status"]
+
+    def get_job_info(self, sid: str) -> dict:
+        return ray_tpu.get(self._sup(sid).status.remote(), timeout=30)
+
+    def get_job_logs(self, sid: str) -> str:
+        return ray_tpu.get(self._sup(sid).logs.remote(), timeout=30)
+
+    def stop_job(self, sid: str) -> bool:
+        return ray_tpu.get(self._sup(sid).stop.remote(), timeout=30)
+
+    def delete_job(self, sid: str) -> bool:
+        try:
+            ray_tpu.kill(self._sup(sid))
+        except ValueError:
+            return False
+        self._w.head.call("kv_del", {
+            "ns": "job", "key": (JOB_KV_PREFIX + sid).encode(),
+        })
+        return True
+
+    def list_jobs(self) -> list[dict]:
+        keys = self._w.head.call("kv_keys", {
+            "ns": "job", "prefix": JOB_KV_PREFIX.encode(),
+        })
+        out = []
+        for k in keys:
+            sid = bytes(k).decode()[len(JOB_KV_PREFIX):]
+            try:
+                out.append(self.get_job_info(sid))
+            except Exception:  # noqa: BLE001 — supervisor gone
+                out.append({"submission_id": sid, "status": STOPPED,
+                            "message": "supervisor dead"})
+        return out
+
+    def wait_until_finish(self, sid: str, timeout: float = 300.0) -> str:
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            st = self.get_job_status(sid)
+            if st in (SUCCEEDED, FAILED, STOPPED):
+                return st
+            time.sleep(0.5)
+        raise TimeoutError(f"job {sid} still {st} after {timeout}s")

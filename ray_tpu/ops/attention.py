@@ -66,14 +66,14 @@ def attention(q, k, v, *, causal: bool = True, use_flash: bool | None = None):
     if auto:
         use_flash = jax.default_backend() == "tpu"
     if use_flash:
-        from ray_tpu.ops.flash_attention import (
-            DEFAULT_BLOCK_K,
-            DEFAULT_BLOCK_Q,
-            flash_attention,
-        )
+        from ray_tpu._private import config as _cfg
+        from ray_tpu.ops.flash_attention import flash_attention
 
         t, s = q.shape[1], k.shape[1]
-        bq, bk = min(DEFAULT_BLOCK_Q, t), min(DEFAULT_BLOCK_K, s)
+        # same config flags flash_attention resolves itself
+        # (RAY_TPU_FLASH_BLOCK_Q/_K), so deployments retune in one place
+        bq = min(_cfg.get("flash_block_q"), t)
+        bk = min(_cfg.get("flash_block_k"), s)
         if t % bq == 0 and s % bk == 0:
             return flash_attention(q, k, v, causal=causal, block_q=bq, block_k=bk)
         if not auto:

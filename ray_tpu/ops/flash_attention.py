@@ -27,8 +27,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ray_tpu.utils.math import cdiv
 
-DEFAULT_BLOCK_Q = 512
-DEFAULT_BLOCK_K = 1024
+DEFAULT_BLOCK_Q = 256  # measured on v5e: b8xT2048xh8xd128 fwd 4.2ms vs
+DEFAULT_BLOCK_K = 1024  # 5.5ms at bq=512 (full-row k tiles)
 # Up to this sequence length the kernels take the whole row/column as one
 # inner tile: per-block overhead and dead-block DMA cost more than the
 # causal-flop saving at short-to-medium T (measured on v5e: full-row
@@ -488,14 +488,22 @@ def flash_attention(
     v,
     *,
     causal: bool = True,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_k: int = DEFAULT_BLOCK_K,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool | None = None,
 ):
     """Flash attention. Layout [B, T, H, D] (matching ops.attention).
 
-    Requires T and S to be multiples of the (clamped) block sizes; callers pad.
+    Requires T and S to be multiples of the (clamped) block sizes; callers
+    pad. Block sizes default from the config flags flash_block_q/_k
+    (RAY_TPU_FLASH_BLOCK_Q/_K) so deployments can retune per chip
+    generation without code changes.
     """
+    if block_q is None or block_k is None:
+        from ray_tpu._private import config as _cfg
+
+        block_q = block_q or _cfg.get("flash_block_q")
+        block_k = block_k or _cfg.get("flash_block_k")
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     # Kernel-internal layout is [B, H, T, D].

@@ -1,0 +1,70 @@
+"""Ulysses-style sequence parallelism: all-to-all head resharding.
+
+SURVEY §5 long-context row — absent in the reference (no sequence
+parallelism anywhere in Ray; DeepSpeed-Ulysses is the published design
+this reimplements TPU-natively). Complement to ops/ring_attention.py:
+
+  ring attention:  keeps seq sharded, streams K/V blocks around the ring
+                   (O(T/sp) memory, sp ppermute hops per block)
+  ulysses:         two all-to-alls reshard seq <-> heads so each chip
+                   runs FULL-sequence attention for H/sp heads — one
+                   fused collective each way, and the unmodified flash
+                   kernel does the math at full MXU efficiency
+
+Inside a partial-manual shard_map over `sp` (every other mesh axis stays
+GSPMD-auto):  [B, T/sp, H, D] --all_to_all--> [B, T, H/sp, D]
+              -> flash_attention -> inverse all_to_all.
+Requires H divisible by the sp size. Differentiable (all_to_all is its
+own transpose up to axis swap).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.ops.attention import attention
+
+
+def ulysses_attention(
+    q, k, v, *, causal: bool = True, axis: str = "sp", mesh=None,
+    use_flash: bool | None = None,
+):
+    """Attention over a seq-sharded [B, T, H, D] layout via head exchange.
+
+    q/k/v: [B, T, H, D] with T sharded on `axis` (rule ("seq", "sp")).
+    Returns [B, T, H, D] sharded the same way.
+    """
+    if mesh is None:
+        mesh = jax.sharding.get_abstract_mesh()
+    sp = dict(mesh.shape).get(axis, 1)
+    if sp == 1:
+        return attention(q, k, v, causal=causal, use_flash=use_flash)
+    n_heads = q.shape[2]
+    if n_heads % sp:
+        raise ValueError(f"heads={n_heads} not divisible by {axis}={sp}")
+
+    def body(q_, k_, v_):
+        # local [B, T/sp, H, D] -> [B, T, H/sp, D]: split heads, gather seq
+        def fwd(x):
+            return jax.lax.all_to_all(
+                x, axis, split_axis=2, concat_axis=1, tiled=True
+            )
+
+        def inv(x):
+            return jax.lax.all_to_all(
+                x, axis, split_axis=1, concat_axis=2, tiled=True
+            )
+
+        o = attention(
+            fwd(q_), fwd(k_), fwd(v_), causal=causal, use_flash=use_flash
+        )
+        return inv(o)
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+        out_specs=P(None, axis),
+        axis_names=frozenset({axis}),
+    )(q, k, v)

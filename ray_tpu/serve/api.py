@@ -233,7 +233,17 @@ class _Controller:
                         )
                         for _ in range(desired - cur)
                     ]
-                    rt.get([r.health.remote() for r in new], timeout=300)
+                    try:
+                        rt.get([r.health.remote() for r in new], timeout=60)
+                    except Exception:  # noqa: BLE001
+                        # failed/slow constructors: reap, retry next tick
+                        # (never leak unregistered actors)
+                        for r in new:
+                            try:
+                                rt.kill(r)
+                            except Exception:  # noqa: BLE001
+                                pass
+                        raise
                     d["replicas"].extend(new)
                     self._publish(name)
                 elif desired < cur:
@@ -476,7 +486,11 @@ class DeploymentHandle:
             with self._lock:
                 n = len(self._replicas)
                 if model_id:
-                    pref = hash(model_id) % n
+                    # process-stable hash: the proxy and every driver must
+                    # agree on the preferred replica or caches thrash
+                    from ray_tpu.utils.hashing import stable_hash
+
+                    pref = stable_hash(model_id) % n
                     if self._inflight[pref] < self._max_q:
                         self._inflight[pref] += 1
                         return pref

@@ -1,0 +1,106 @@
+"""SklearnTrainer + Predictor / BatchPredictor.
+
+Reference: train/sklearn/sklearn_trainer.py (fit an estimator inside a
+remote worker with cpu parallelism), train/predictor.py +
+batch_predictor.py (fitted-model inference over a Dataset). The TPU
+build keeps the same surface: the estimator trains in a task (driver
+stays free), the fitted model rides the object store, and BatchPredictor
+fans inference over Dataset blocks as tasks.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import ray_tpu
+
+
+@ray_tpu.remote(num_cpus=1)
+def _fit_task(est_blob, X, y, fit_params: dict):
+    from ray_tpu._private import serialization
+
+    est = serialization.unpack_payload(est_blob)
+    est.fit(X, y, **fit_params)
+    return est
+
+
+class SklearnTrainer:
+    """reference sklearn_trainer.py: `fit()` returns a Result whose
+    checkpoint holds the fitted estimator."""
+
+    def __init__(self, estimator, *, label_column: str | None = None,
+                 datasets: dict | None = None, X=None, y=None,
+                 fit_params: dict | None = None):
+        self._est = estimator
+        self._label = label_column
+        self._datasets = datasets or {}
+        self._X, self._y = X, y
+        self._fit_params = fit_params or {}
+
+    def fit(self):
+        import numpy as np
+
+        from ray_tpu._private import serialization
+        from ray_tpu.tune.tuner import Result
+
+        X, y = self._X, self._y
+        if X is None and "train" in self._datasets:
+            rows = list(self._datasets["train"].iter_rows())
+            if self._label is None:
+                raise ValueError("label_column required with datasets")
+            y = np.asarray([r[self._label] for r in rows])
+            X = np.asarray([
+                [v for k_, v in sorted(r.items()) if k_ != self._label]
+                for r in rows
+            ])
+        est_blob = serialization.pack_callable(self._est)
+        fitted = ray_tpu.get(
+            _fit_task.remote(est_blob, X, y, self._fit_params),
+            timeout=600,
+        )
+        score = None
+        try:
+            score = float(fitted.score(X, y))
+        except Exception:  # noqa: BLE001 — not all estimators score
+            pass
+        return Result(
+            config={}, metrics={"score": score},
+            checkpoint={"estimator": fitted}, trial_id="sklearn",
+        )
+
+
+class Predictor:
+    """reference train/predictor.py: wraps a fitted model."""
+
+    def __init__(self, estimator):
+        self._est = estimator
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint: dict) -> "Predictor":
+        return cls(checkpoint["estimator"])
+
+    def predict(self, batch):
+        import numpy as np
+
+        import pandas as pd
+
+        if isinstance(batch, pd.DataFrame):
+            return self._est.predict(batch.to_numpy())
+        return self._est.predict(np.asarray(batch))
+
+
+class BatchPredictor:
+    """reference train/batch_predictor.py: Dataset-parallel inference."""
+
+    def __init__(self, checkpoint: dict, predictor_cls=Predictor):
+        self._checkpoint = checkpoint
+        self._cls = predictor_cls
+
+    def predict(self, dataset, **kw) -> Any:
+        ckpt = self._checkpoint
+        cls = self._cls
+
+        def infer(block):
+            return cls.from_checkpoint(ckpt).predict(block)
+
+        return dataset.map_batches(infer, **kw)

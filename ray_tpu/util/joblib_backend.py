@@ -1,0 +1,55 @@
+"""joblib backend on the task runtime.
+
+Reference: python/ray/util/joblib/ — `register_ray()` registers a
+joblib parallel backend so `with joblib.parallel_backend("ray_tpu"):`
+fans scikit-learn-style workloads out as cluster tasks. Built on the
+multiprocessing Pool shim (util/multiprocessing.py), mirroring how the
+reference rides its Pool implementation.
+"""
+
+from __future__ import annotations
+
+from joblib._parallel_backends import MultiprocessingBackend
+
+from ray_tpu.util.multiprocessing import Pool
+
+
+class RayTpuBackend(MultiprocessingBackend):
+    """joblib backend executing batches as ray_tpu tasks."""
+
+    supports_timeout = True
+
+    def effective_n_jobs(self, n_jobs):
+        if n_jobs == 1:
+            return 1
+        import ray_tpu
+
+        try:
+            total = sum(
+                n["resources_total"].get("CPU", 0)
+                for n in ray_tpu.nodes() if n["alive"]
+            )
+        except Exception:  # noqa: BLE001 — not connected yet
+            total = 0
+        cpus = int(total) or 8
+        return cpus if n_jobs in (-1, None) else min(n_jobs, cpus)
+
+    def configure(self, n_jobs=1, parallel=None, prefer=None, require=None,
+                  **kwargs):
+        n_jobs = self.effective_n_jobs(n_jobs)
+        # eat kwargs the mp backend would pass to multiprocessing.Pool
+        self._pool = Pool(processes=n_jobs)
+        self.parallel = parallel
+        return n_jobs
+
+    def terminate(self):
+        if getattr(self, "_pool", None) is not None:
+            self._pool.terminate()
+            self._pool = None
+
+
+def register_ray():
+    """Make `joblib.parallel_backend("ray_tpu")` available."""
+    from joblib.parallel import register_parallel_backend
+
+    register_parallel_backend("ray_tpu", RayTpuBackend)

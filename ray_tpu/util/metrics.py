@@ -1,0 +1,153 @@
+"""Application metrics API: Counter / Gauge / Histogram.
+
+Reference: python/ray/util/metrics.py (backed by OpenCensus + the
+dashboard agent's Prometheus exporter; SURVEY §2.1 stats row). Here
+metrics are process-local registries flushed by a background thread to
+the control plane (`record_metrics` RPC), which aggregates across
+processes; the dashboard head renders the store in Prometheus text
+format at /metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Sequence
+
+FLUSH_PERIOD_S = 1.0
+
+_registry: list["_Metric"] = []
+_reg_lock = threading.Lock()
+_flusher_started = False
+
+
+def _tagkey(tags: dict | None) -> tuple:
+    return tuple(sorted((tags or {}).items()))
+
+
+class _Metric:
+    kind = ""
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: dict = {}
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+        with _reg_lock:
+            _registry.append(self)
+        _ensure_flusher()
+
+    def set_default_tags(self, tags: dict):
+        self._default_tags = dict(tags)
+        return self
+
+    def _merged(self, tags: dict | None) -> tuple:
+        return _tagkey({**self._default_tags, **(tags or {})})
+
+    def _snapshot(self) -> list[tuple]:
+        with self._lock:
+            return [
+                (self.name, self.kind, self.description, list(k), v)
+                for k, v in self._values.items()
+            ]
+
+
+class Counter(_Metric):
+    """Monotonically increasing (reference metrics.py Counter)."""
+
+    kind = "counter"
+
+    def inc(self, value: float = 1.0, tags: dict | None = None):
+        if value < 0:
+            raise ValueError("Counter.inc() value must be >= 0")
+        k = self._merged(tags)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+
+class Gauge(_Metric):
+    """Last-value-wins (reference metrics.py Gauge)."""
+
+    kind = "gauge"
+
+    def set(self, value: float, tags: dict | None = None):
+        with self._lock:
+            self._values[self._merged(tags)] = float(value)
+
+
+class Histogram(_Metric):
+    """Cumulative bucket counts (reference metrics.py Histogram)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Sequence[float] = (),
+                 tag_keys: Sequence[str] = ()):
+        if not boundaries or list(boundaries) != sorted(boundaries):
+            raise ValueError("Histogram needs sorted, non-empty boundaries")
+        self.boundaries = tuple(boundaries)
+        super().__init__(name, description, tag_keys)
+
+    def observe(self, value: float, tags: dict | None = None):
+        base = self._merged(tags)
+        with self._lock:
+            # one cumulative series per bucket, + _sum and _count
+            for b in self.boundaries:
+                if value <= b:
+                    k = base + (("le", str(b)),)
+                    self._values[k] = self._values.get(k, 0.0) + 1
+            inf = base + (("le", "+Inf"),)
+            self._values[inf] = self._values.get(inf, 0.0) + 1
+            s = base + (("__stat__", "sum"),)
+            self._values[s] = self._values.get(s, 0.0) + value
+
+    def _snapshot(self):
+        rows = super()._snapshot()
+        return [
+            (n, k, self.description, tags, v)
+            for (n, k, _, tags, v) in rows
+        ]
+
+
+def _ensure_flusher():
+    global _flusher_started
+    with _reg_lock:
+        if _flusher_started:
+            return
+        _flusher_started = True
+    threading.Thread(target=_flush_loop, daemon=True,
+                     name="ray_tpu-metrics").start()
+
+
+def _flush_loop():
+    while True:
+        time.sleep(FLUSH_PERIOD_S)
+        try:
+            flush_once()
+        except Exception:  # noqa: BLE001 — metrics must never crash apps
+            pass
+
+
+def flush_once():
+    """Push every registered metric's current values to the head (no-op
+    when not connected to a cluster)."""
+    from ray_tpu._private import api as _api
+
+    w = _api._worker
+    if w is None or getattr(w, "head", None) is None:
+        return
+    with _reg_lock:
+        metrics = list(_registry)
+    rows = []
+    for m in metrics:
+        rows.extend(m._snapshot())
+    if rows:
+        # keyed by reporter so the head can replace this process's series
+        # (values are cumulative per process; the head sums across
+        # reporters at render time)
+        w.head.fire("record_metrics", {
+            "reporter": w.worker_id, "rows": rows,
+        })

@@ -97,6 +97,7 @@ class Pool:
         self._initargs = tuple(initargs)
         self._pool_id = f"{os.getpid()}-{next(_pool_counter)}"
         self._closed = False
+        self._cb_queue = None  # lazy; one drainer thread per pool
 
     def _check_open(self):
         if self._closed:
@@ -190,11 +191,53 @@ class Pool:
         return self._submit_windowed(task, iterable)
 
     def apply_async(self, fn: Callable, args: tuple = (),
-                    kwds: dict | None = None) -> AsyncResult:
+                    kwds: dict | None = None, callback=None,
+                    error_callback=None) -> AsyncResult:
         self._check_open()
         task = self._remote(fn)
-        return AsyncResult([task.remote(*args, **(kwds or {}))],
-                           single=True)
+        ref = task.remote(*args, **(kwds or {}))
+        result = AsyncResult([ref], single=True)
+        if callback is not None or error_callback is not None:
+            # stdlib semantics (joblib relies on this): callbacks fire
+            # from one pool-owned result-drainer thread (stdlib Pool's
+            # _handle_results model — NOT a thread per call)
+            self._enqueue_callback(ref, callback, error_callback)
+        return result
+
+    def _enqueue_callback(self, ref, callback, error_callback):
+        import queue as _q
+
+        if self._cb_queue is None:
+            self._cb_queue = _q.Queue()
+
+            def drain():
+                pending: list = []
+                while True:
+                    if not pending:
+                        pending.append(self._cb_queue.get())
+                    while True:  # absorb new submissions
+                        try:
+                            pending.append(self._cb_queue.get_nowait())
+                        except _q.Empty:
+                            break
+                    refs = [p[0] for p in pending]
+                    done, _ = ray_tpu.wait(refs, num_returns=1, timeout=1.0)
+                    if not done:
+                        continue  # re-poll the queue, then wait again
+                    i = refs.index(done[0])
+                    _, cb, ecb = pending.pop(i)
+                    try:
+                        value = ray_tpu.get([done[0]], timeout=None)[0]
+                    except BaseException as e:  # noqa: BLE001
+                        if ecb is not None:
+                            ecb(e)
+                        continue
+                    if cb is not None:
+                        cb(value)
+
+            threading.Thread(target=drain, daemon=True,
+                             name="ray_tpu-pool-callbacks").start()
+        self._cb_queue.put((ref, callback, error_callback))
 
     # -- lifecycle --
 

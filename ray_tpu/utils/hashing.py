@@ -1,0 +1,15 @@
+"""Process-stable hashing (python's builtin hash() is salted per process,
+which breaks any cross-process partitioning/affinity decision)."""
+
+from __future__ import annotations
+
+import hashlib
+import pickle
+from typing import Any
+
+
+def stable_hash(key: Any) -> int:
+    payload = pickle.dumps(key, protocol=4)
+    return int.from_bytes(
+        hashlib.blake2b(payload, digest_size=8).digest(), "little"
+    )

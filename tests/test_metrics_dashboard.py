@@ -1,0 +1,147 @@
+"""Metrics API + dashboard head.
+
+Reference test models: python/ray/tests/test_metrics_agent.py,
+dashboard/tests — user metrics flow process -> head -> Prometheus text;
+dashboard endpoints serve live cluster state.
+"""
+
+import http.client
+import json
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util.metrics import Counter, Gauge, Histogram, flush_once
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(head_resources={"CPU": 4, "memory": 4 * 2**30})
+    c.connect()
+    yield c
+    c.shutdown()
+
+
+def _get(addr, path):
+    conn = http.client.HTTPConnection(*addr, timeout=60)
+    conn.request("GET", path)
+    r = conn.getresponse()
+    body = r.read()
+    conn.close()
+    return r.status, body
+
+
+def test_metric_types_validate():
+    with pytest.raises(ValueError):
+        Histogram("h_bad", boundaries=[])
+    with pytest.raises(ValueError):
+        Histogram("h_bad2", boundaries=[5, 1])
+    c = Counter("c_neg")
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_metrics_flow_to_head(cluster):
+    c = Counter("test_requests_total", description="reqs",
+                tag_keys=("route",))
+    g = Gauge("test_queue_depth")
+    h = Histogram("test_latency_s", boundaries=[0.1, 1.0])
+    c.inc(3, tags={"route": "/a"})
+    c.inc(2, tags={"route": "/b"})
+    g.set(7)
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    flush_once()
+    w = ray_tpu._private.api._get_worker()
+    rows = w.head.call("get_metrics", {})
+    by_name = {}
+    for r in rows:
+        by_name.setdefault(r["name"], []).append(r)
+    assert sum(r["value"] for r in by_name["test_requests_total"]) == 5
+    assert any(r["value"] == 7 for r in by_name["test_queue_depth"])
+    lat = {tuple(map(tuple, r["tags"])): r["value"]
+           for r in by_name["test_latency_s"]}
+    assert lat[(("le", "0.1"),)] == 1
+    assert lat[(("le", "1.0"),)] == 2
+    assert lat[(("le", "+Inf"),)] == 3
+
+
+def test_metrics_from_remote_task(cluster):
+    @ray_tpu.remote
+    def emit():
+        from ray_tpu.util.metrics import Counter, flush_once
+
+        Counter("task_side_metric").inc(11)
+        flush_once()
+        return True
+
+    assert ray_tpu.get(emit.remote())
+    w = ray_tpu._private.api._get_worker()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        rows = w.head.call("get_metrics", {})
+        vals = [r["value"] for r in rows if r["name"] == "task_side_metric"]
+        if vals == [11]:
+            return
+        time.sleep(0.2)
+    raise AssertionError(f"task metric never arrived: {rows}")
+
+
+def test_dashboard_endpoints(cluster):
+    from ray_tpu.dashboard import start_dashboard
+
+    Counter("dash_metric").inc(4)
+    flush_once()
+    addr = start_dashboard()
+
+    status, body = _get(addr, "/api/cluster")
+    assert status == 200
+    summary = json.loads(body)
+    assert summary["nodes_alive"] >= 1
+    assert summary["cpus_total"] >= 4
+
+    status, body = _get(addr, "/api/nodes")
+    nodes = json.loads(body)
+    assert status == 200 and len(nodes) >= 1
+    # reporter stats ride heartbeats; wait for one carrying psutil stats
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        nodes = json.loads(_get(addr, "/api/nodes")[1])
+        if any("mem_total" in (n.get("stats") or {}) for n in nodes):
+            break
+        time.sleep(0.5)
+    assert any("mem_total" in (n.get("stats") or {}) for n in nodes)
+
+    status, body = _get(addr, "/api/actors")
+    assert status == 200
+
+    status, body = _get(addr, "/metrics")
+    text = body.decode()
+    assert status == 200
+    assert "ray_tpu_cluster_nodes_alive" in text
+    assert "dash_metric 4" in text or "dash_metric" in text
+
+    status, body = _get(addr, "/api/nope")
+    assert status == 404
+
+
+def test_dashboard_stacks(cluster):
+    from ray_tpu.dashboard import start_dashboard
+
+    @ray_tpu.remote
+    def parked():
+        time.sleep(8)
+        return True
+
+    ref = parked.remote()
+    time.sleep(1.0)  # let it start
+    addr = start_dashboard()
+    status, body = _get(addr, "/api/stacks")
+    assert status == 200
+    dumps = json.loads(body)
+    text = json.dumps(dumps)
+    assert "parked" in text or "time.sleep" in text
+    ray_tpu.get(ref, timeout=30)

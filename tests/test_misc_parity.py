@@ -1,0 +1,120 @@
+"""Misc parity: joblib backend, tqdm_ray, job submission.
+
+Reference test models: python/ray/tests/test_joblib.py,
+test_tqdm_ray.py, dashboard/modules/job/tests/test_job_manager.py.
+"""
+
+import io
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(head_resources={"CPU": 4, "memory": 4 * 2**30})
+    c.connect()
+    yield c
+    c.shutdown()
+
+
+def test_joblib_backend(cluster):
+    import joblib
+
+    from ray_tpu.util.joblib_backend import register_ray
+
+    register_ray()
+    with joblib.parallel_backend("ray_tpu", n_jobs=4):
+        out = joblib.Parallel()(
+            joblib.delayed(pow)(i, 2) for i in range(20)
+        )
+    assert out == [i * i for i in range(20)]
+
+
+def test_joblib_effective_n_jobs(cluster):
+    from ray_tpu.util.joblib_backend import RayTpuBackend
+
+    b = RayTpuBackend()
+    assert b.effective_n_jobs(1) == 1
+    assert b.effective_n_jobs(-1) >= 4  # all cluster CPUs
+    assert b.effective_n_jobs(2) == 2
+
+
+def test_tqdm_ray_render():
+    from ray_tpu.experimental import tqdm_ray
+
+    buf = io.StringIO()
+    bar = tqdm_ray.tqdm(range(3), desc="work")
+    # worker side emits magic lines on stdout; simulate the driver loop
+    emitted = []
+    real = sys.stdout
+    try:
+        sys.stdout = io.StringIO()
+        for _ in bar._iterable:
+            bar.update(1)
+        bar.close()
+        emitted = sys.stdout.getvalue().splitlines()
+    finally:
+        sys.stdout = real
+    rendered = [ln for ln in emitted if tqdm_ray.maybe_render(ln, out=buf)]
+    assert rendered, "no tqdm state lines emitted"
+    assert "work" in buf.getvalue()
+    assert not tqdm_ray.maybe_render("a plain log line", out=buf)
+
+
+def test_tqdm_in_remote_task(cluster, capsys):
+    @ray_tpu.remote
+    def loud():
+        from ray_tpu.experimental.tqdm_ray import tqdm
+
+        for _ in tqdm(range(5), desc="remote-bar"):
+            time.sleep(0.01)
+        return True
+
+    assert ray_tpu.get(loud.remote(), timeout=60)
+    # give the log pubsub a beat to flush through the driver hook
+    time.sleep(1.0)
+
+
+def test_job_submission_roundtrip(cluster):
+    from ray_tpu import job_submission
+
+    client = job_submission.JobSubmissionClient()
+    sid = client.submit_job(
+        entrypoint=(
+            f"{sys.executable} -c \"print('hello from job'); "
+            "import sys; sys.exit(0)\""
+        ),
+    )
+    assert client.wait_until_finish(sid, timeout=60) == \
+        job_submission.SUCCEEDED
+    assert "hello from job" in client.get_job_logs(sid)
+    jobs = client.list_jobs()
+    assert any(j["submission_id"] == sid for j in jobs)
+    assert client.delete_job(sid)
+
+
+def test_job_submission_failure_and_stop(cluster):
+    from ray_tpu import job_submission
+
+    client = job_submission.JobSubmissionClient()
+    bad = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"import sys; sys.exit(3)\"",
+    )
+    assert client.wait_until_finish(bad, timeout=60) == \
+        job_submission.FAILED
+    assert "exit code 3" in client.get_job_info(bad)["message"]
+
+    slow = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"import time; time.sleep(60)\"",
+    )
+    time.sleep(0.5)
+    assert client.stop_job(slow)
+    assert client.wait_until_finish(slow, timeout=30) == \
+        job_submission.STOPPED
+    client.delete_job(bad)
+    client.delete_job(slow)

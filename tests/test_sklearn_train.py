@@ -1,0 +1,54 @@
+"""SklearnTrainer + BatchPredictor (reference train/sklearn/ +
+batch_predictor.py test models)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rdata
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.train import BatchPredictor, Predictor, SklearnTrainer
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(head_resources={"CPU": 4, "memory": 4 * 2**30})
+    c.connect()
+    yield c
+    c.shutdown()
+
+
+def test_sklearn_fit_and_batch_predict(cluster):
+    from sklearn.linear_model import LogisticRegression
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(200, 4))
+    y = (X[:, 0] + X[:, 1] > 0).astype(int)
+
+    result = SklearnTrainer(LogisticRegression(max_iter=200), X=X, y=y).fit()
+    assert result.metrics["score"] > 0.9
+    est = result.checkpoint["estimator"]
+    assert est.predict(X[:5]).shape == (5,)
+
+    # dataset-parallel inference
+    ds = rdata.from_numpy(X, parallelism=4)
+    preds = BatchPredictor(result.checkpoint).predict(ds)
+    flat = np.concatenate(preds.materialize())
+    assert flat.shape == (200,)
+    assert (flat == est.predict(X)).all()
+
+
+def test_sklearn_fit_from_dataset(cluster):
+    from sklearn.tree import DecisionTreeClassifier
+
+    rows = [
+        {"a": float(i % 7), "b": float(i % 3), "label": int(i % 2)}
+        for i in range(60)
+    ]
+    ds = rdata.from_items(rows, parallelism=3)
+    result = SklearnTrainer(
+        DecisionTreeClassifier(), label_column="label",
+        datasets={"train": ds},
+    ).fit()
+    p = Predictor.from_checkpoint(result.checkpoint)
+    assert len(p.predict([[0.0, 0.0]])) == 1

@@ -1,0 +1,72 @@
+"""Ulysses all-to-all sequence parallelism: parity vs dense attention.
+
+Design-new component (SURVEY §5 — the reference has no SP); pinned
+against ops.attention_reference on the virtual CPU mesh like
+tests/test_ring_attention.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.ops.attention import attention_reference
+from ray_tpu.ops.ulysses import ulysses_attention
+from ray_tpu.parallel import MeshConfig, build_mesh, use_mesh
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices"
+)
+
+
+def _qkv(b=2, t=64, h=8, d=16, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    mk = lambda k: jax.random.normal(k, (b, t, h, d), jnp.float32)  # noqa
+    return mk(ks[0]), mk(ks[1]), mk(ks[2])
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_matches_reference(causal):
+    q, k, v = _qkv()
+    ref = attention_reference(q, k, v, causal=causal)
+    mesh = build_mesh(MeshConfig(sp=4, tp=2), jax.devices()[:8])
+    with use_mesh(mesh):
+        out = jax.jit(
+            lambda a, b, c: ulysses_attention(a, b, c, causal=causal)
+        )(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ulysses_grads_match():
+    q, k, v = _qkv(t=32, seed=3)
+
+    def loss_ref(q_, k_, v_):
+        return jnp.sum(attention_reference(q_, k_, v_, causal=True) ** 2)
+
+    def loss_uly(q_, k_, v_):
+        return jnp.sum(ulysses_attention(q_, k_, v_, causal=True) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    mesh = build_mesh(MeshConfig(sp=8), jax.devices()[:8])
+    with use_mesh(mesh):
+        g_uly = jax.jit(jax.grad(loss_uly, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_ref, g_uly):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_ulysses_single_device_fallback():
+    q, k, v = _qkv(t=32)
+    out = ulysses_attention(q, k, v, causal=True)  # no mesh -> plain path
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    q, k, v = _qkv(h=6)
+    mesh = build_mesh(MeshConfig(sp=4, tp=2), jax.devices()[:8])
+    with use_mesh(mesh):
+        with pytest.raises(ValueError, match="not divisible"):
+            jax.jit(lambda a, b, c: ulysses_attention(a, b, c))(q, k, v)
