@@ -1041,6 +1041,23 @@ class ControlPlane:
             store[(name, tuple(map(tuple, tags)))] = (
                 kind, desc, float(value), now
             )
+        # evict reporters silent >10min (dead workers), folding their
+        # monotonic series into a tombstone accumulator so counter totals
+        # survive worker churn without unbounded per-reporter growth
+        for rep in [
+            r for r, series in self.metrics.items()
+            if r != b"\0tomb" and series
+            and now - max(v[3] for v in series.values()) > 600.0
+        ]:
+            tomb = self.metrics.setdefault(b"\0tomb", {})
+            for key, (kind, desc, value, ts) in self.metrics.pop(
+                rep
+            ).items():
+                if kind == "gauge":
+                    continue  # point-in-time; dies with its reporter
+                old = tomb.get(key)
+                value += old[2] if old else 0.0
+                tomb[key] = (kind, desc, value, ts)
         return True
 
     async def rpc_get_metrics(self, conn, p):

@@ -72,6 +72,20 @@ def _to_prometheus(rows: list[dict], cluster: dict) -> str:
         lines.append(
             f"{metric}{{{label}}} {value}" if label else f"{metric} {value}"
         )
+        if metric.endswith("_bucket") and any(
+            k == "le" and v == "+Inf" for k, v in clean_tags
+        ):
+            # the +Inf bucket IS the count; exposition requires an
+            # explicit name_count series for rate(_sum)/rate(_count)
+            base_label = ",".join(
+                f'{k}="{_prom_escape(str(v))}"'
+                for k, v in clean_tags if k != "le"
+            )
+            cnt = f"{name}_count"
+            lines.append(
+                f"{cnt}{{{base_label}}} {value}" if base_label
+                else f"{cnt} {value}"
+            )
     return "\n".join(lines) + "\n"
 
 

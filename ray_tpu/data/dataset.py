@@ -33,11 +33,14 @@ def _default_resources() -> dict:
 
 
 @ray_tpu.remote(num_cpus=1)
-def _map_block(fn_blob, block):
+def _map_block_fused(fn_blobs, block):
+    """One task applying a whole fused stage chain to one block
+    (reference _internal/plan.py:67 can_fuse -> fused MapOperator)."""
     from ray_tpu._private import serialization
 
-    fn = serialization.unpack_payload(fn_blob)
-    return fn(block)
+    for blob in fn_blobs:
+        block = serialization.unpack_payload(blob)(block)
+    return block
 
 
 @ray_tpu.remote(num_cpus=1, num_returns="dynamic")
@@ -47,15 +50,48 @@ def _read_range(start: int, stop: int, block_size: int):
 
 
 class Dataset:
-    """An ordered collection of block refs (reference dataset.py:176)."""
+    """An ordered collection of block refs (reference dataset.py:176).
 
-    def __init__(self, block_refs: list):
-        self._blocks = list(block_refs)
+    map_batches/filter are LAZY: chained maps accumulate as a pending
+    stage list and execute as ONE fused task per block when any consuming
+    op touches `_blocks` (the reference's logical-plan stage fusion,
+    plan.py:82 + can_fuse:67 — here fusion is the representation, so
+    chained maps can never miss it)."""
+
+    def __init__(self, block_refs: list, *, _base=None, _pending=None,
+                 _inflight=DEFAULT_INFLIGHT):
+        if _pending:
+            self._base = list(_base)
+            self._pending = list(_pending)
+            self._cached: list | None = None
+        else:
+            self._base = list(block_refs)
+            self._pending = []
+            self._cached = self._base
+        self._inflight = _inflight
+
+    @property
+    def _blocks(self) -> list:
+        """Materialized block refs; executes pending fused stages once."""
+        if self._cached is None:
+            out: list = []
+            in_flight: list = []
+            blobs = list(self._pending)
+            for block_ref in self._base:
+                if len(in_flight) >= self._inflight:
+                    _, in_flight = ray_tpu.wait(
+                        in_flight, num_returns=1, timeout=300
+                    )
+                ref = _map_block_fused.remote(blobs, block_ref)
+                in_flight.append(ref)
+                out.append(ref)
+            self._cached = out
+        return self._cached
 
     # -- metadata --
 
     def num_blocks(self) -> int:
-        return len(self._blocks)
+        return len(self._base)
 
     def count(self) -> int:
         return sum(
@@ -70,25 +106,24 @@ class Dataset:
 
     def map_batches(self, fn: Callable[[Any], Any], *,
                     max_in_flight: int = DEFAULT_INFLIGHT) -> "Dataset":
-        """Apply fn to every block via remote tasks.
+        """Apply fn to every block via remote tasks — lazily.
 
-        Pipelined: at most max_in_flight map tasks are outstanding; output
-        block refs are collected in order. (TaskPoolMapOperator analog; the
-        window is the backpressure budget of streaming_executor.py:210.)"""
+        Chained map_batches/filter calls fuse into one task per block at
+        execution time (TaskPoolMapOperator + stage fusion analog); the
+        in-flight window is the backpressure budget of
+        streaming_executor.py:210."""
         from ray_tpu._private import serialization
 
         fn_blob = serialization.pack_callable(fn)
-        out: list = []
-        in_flight: list = []
-        for block_ref in self._blocks:
-            if len(in_flight) >= max_in_flight:
-                _, in_flight = ray_tpu.wait(
-                    in_flight, num_returns=1, timeout=300
-                )
-            ref = _map_block.remote(fn_blob, block_ref)
-            in_flight.append(ref)
-            out.append(ref)
-        return Dataset(out)
+        if self._cached is not None:
+            # chain from materialized blocks — never re-run earlier stages
+            # (they may be side-effecting or nondeterministic)
+            base, pending = self._cached, [fn_blob]
+        else:
+            base, pending = self._base, self._pending + [fn_blob]
+        return Dataset(
+            [], _base=base, _pending=pending, _inflight=max_in_flight
+        )
 
     def filter(self, pred: Callable[[Any], bool], **kw) -> "Dataset":
         from ray_tpu._private import serialization

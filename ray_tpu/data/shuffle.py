@@ -121,13 +121,15 @@ def _exchange(blocks: list, mode: str, specs, num_parts: int) -> list[list]:
         # partitioning into one part is the identity: feed every block
         # straight to the single reducer
         return [list(blocks)]
-    if not isinstance(specs, list):
-        specs = [specs] * len(blocks)
+    if isinstance(specs, list):
+        blobs = [serialization.pack_payload(s) for s in specs]
+    else:  # shared spec: pack exactly once
+        blobs = [serialization.pack_payload(specs)] * len(blocks)
     part_refs = [
         _partition_block.options(num_returns=num_parts).remote(
-            b, mode, serialization.pack_payload(spec)
+            b, mode, blob
         )
-        for b, spec in zip(blocks, specs)
+        for b, blob in zip(blocks, blobs)
     ]
     # transpose: partition i gathers piece i of every block
     return [[refs[i] for refs in part_refs] for i in range(num_parts)]

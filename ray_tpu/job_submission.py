@@ -143,9 +143,12 @@ class JobSubmissionClient:
         import time
 
         deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        while True:
             st = self.get_job_status(sid)
             if st in (SUCCEEDED, FAILED, STOPPED):
                 return st
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"job {sid} still {st} after {timeout}s"
+                )
             time.sleep(0.5)
-        raise TimeoutError(f"job {sid} still {st} after {timeout}s")

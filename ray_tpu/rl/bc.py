@@ -1,0 +1,92 @@
+"""Offline RL: behavior cloning from a Dataset of transitions.
+
+Reference: rllib/offline/ + algorithms/bc/bc.py — learn a policy by
+supervised imitation of logged (obs, action) pairs, no environment
+interaction. Data arrives as a ray_tpu.data Dataset (rows
+{"obs": [...], "action": int}), streaming-split across epochs; the
+cross-entropy update is one jitted function.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rl import models
+
+
+@dataclass
+class BCConfig:
+    obs_dim: int = 4
+    n_actions: int = 2
+    lr: float = 1e-3
+    epochs: int = 5
+    batch_size: int = 128
+    seed: int = 0
+
+    def build(self) -> "BC":
+        return BC(self)
+
+
+class BC:
+    def __init__(self, config: BCConfig):
+        self.config = config
+        self.params = models.init_policy(
+            jax.random.PRNGKey(config.seed), config.obs_dim,
+            config.n_actions,
+        )
+        self.opt = optax.adam(config.lr)
+        self.opt_state = self.opt.init(self.params)
+        self._update = jax.jit(self._update_fn)
+        self.iteration = 0
+
+    def _update_fn(self, params, opt_state, obs, actions):
+        def loss_fn(p):
+            logits = models.forward(p, obs)[0]
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(logp, actions[:, None], axis=1)
+            acc = jnp.mean(
+                (jnp.argmax(logits, axis=1) == actions).astype(jnp.float32)
+            )
+            return jnp.mean(nll), acc
+
+        (loss, acc), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params)
+        updates, opt_state = self.opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss, acc
+
+    def train_on_dataset(self, dataset) -> dict:
+        """Run `epochs` passes of minibatch SGD over the Dataset."""
+        c = self.config
+        loss = acc = 0.0
+        for _ in range(c.epochs):
+            shuffled = dataset.random_shuffle(seed=c.seed + self.iteration)
+            for block in shuffled.iter_batches():
+                rows = block if isinstance(block, list) else list(block)
+                obs = jnp.asarray(
+                    np.asarray([r["obs"] for r in rows], np.float32)
+                )
+                actions = jnp.asarray(
+                    np.asarray([r["action"] for r in rows], np.int32)
+                )
+                for lo in range(0, len(rows), c.batch_size):
+                    sl = slice(lo, lo + c.batch_size)
+                    self.params, self.opt_state, loss, acc = self._update(
+                        self.params, self.opt_state, obs[sl], actions[sl]
+                    )
+            self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "loss": float(loss),
+            "train_accuracy": float(acc),
+        }
+
+    def compute_actions(self, obs) -> np.ndarray:
+        logits = models.forward(self.params, jnp.asarray(obs))[0]
+        return np.asarray(jnp.argmax(logits, axis=1))

@@ -1,0 +1,200 @@
+"""DQN: off-policy Q-learning over EnvRunner actors + replay.
+
+Reference: rllib/algorithms/dqn/ (dqn.py training_step, the replay +
+target-network pattern). TPU-native shape: the double-DQN TD update is
+one jitted function (target = r + γ·(1-d)·Q_tgt(s', argmax_a Q(s',a)),
+Huber loss); sampling actors run ε-greedy on host CPU.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+from ray_tpu._private import serialization
+from ray_tpu.rl import models
+from ray_tpu.rl.env_runner import _episode_return_mean
+from ray_tpu.rl.replay import ReplayBuffer
+
+
+@ray_tpu.remote(num_cpus=1)
+class QEnvRunner:
+    """ε-greedy sampling actor (rollout_worker.py analog for DQN)."""
+
+    def __init__(self, env_creator_blob, seed: int = 0):
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", "cpu")
+        env_creator = serialization.unpack_payload(env_creator_blob)
+        self.env = env_creator()
+        self.rng = np.random.RandomState(seed)
+        self._obs = np.asarray(self.env.reset(), np.float32)
+        self._q = _jax.jit(lambda p, o: models.forward(p, o)[0])
+
+    def set_weights(self, params):
+        self.params = params
+
+    def sample(self, n_steps: int, epsilon: float) -> dict:
+        obs_l, act_l, rew_l, done_l, next_l = [], [], [], [], []
+        obs = self._obs
+        for _ in range(n_steps):
+            q = np.asarray(self._q(self.params, obs[None]))[0]
+            a = (int(self.rng.randint(len(q)))
+                 if self.rng.rand() < epsilon else int(np.argmax(q)))
+            nxt, r, done, _ = self.env.step(a)
+            nxt = np.asarray(nxt, np.float32)
+            obs_l.append(obs)
+            act_l.append(a)
+            rew_l.append(float(r))
+            done_l.append(bool(done))
+            next_l.append(nxt)
+            obs = (np.asarray(self.env.reset(), np.float32) if done
+                   else nxt)
+        self._obs = obs
+        return {
+            "obs": np.stack(obs_l),
+            "actions": np.asarray(act_l, np.int32),
+            "rewards": np.asarray(rew_l, np.float32),
+            "dones": np.asarray(done_l, np.bool_),
+            "next_obs": np.stack(next_l),
+            "episode_return_mean": _episode_return_mean(rew_l, done_l),
+        }
+
+
+@dataclass
+class DQNConfig:
+    env_creator: Callable | None = None
+    obs_dim: int = 4
+    n_actions: int = 2
+    num_env_runners: int = 2
+    rollout_steps: int = 64           # per runner per iteration
+    buffer_capacity: int = 50_000
+    learning_starts: int = 256
+    train_batch_size: int = 64
+    grad_steps_per_iteration: int = 32
+    lr: float = 5e-4
+    gamma: float = 0.99
+    target_update_period: int = 4     # iterations between hard syncs
+    epsilon_start: float = 1.0
+    epsilon_end: float = 0.05
+    epsilon_decay_iterations: int = 30
+    seed: int = 0
+
+    def build(self) -> "DQN":
+        return DQN(self)
+
+
+class DQN:
+    def __init__(self, config: DQNConfig):
+        assert config.env_creator is not None, "set DQNConfig.env_creator"
+        self.config = config
+        self.params = models.init_policy(
+            jax.random.PRNGKey(config.seed), config.obs_dim,
+            config.n_actions,
+        )
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.opt = optax.adam(config.lr)
+        self.opt_state = self.opt.init(self.params)
+        self.buffer = ReplayBuffer(
+            config.buffer_capacity, config.obs_dim, seed=config.seed
+        )
+        blob = serialization.pack_callable(config.env_creator)
+        self.runners = [
+            QEnvRunner.remote(blob, seed=config.seed + i)
+            for i in range(config.num_env_runners)
+        ]
+        self.iteration = 0
+        self._update = jax.jit(self._update_fn)
+        self._sync_runner_weights()
+
+    def _sync_runner_weights(self):
+        w = jax.device_get(self.params)
+        ray_tpu.get(
+            [r.set_weights.remote(w) for r in self.runners], timeout=120
+        )
+
+    def _epsilon(self) -> float:
+        c = self.config
+        frac = min(1.0, self.iteration / max(1, c.epsilon_decay_iterations))
+        return c.epsilon_start + frac * (c.epsilon_end - c.epsilon_start)
+
+    def _update_fn(self, params, target_params, opt_state, batch):
+        c = self.config
+
+        def loss_fn(p):
+            q = models.forward(p, batch["obs"])[0]
+            q_sa = jnp.take_along_axis(
+                q, batch["actions"][:, None], axis=1
+            )[:, 0]
+            # double DQN: online net picks a', target net evaluates it
+            next_online = models.forward(p, batch["next_obs"])[0]
+            a_prime = jnp.argmax(next_online, axis=1)
+            next_target = models.forward(target_params,
+                                         batch["next_obs"])[0]
+            q_next = jnp.take_along_axis(
+                next_target, a_prime[:, None], axis=1
+            )[:, 0]
+            target = batch["rewards"] + c.gamma * (
+                1.0 - batch["dones"].astype(jnp.float32)
+            ) * jax.lax.stop_gradient(q_next)
+            td = q_sa - target
+            return jnp.mean(optax.huber_loss(td)), jnp.mean(jnp.abs(td))
+
+        (loss, td_abs), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params)
+        updates, opt_state = self.opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss, td_abs
+
+    def train(self) -> dict:
+        c = self.config
+        eps = self._epsilon()
+        batches = ray_tpu.get(
+            [r.sample.remote(c.rollout_steps, eps) for r in self.runners],
+            timeout=600,
+        )
+        for b in batches:
+            self.buffer.add_batch(
+                b["obs"], b["actions"], b["rewards"], b["dones"],
+                b["next_obs"],
+            )
+        loss = td = 0.0
+        if len(self.buffer) >= c.learning_starts:
+            for _ in range(c.grad_steps_per_iteration):
+                mb = {
+                    k: jnp.asarray(v)
+                    for k, v in self.buffer.sample(
+                        c.train_batch_size
+                    ).items()
+                }
+                self.params, self.opt_state, loss, td = self._update(
+                    self.params, self.target_params, self.opt_state, mb
+                )
+        self.iteration += 1
+        if self.iteration % c.target_update_period == 0:
+            self.target_params = jax.tree.map(jnp.copy, self.params)
+        self._sync_runner_weights()
+        return {
+            "training_iteration": self.iteration,
+            "epsilon": eps,
+            "buffer_size": len(self.buffer),
+            "loss": float(loss),
+            "td_error_mean": float(td),
+            "episode_return_mean": float(np.mean(
+                [b["episode_return_mean"] for b in batches]
+            )),
+        }
+
+    def stop(self):
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:  # noqa: BLE001
+                pass

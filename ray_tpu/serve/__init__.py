@@ -33,6 +33,11 @@ from ray_tpu.serve.api import (  # noqa: F401
     start_http_proxy,
 )
 from ray_tpu.serve.batching import batch  # noqa: F401
+from ray_tpu.serve.graph import (  # noqa: F401
+    GraphHandle,
+    InputNode,
+    run_graph,
+)
 from ray_tpu.serve.multiplex import (  # noqa: F401
     get_multiplexed_model_id,
     multiplexed,

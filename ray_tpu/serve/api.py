@@ -37,11 +37,24 @@ class _ReplicaActor:
         self._user = cls(*init_args, **init_kwargs)
 
     def handle_request(self, method: str, args, kwargs, model_id: str = ""):
+        import ray_tpu as rt
         from ray_tpu.serve.multiplex import _set_model_id
 
         # set unconditionally: pooled executor threads would otherwise leak
         # a previous request's model id into non-multiplexed requests
         _set_model_id(model_id)
+        # deployment-graph edges arrive as ObjectRefs nested in the args
+        # list (the runtime only auto-resolves top-level task args) —
+        # resolve them here so composed deployments pipeline replica to
+        # replica without a driver hop
+        args = [
+            rt.get(a, timeout=300) if isinstance(a, rt.ObjectRef) else a
+            for a in args
+        ]
+        kwargs = {
+            k: rt.get(v, timeout=300) if isinstance(v, rt.ObjectRef) else v
+            for k, v in kwargs.items()
+        }
         fn = (self._user if method == "__call__"
               else getattr(self._user, method))
         return fn(*args, **kwargs)
@@ -204,57 +217,60 @@ class _Controller:
                 logger.exception("autoscale tick failed")
 
     def _autoscale_once(self):
+        with self._lock:
+            for name, d in list(self.deployments.items()):
+                try:
+                    self._autoscale_deployment(name, d)
+                except Exception:  # noqa: BLE001 — one bad deployment
+                    logger.exception("autoscale failed for %s", name)
+
+    def _autoscale_deployment(self, name: str, d: dict):
         import math
         import time as t
 
         import ray_tpu as rt
 
-        with self._lock:
-            for name, d in list(self.deployments.items()):
-                cfg = d.get("autoscaling")
-                if not cfg:
-                    continue
-                now = t.time()
-                reports = self._metrics.get(name, {})
-                total = sum(
-                    n for (ts, n) in reports.values() if now - ts < 5.0
+        cfg = d.get("autoscaling")
+        if not cfg:
+            return
+        now = t.time()
+        reports = self._metrics.get(name, {})
+        total = sum(n for (ts, n) in reports.values() if now - ts < 5.0)
+        target = cfg.get("target_num_ongoing_requests_per_replica", 2)
+        desired = math.ceil(total / max(target, 1e-9))
+        desired = max(cfg.get("min_replicas", 1),
+                      min(cfg.get("max_replicas", 8), desired))
+        cur = len(d["replicas"])
+        if desired > cur:
+            new = [
+                self._start_replica(
+                    d["cls_blob"], d["init_args"], d["init_kwargs"],
+                    d["resources"], d["max_concurrent_queries"],
                 )
-                target = cfg.get("target_num_ongoing_requests_per_replica",
-                                 2)
-                desired = math.ceil(total / max(target, 1e-9))
-                desired = max(cfg.get("min_replicas", 1),
-                              min(cfg.get("max_replicas", 8), desired))
-                cur = len(d["replicas"])
-                if desired > cur:
-                    new = [
-                        self._start_replica(
-                            d["cls_blob"], d["init_args"], d["init_kwargs"],
-                            d["resources"], d["max_concurrent_queries"],
-                        )
-                        for _ in range(desired - cur)
-                    ]
+                for _ in range(desired - cur)
+            ]
+            try:
+                rt.get([r.health.remote() for r in new], timeout=60)
+            except Exception:  # noqa: BLE001
+                # failed/slow constructors: reap, retry next tick
+                # (never leak unregistered actors)
+                for r in new:
                     try:
-                        rt.get([r.health.remote() for r in new], timeout=60)
+                        rt.kill(r)
                     except Exception:  # noqa: BLE001
-                        # failed/slow constructors: reap, retry next tick
-                        # (never leak unregistered actors)
-                        for r in new:
-                            try:
-                                rt.kill(r)
-                            except Exception:  # noqa: BLE001
-                                pass
-                        raise
-                    d["replicas"].extend(new)
-                    self._publish(name)
-                elif desired < cur:
-                    victims = d["replicas"][desired:]
-                    d["replicas"] = d["replicas"][:desired]
-                    self._publish(name)
-                    for r in victims:
-                        try:
-                            rt.kill(r)
-                        except Exception:  # noqa: BLE001
-                            pass
+                        pass
+                raise
+            d["replicas"].extend(new)
+            self._publish(name)
+        elif desired < cur:
+            victims = d["replicas"][desired:]
+            d["replicas"] = d["replicas"][:desired]
+            self._publish(name)
+            for r in victims:
+                try:
+                    rt.kill(r)
+                except Exception:  # noqa: BLE001
+                    pass
 
 
 # ---------------- driver-side API ----------------
@@ -339,6 +355,13 @@ class Deployment:
         }
         merged.update(kw)
         return Deployment(self._cls, **merged)
+
+    def bind(self, *args, **kwargs):
+        """Node in a deployment graph (serve/graph.py; reference
+        deployment_graph.py)."""
+        from ray_tpu.serve.graph import DeploymentNode
+
+        return DeploymentNode(self, args, kwargs)
 
 
 def deployment(_cls=None, **kw):

@@ -1,0 +1,113 @@
+"""Deployment graphs: DAG composition of deployments.
+
+Reference: serve/deployment_graph.py + _private/deployment_graph_build.py
+(+ python/ray/dag/dag_node.py:23) — `Deployment.bind(init_args)` makes a
+node, method `.bind(...)` calls compose a DAG, `serve.run_graph(root)`
+deploys every bound deployment and returns a handle whose `remote()`
+executes the graph per request. Edges travel as ObjectRefs between
+replica actors (top-level ref args resolve executor-side), so a chain
+A -> B never routes intermediate data through the driver.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ray_tpu.dag.dag_node import InputNode  # noqa: F401 — re-export
+
+
+class DeploymentNode:
+    """A Deployment bound with constructor args (one deployed instance)."""
+
+    def __init__(self, deployment, args: tuple, kwargs: dict):
+        self._deployment = deployment
+        self._init_args = args
+        self._init_kwargs = kwargs
+        self._handle = None  # filled by build()
+
+    @property
+    def name(self) -> str:
+        return self._deployment.name
+
+    def __getattr__(self, method: str):
+        if method.startswith("_"):
+            raise AttributeError(method)
+        return _MethodBinder(self, method)
+
+    # calling the node itself composes __call__
+    def bind(self, *args, **kwargs) -> "GraphCallNode":
+        return GraphCallNode(self, "__call__", args, kwargs)
+
+
+class _MethodBinder:
+    def __init__(self, node: DeploymentNode, method: str):
+        self._node = node
+        self._method = method
+
+    def bind(self, *args, **kwargs) -> "GraphCallNode":
+        return GraphCallNode(self._node, self._method, args, kwargs)
+
+
+class GraphCallNode:
+    """One deferred replica method call; DAG edges are other call nodes
+    (or InputNode placeholders)."""
+
+    def __init__(self, node: DeploymentNode, method: str, args, kwargs):
+        self._node = node
+        self._method = method
+        self._args = args
+        self._kwargs = kwargs
+
+    def _walk_deployments(self, seen: dict):
+        seen.setdefault(id(self._node), self._node)
+        for v in list(self._args) + list(self._kwargs.values()):
+            if isinstance(v, GraphCallNode):
+                v._walk_deployments(seen)
+
+    def _execute(self, cache: dict, input_args: tuple):
+        if id(self) in cache:
+            return cache[id(self)]
+
+        def resolve(v):
+            if isinstance(v, (GraphCallNode, InputNode)):
+                return v._execute(cache, input_args)
+            return v
+
+        args = tuple(resolve(a) for a in self._args)
+        kwargs = {k: resolve(v) for k, v in self._kwargs.items()}
+        handle = self._node._handle
+        if handle is None:
+            raise RuntimeError(
+                f"deployment '{self._node.name}' not built; call "
+                "serve.run_graph(root) first"
+            )
+        ref = handle.method(self._method).remote(*args, **kwargs)
+        cache[id(self)] = ref
+        return ref
+
+
+class GraphHandle:
+    """Executes the built graph per request; returns the root's ref."""
+
+    def __init__(self, root: GraphCallNode):
+        self._root = root
+
+    def remote(self, *input_args) -> Any:
+        return self._root._execute({}, input_args)
+
+
+def run_graph(root: GraphCallNode) -> GraphHandle:
+    """Deploy every deployment bound into the graph, then hand back a
+    GraphHandle (reference deployment_graph_build.py build)."""
+    from ray_tpu.serve import api as serve_api
+
+    serve_api.start()
+    seen: dict[int, DeploymentNode] = {}
+    root._walk_deployments(seen)
+    for node in seen.values():
+        node._handle = serve_api.run(
+            node._deployment, name=node.name,
+            init_args=node._init_args,
+            init_kwargs=node._init_kwargs,
+        )
+    return GraphHandle(root)

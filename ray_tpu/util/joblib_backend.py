@@ -32,7 +32,11 @@ class RayTpuBackend(MultiprocessingBackend):
         except Exception:  # noqa: BLE001 — not connected yet
             total = 0
         cpus = int(total) or 8
-        return cpus if n_jobs in (-1, None) else min(n_jobs, cpus)
+        if n_jobs is None:
+            return cpus
+        if n_jobs < 0:  # joblib idiom: -1 = all, -2 = all but one, ...
+            return max(1, cpus + 1 + n_jobs)
+        return min(n_jobs, cpus)
 
     def configure(self, n_jobs=1, parallel=None, prefer=None, require=None,
                   **kwargs):

@@ -98,6 +98,7 @@ class Pool:
         self._pool_id = f"{os.getpid()}-{next(_pool_counter)}"
         self._closed = False
         self._cb_queue = None  # lazy; one drainer thread per pool
+        self._cb_lock = threading.Lock()
 
     def _check_open(self):
         if self._closed:
@@ -207,8 +208,11 @@ class Pool:
     def _enqueue_callback(self, ref, callback, error_callback):
         import queue as _q
 
-        if self._cb_queue is None:
-            self._cb_queue = _q.Queue()
+        with self._cb_lock:
+            start_drainer = self._cb_queue is None
+            if start_drainer:
+                self._cb_queue = _q.Queue()
+        if start_drainer:
 
             def drain():
                 pending: list = []

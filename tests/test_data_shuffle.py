@@ -5,6 +5,8 @@ test_groupby.py, test_csv/parquet readers — semantics pinned against
 in-memory oracles on the multinode fixture.
 """
 
+import time
+
 import numpy as np
 import pandas as pd
 import pytest
@@ -109,6 +111,46 @@ def test_aggregates(cluster):
     assert ds.min() == 1
     assert ds.max() == 100
     assert ds.mean() == 50.5
+
+
+def _fused_task_count():
+    return sum(
+        1 for t in ray_tpu.list_tasks()
+        if t.get("name") == "_map_block_fused"
+    )
+
+
+def test_map_chain_fuses_into_one_task_per_block(cluster):
+    ds = rdata.from_items(list(range(40)), parallelism=4)
+    before = _fused_task_count()
+    out = (
+        ds.map_batches(lambda b: [x + 1 for x in b])
+          .map_batches(lambda b: [x * 2 for x in b])
+          .map_batches(lambda b: [x - 1 for x in b])
+    )
+    # lazy: nothing ran yet
+    assert _fused_task_count() == before
+    assert sorted(out.iter_rows()) == sorted((x + 1) * 2 - 1
+                                             for x in range(40))
+    deadline = time.time() + 10
+    while time.time() < deadline:  # task events are async
+        ran = _fused_task_count() - before
+        if ran >= 4:
+            break
+        time.sleep(0.2)
+    # 3 chained stages x 4 blocks fused to 4 tasks, not 12
+    assert ran == 4, f"expected 4 fused tasks, saw {ran}"
+
+
+def test_lazy_dataset_reuse_executes_once(cluster):
+    ds = rdata.from_items(list(range(12)), parallelism=2)
+    mapped = ds.map_batches(lambda b: [x * 10 for x in b])
+    assert mapped.count() == 12
+    time.sleep(0.5)  # drain async task events
+    before = _fused_task_count()
+    assert sorted(mapped.iter_rows())[-1] == 110  # cached, no new tasks
+    time.sleep(0.5)
+    assert _fused_task_count() == before
 
 
 def test_union_limit(cluster):

@@ -81,5 +81,17 @@ def test_head_restart_objects_reannounced(cluster):
         except Exception:
             pass
         time.sleep(0.2)
+    # under full-suite load the agent's re-announce can trail the node
+    # registration by several heartbeats; wait for the directory entry
+    # itself before fetching (that's the property being tested)
+    deadline = time.time() + 90
+    while time.time() < deadline:
+        try:
+            if any(o["object_id"] == ref.binary()
+                   for o in ray_tpu.list_objects()):
+                break
+        except Exception:
+            pass
+        time.sleep(0.3)
     out = ray_tpu.get(ref, timeout=90)
     assert out[-1] == 299_999

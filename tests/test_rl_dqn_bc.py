@@ -1,0 +1,106 @@
+"""DQN + offline BC tests.
+
+Reference analogs: rllib/algorithms/dqn/tests, rllib/algorithms/bc/tests
+(scaled): DQN must learn the corridor env (return improves over
+iterations); BC must clone a scripted expert from a Dataset.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rdata
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.rl import BC, BCConfig, DQN, DQNConfig, ReplayBuffer
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(head_resources={"CPU": 8, "memory": 4 * 2**30})
+    c.connect()
+    yield c
+    c.shutdown()
+
+
+class Corridor:
+    """5-step corridor; action 1 moves right (+1 at the goal)."""
+
+    N = 5
+
+    def __init__(self):
+        self.pos = 0
+
+    def reset(self):
+        self.pos = 0
+        return self._obs()
+
+    def _obs(self):
+        return np.array([self.pos / self.N, 1.0], np.float32)
+
+    def step(self, action):
+        self.pos += 1 if action == 1 else -1
+        self.pos = max(0, self.pos)
+        done = self.pos >= self.N
+        reward = 1.0 if done else -0.05
+        return self._obs(), reward, done, {}
+
+
+def test_replay_buffer_ring():
+    buf = ReplayBuffer(capacity=8, obs_dim=2, seed=0)
+    obs = np.arange(20, dtype=np.float32).reshape(10, 2)
+    buf.add_batch(obs, np.arange(10, dtype=np.int32),
+                  np.ones(10, np.float32), np.zeros(10, np.bool_), obs)
+    assert len(buf) == 8
+    s = buf.sample(16)
+    assert s["obs"].shape == (16, 2)
+    # oldest two entries were overwritten by the wrap
+    assert set(np.unique(s["actions"])) <= set(range(2, 10))
+
+
+def test_dqn_learns_corridor(cluster):
+    algo = DQNConfig(
+        env_creator=Corridor,
+        obs_dim=2,
+        n_actions=2,
+        num_env_runners=2,
+        rollout_steps=64,
+        learning_starts=128,
+        grad_steps_per_iteration=64,
+        epsilon_decay_iterations=8,
+        target_update_period=2,
+        lr=2e-3,
+        seed=3,
+    ).build()
+    try:
+        first = algo.train()
+        last = None
+        for _ in range(14):
+            last = algo.train()
+        # optimal return = 1 - 4*0.05 = 0.8; random ~ negative
+        assert last["episode_return_mean"] > max(
+            0.3, first["episode_return_mean"]
+        ), f"no learning: {first} -> {last}"
+        assert last["buffer_size"] > 128
+    finally:
+        algo.stop()
+
+
+def test_bc_clones_expert(cluster):
+    # expert: always action 1 when pos < N (i.e. always, in this env)
+    rng = np.random.default_rng(0)
+    rows = []
+    for _ in range(800):
+        pos = rng.integers(0, 5)
+        obs = [pos / 5, 1.0]
+        rows.append({"obs": obs, "action": 1})
+    # sprinkle contrast: a second fake state type mapping to action 0
+    for _ in range(800):
+        rows.append({"obs": [rng.uniform(5, 9), 0.0], "action": 0})
+    ds = rdata.from_items(rows, parallelism=4)
+    algo = BCConfig(obs_dim=2, n_actions=2, epochs=3, lr=5e-3).build()
+    metrics = algo.train_on_dataset(ds)
+    assert metrics["train_accuracy"] > 0.95
+    acts = algo.compute_actions(
+        np.array([[0.2, 1.0], [7.0, 0.0]], np.float32)
+    )
+    assert list(acts) == [1, 0]

@@ -1,0 +1,84 @@
+"""Deployment graphs (reference serve/tests/test_deployment_graph*.py,
+scaled): diamond composition, replica-to-replica ref flow, shared nodes.
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(head_resources={"CPU": 8, "memory": 4 * 2**30})
+    c.connect()
+    serve.start()
+    yield c
+    serve.shutdown()
+    c.shutdown()
+
+
+def test_chain_graph(cluster):
+    @serve.deployment
+    class Tokenize:
+        def __call__(self, text):
+            return text.split()
+
+    @serve.deployment
+    class Count:
+        def __call__(self, tokens):
+            return len(tokens)
+
+    inp = serve.InputNode()
+    tok = Tokenize.bind()
+    cnt = Count.bind()
+    graph = cnt.bind(tok.bind(inp))
+    h = serve.run_graph(graph)
+    assert ray_tpu.get(h.remote("a b c d"), timeout=60) == 4
+
+
+def test_diamond_graph_with_methods(cluster):
+    @serve.deployment(name="preproc")
+    class Pre:
+        def split(self, s):
+            return [int(x) for x in s.split(",")]
+
+    @serve.deployment(name="left")
+    class Left:
+        def __call__(self, xs):
+            return sum(xs)
+
+    @serve.deployment(name="right")
+    class Right:
+        def __call__(self, xs):
+            return max(xs)
+
+    @serve.deployment(name="combine")
+    class Combine:
+        def __init__(self, scale=1):
+            self.scale = scale
+
+        def merge(self, a, b):
+            return self.scale * (a + b)
+
+    inp = serve.InputNode()
+    pre = Pre.bind()
+    xs = pre.split.bind(inp)  # shared node feeding both branches
+    out = Combine.bind(10).merge.bind(
+        Left.bind().bind(xs), Right.bind().bind(xs)
+    )
+    h = serve.run_graph(out)
+    # sum=6, max=3 -> 10*(6+3) = 90
+    assert ray_tpu.get(h.remote("1,2,3"), timeout=60) == 90
+
+
+def test_unbuilt_graph_raises(cluster):
+    @serve.deployment(name="orphan")
+    class Orphan:
+        def __call__(self, x):
+            return x
+
+    node = Orphan.bind().bind(serve.InputNode())
+    with pytest.raises(RuntimeError, match="not built"):
+        node._execute({}, ("x",))
