@@ -36,6 +36,7 @@ _RUNTIME_API = (
     "list_tasks",
     "list_objects",
     "list_actors",
+    "list_jobs",
     "placement_group",
     "remove_placement_group",
     "PlacementGroup",

@@ -88,6 +88,43 @@ class ObjectRef:
     def __repr__(self):
         return f"ObjectRef({self._id.hex()[:16]}…)"
 
+    def as_future(self):
+        """asyncio.Future resolving to the object (reference
+        ObjectRef.as_future / `await ref` in _raylet.pyx). Resolution
+        happens on a thread so the event loop (e.g. an async actor's)
+        never blocks on the fetch."""
+        import asyncio
+        import threading
+
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+
+        def work():
+            # NOTE: never close over the `except ... as e` target —
+            # CPython deletes it when the block exits, racing the loop
+            # callback (NameError, future never resolves)
+            err = val = None
+            try:
+                val = get(self)
+            except BaseException as e:  # noqa: BLE001
+                err = e
+
+            def resolve():
+                if fut.cancelled():
+                    return
+                if err is not None:
+                    fut.set_exception(err)
+                else:
+                    fut.set_result(val)
+
+            loop.call_soon_threadsafe(resolve)
+
+        threading.Thread(target=work, daemon=True).start()
+        return fut
+
+    def __await__(self):
+        return self.as_future().__await__()
+
     def __reduce__(self):
         serialization.note_object_ref(_RefProxy(self._id))
         return (ObjectRef, (self._id,))
@@ -695,6 +732,11 @@ def list_actors() -> list[dict]:
     return w.head.call("list_actors", {})
 
 
+def list_jobs() -> list[dict]:
+    w = _get_worker()
+    return w.head.call("list_jobs", {})
+
+
 def timeline(filename: str | None = None) -> list:
     """Chrome-trace events from the task-event store (reference
     _private/profiling.py:123 chrome_tracing_dump). Load the result in
@@ -726,6 +768,6 @@ __all__ = [
     "wait", "kill", "cancel", "get_actor", "free", "ObjectRef",
     "ActorHandle", "PlacementGroup", "placement_group",
     "remove_placement_group", "cluster_resources", "available_resources",
-    "nodes", "timeline", "list_tasks", "list_objects", "list_actors",
+    "nodes", "timeline", "list_tasks", "list_objects", "list_actors", "list_jobs",
     "RayTaskError", "RayActorError", "GetTimeoutError", "ObjectLostError",
 ]

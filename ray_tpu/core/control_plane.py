@@ -173,6 +173,9 @@ class ControlPlane:
         self.task_events: collections.deque = collections.deque(maxlen=50_000)
         # per-reporter metric series (rpc_record_metrics)
         self.metrics: dict[bytes, dict] = {}
+        self._metrics_last_seen: dict[bytes, float] = {}
+        self._metrics_folded: dict[bytes, dict] = {}  # tombstone undo info
+        self._metrics_last_sweep = 0.0
         self._agent_clients: dict[bytes, rpc.AsyncRpcClient] = {}
         from ray_tpu._private import config as cfg
 
@@ -1033,31 +1036,50 @@ class ControlPlane:
     # agent exporter; here processes push cumulative series and the head
     # aggregates across reporters for the dashboard's /metrics) --
 
+    _TOMB = b"\0tomb"
+
     async def rpc_record_metrics(self, conn, p):
         reporter = p.get("reporter", b"?")
-        store = self.metrics.setdefault(reporter, {})
         now = time.time()
+        folded = self._metrics_folded.pop(reporter, None)
+        if folded is not None:
+            # a tombstoned reporter came back (paused/partitioned, not
+            # dead): un-fold its contribution or its cumulative series
+            # would be double-counted forever
+            tomb = self.metrics.get(self._TOMB, {})
+            for key, contrib in folded.items():
+                ent = tomb.get(key)
+                if ent is not None:
+                    tomb[key] = (ent[0], ent[1], ent[2] - contrib, ent[3])
+        store = self.metrics.setdefault(reporter, {})
         for name, kind, desc, tags, value in p["rows"]:
             store[(name, tuple(map(tuple, tags)))] = (
                 kind, desc, float(value), now
             )
-        # evict reporters silent >10min (dead workers), folding their
-        # monotonic series into a tombstone accumulator so counter totals
-        # survive worker churn without unbounded per-reporter growth
-        for rep in [
-            r for r, series in self.metrics.items()
-            if r != b"\0tomb" and series
-            and now - max(v[3] for v in series.values()) > 600.0
-        ]:
-            tomb = self.metrics.setdefault(b"\0tomb", {})
-            for key, (kind, desc, value, ts) in self.metrics.pop(
-                rep
-            ).items():
-                if kind == "gauge":
-                    continue  # point-in-time; dies with its reporter
-                old = tomb.get(key)
-                value += old[2] if old else 0.0
-                tomb[key] = (kind, desc, value, ts)
+        self._metrics_last_seen[reporter] = now
+        # sweep reporters silent >10min at most once a minute (O(#series)
+        # scans per report would make ingestion quadratic), folding their
+        # monotonic series into a tombstone so counter totals survive
+        # worker churn without unbounded per-reporter growth
+        if now - self._metrics_last_sweep > 60.0:
+            self._metrics_last_sweep = now
+            for rep, seen in list(self._metrics_last_seen.items()):
+                if now - seen <= 600.0:
+                    continue
+                del self._metrics_last_seen[rep]
+                tomb = self.metrics.setdefault(self._TOMB, {})
+                snapshot: dict = {}
+                for key, (kind, desc, value, ts) in self.metrics.pop(
+                    rep, {}
+                ).items():
+                    if kind == "gauge":
+                        continue  # point-in-time; dies with its reporter
+                    old = tomb.get(key)
+                    total = value + (old[2] if old else 0.0)
+                    tomb[key] = (kind, desc, total, ts)
+                    snapshot[key] = value
+                if snapshot:
+                    self._metrics_folded[rep] = snapshot
         return True
 
     async def rpc_get_metrics(self, conn, p):

@@ -306,21 +306,33 @@ class Executor(CoreWorker):
     def _execute_task(self, spec):
         owner = spec["owner"]
         t_start = time.time()
+        emitted = False
         try:
             fn = self.load_function(spec["func_id"])
             args, kwargs = self._resolve_args(spec)
             results = fn(*args, **kwargs)
             n = spec.get("num_returns", 1)
+            if n != "dynamic" and n > 1:
+                results = tuple(results)
+                if len(results) != n:
+                    raise RayTaskError(
+                        f"task declared num_returns={n} but returned "
+                        f"{len(results)} values"
+                    )
             if n == "dynamic":
+                # the generator runs while streaming; only then is the
+                # task finished
                 self._push_dynamic_results(spec, owner, results)
+                emitted = True
+                self._emit_task_event(spec, "FINISHED", t_start,
+                                      time.time())
             else:
-                if n > 1:
-                    results = tuple(results)
-                    if len(results) != n:
-                        raise RayTaskError(
-                            f"task declared num_returns={n} but returned "
-                            f"{len(results)} values"
-                        )
+                # event BEFORE the result push: the push unblocks the
+                # owner's get(), and a fast driver exit tears down this
+                # worker — the event would be lost in that race
+                emitted = True
+                self._emit_task_event(spec, "FINISHED", t_start,
+                                      time.time())
                 self._push_results(spec, owner, results)
         except BaseException as e:  # noqa: BLE001 — report, don't die
             tb = traceback.format_exc()
@@ -329,10 +341,9 @@ class Executor(CoreWorker):
                 e if _picklable(e) else
                 RayTaskError(f"{type(e).__name__}: {e}\n{tb}")
             )
+            if not emitted:  # one terminal event per task
+                self._emit_task_event(spec, "FAILED", t_start, time.time())
             self._push_results(spec, owner, None, error=err)
-            self._emit_task_event(spec, "FAILED", t_start, time.time())
-        else:
-            self._emit_task_event(spec, "FINISHED", t_start, time.time())
         finally:
             try:
                 self.agent.call("task_done", {"task_id": spec["task_id"]})

@@ -52,32 +52,40 @@ def _read_range(start: int, stop: int, block_size: int):
 class Dataset:
     """An ordered collection of block refs (reference dataset.py:176).
 
-    map_batches/filter are LAZY: chained maps accumulate as a pending
-    stage list and execute as ONE fused task per block when any consuming
-    op touches `_blocks` (the reference's logical-plan stage fusion,
-    plan.py:82 + can_fuse:67 — here fusion is the representation, so
-    chained maps can never miss it)."""
+    map_batches/filter are LAZY: each call makes a child Dataset holding
+    one stage; consuming ops materialize by walking up to the nearest
+    already-materialized ancestor and running the un-materialized stage
+    chain as ONE fused task per block (the reference's logical-plan stage
+    fusion, plan.py:82 + can_fuse:67). Branched pipelines therefore share
+    whatever an ancestor already computed — a stage never runs twice."""
 
-    def __init__(self, block_refs: list, *, _base=None, _pending=None,
+    def __init__(self, block_refs: list, *, _parent=None, _fn=None,
                  _inflight=DEFAULT_INFLIGHT):
-        if _pending:
-            self._base = list(_base)
-            self._pending = list(_pending)
+        if _parent is not None:
+            self._parent: "Dataset | None" = _parent
+            self._fn = _fn
             self._cached: list | None = None
         else:
-            self._base = list(block_refs)
-            self._pending = []
-            self._cached = self._base
+            self._parent = None
+            self._fn = None
+            self._cached = list(block_refs)
         self._inflight = _inflight
 
     @property
     def _blocks(self) -> list:
-        """Materialized block refs; executes pending fused stages once."""
+        """Materialized block refs; fuses + executes pending stages once."""
         if self._cached is None:
+            # collect un-materialized stages up to the nearest cached
+            # ancestor (intermediates stay lazy — that's the fusion)
+            blobs: list = []
+            node: Dataset = self
+            while node._cached is None:
+                blobs.append(node._fn)
+                node = node._parent
+            blobs.reverse()
             out: list = []
             in_flight: list = []
-            blobs = list(self._pending)
-            for block_ref in self._base:
+            for block_ref in node._cached:
                 if len(in_flight) >= self._inflight:
                     _, in_flight = ray_tpu.wait(
                         in_flight, num_returns=1, timeout=300
@@ -88,10 +96,16 @@ class Dataset:
             self._cached = out
         return self._cached
 
+    def _root(self) -> "Dataset":
+        node = self
+        while node._cached is None:
+            node = node._parent
+        return node
+
     # -- metadata --
 
     def num_blocks(self) -> int:
-        return len(self._base)
+        return len(self._root()._cached)
 
     def count(self) -> int:
         return sum(
@@ -115,14 +129,8 @@ class Dataset:
         from ray_tpu._private import serialization
 
         fn_blob = serialization.pack_callable(fn)
-        if self._cached is not None:
-            # chain from materialized blocks — never re-run earlier stages
-            # (they may be side-effecting or nondeterministic)
-            base, pending = self._cached, [fn_blob]
-        else:
-            base, pending = self._base, self._pending + [fn_blob]
         return Dataset(
-            [], _base=base, _pending=pending, _inflight=max_in_flight
+            [], _parent=self, _fn=fn_blob, _inflight=max_in_flight
         )
 
     def filter(self, pred: Callable[[Any], bool], **kw) -> "Dataset":

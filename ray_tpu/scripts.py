@@ -9,6 +9,11 @@ Reference: python/ray/scripts/scripts.py (`ray start:535`, `ray stop:978`,
   status --address HOST:PORT                   cluster view
   submit --address HOST:PORT script.py [args]  run a driver script with
                                                RAY_TPU_ADDRESS exported
+  list {tasks,actors,objects,jobs,nodes} --address HOST:PORT
+                                               state API listings
+                                               (`ray list ...` analog)
+  dashboard --address HOST:PORT [--dash-port P]  serve the dashboard
+                                               HTTP backend in foreground
 """
 
 from __future__ import annotations
@@ -75,6 +80,47 @@ def _status(args):
     ray_tpu.shutdown()
 
 
+def _list_state(args):
+    """`ray list tasks/actors/...` analog (reference
+    experimental/state/state_cli.py)."""
+    import ray_tpu
+
+    ray_tpu.init(address=args.address)
+    kind = args.kind
+    if kind == "tasks":
+        rows = ray_tpu.list_tasks(limit=args.limit)
+    elif kind == "actors":
+        rows = ray_tpu.list_actors()
+    elif kind == "objects":
+        rows = ray_tpu.list_objects(limit=args.limit)
+    elif kind == "jobs":
+        rows = ray_tpu.list_jobs()
+    else:
+        rows = ray_tpu.nodes()
+    print(json.dumps(
+        rows[-args.limit:] if isinstance(rows, list) else rows,
+        indent=2,
+        default=lambda o: o.hex() if isinstance(o, bytes) else repr(o),
+    ))
+    ray_tpu.shutdown()
+
+
+def _dashboard(args):
+    import time
+
+    import ray_tpu
+    from ray_tpu.dashboard import start_dashboard
+
+    ray_tpu.init(address=args.address)
+    host, port = start_dashboard(port=args.dash_port)
+    print(f"ray_tpu dashboard: http://{host}:{port}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        ray_tpu.shutdown()
+
+
 def _submit(args):
     env = dict(os.environ)
     env["RAY_TPU_ADDRESS"] = args.address
@@ -109,6 +155,16 @@ def main(argv=None):
     sm.add_argument("script")
     sm.add_argument("args", nargs=argparse.REMAINDER)
 
+    ls = sub.add_parser("list", help="state API listings")
+    ls.add_argument("kind",
+                    choices=["tasks", "actors", "objects", "jobs", "nodes"])
+    ls.add_argument("--address", required=True)
+    ls.add_argument("--limit", type=int, default=100)
+
+    db = sub.add_parser("dashboard", help="serve the dashboard backend")
+    db.add_argument("--address", required=True)
+    db.add_argument("--dash-port", type=int, default=8265)
+
     args = p.parse_args(argv)
     if args.cmd == "start":
         if args.head:
@@ -121,6 +177,10 @@ def main(argv=None):
         _status(args)
     elif args.cmd == "submit":
         _submit(args)
+    elif args.cmd == "list":
+        _list_state(args)
+    elif args.cmd == "dashboard":
+        _dashboard(args)
 
 
 if __name__ == "__main__":

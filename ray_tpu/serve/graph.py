@@ -104,9 +104,15 @@ def run_graph(root: GraphCallNode) -> GraphHandle:
     serve_api.start()
     seen: dict[int, DeploymentNode] = {}
     root._walk_deployments(seen)
+    # distinct nodes of the same Deployment are distinct instances: give
+    # repeats unique names (reference suffixes bound nodes the same way)
+    used: dict[str, int] = {}
     for node in seen.values():
+        n = used.get(node.name, 0)
+        used[node.name] = n + 1
+        unique = node.name if n == 0 else f"{node.name}_{n}"
         node._handle = serve_api.run(
-            node._deployment, name=node.name,
+            node._deployment, name=unique,
             init_args=node._init_args,
             init_kwargs=node._init_kwargs,
         )

@@ -35,3 +35,7 @@ from ray_tpu.train.sklearn import (  # noqa: F401,E402
     Predictor,
     SklearnTrainer,
 )
+from ray_tpu.train.torch_trainer import (  # noqa: F401,E402
+    TorchTrainer,
+    prepare_model,
+)

@@ -1,0 +1,109 @@
+"""TorchTrainer: gang DDP training with a gloo process group.
+
+Reference: train/torch/torch_trainer.py + torch/config.py:29 TorchConfig
+/ :69 _setup_torch_process_group / train_loop_utils.py prepare_model.
+The TPU build's flagship path is JaxTrainer (SPMD over a mesh); this
+trainer exists for torch-workload parity: N gang-scheduled workers join
+one torch.distributed gloo group (CPU; NCCL has no TPU meaning), the
+user loop reports through the same train session, and prepare_model
+wraps in DistributedDataParallel.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from ray_tpu._private import serialization
+from ray_tpu.train.backend_executor import _pick_coordinator
+from ray_tpu.train.trainer import Result, RunConfig, ScalingConfig
+from ray_tpu.train.worker_group import WorkerGroup
+
+
+def prepare_model(model):
+    """DDP-wrap under an initialized process group (reference
+    train_loop_utils.py prepare_model; no device moves — CPU/gloo)."""
+    import torch.distributed as dist
+    from torch.nn.parallel import DistributedDataParallel
+
+    if dist.is_initialized() and dist.get_world_size() > 1:
+        return DistributedDataParallel(model)
+    return model
+
+
+def _run_worker(worker, fn_blob, config, coordinator: str,
+                world_size: int):
+    import os
+    import queue
+
+    import torch.distributed as dist
+
+    from ray_tpu.train import session as S
+
+    rank = worker.worker_idx
+    host, port = coordinator.rsplit(":", 1)
+    os.environ["MASTER_ADDR"] = host
+    os.environ["MASTER_PORT"] = port
+    dist.init_process_group(
+        "gloo", init_method=f"tcp://{coordinator}", rank=rank,
+        world_size=world_size,
+    )
+    # unbounded results queue: the torch path drains post-hoc instead of
+    # streaming (reference semantics are per-report streaming; jax path
+    # has that — torch parity keeps the service simple)
+    sess = S._init_session(
+        world_rank=rank, world_size=world_size, results=queue.Queue(),
+    )
+    fn = serialization.unpack_payload(fn_blob)
+    try:
+        fn(config)
+    finally:
+        history = []
+        while not sess.results.empty():
+            history.append(sess.results.get())
+        try:
+            dist.destroy_process_group()
+        except Exception:  # noqa: BLE001
+            pass
+        S._shutdown_session()
+    return history
+
+
+class TorchTrainer:
+    """reference torch_trainer.py TorchTrainer.fit."""
+
+    def __init__(self, train_loop_per_worker: Callable[[dict], Any], *,
+                 train_loop_config: dict | None = None,
+                 scaling_config: ScalingConfig | None = None,
+                 run_config: RunConfig | None = None):
+        self.train_fn = train_loop_per_worker
+        self.config = train_loop_config or {}
+        self.scaling = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+
+    def fit(self) -> Result:
+        wg = WorkerGroup(
+            self.scaling.num_workers,
+            resources_per_worker=self.scaling.resources_per_worker,
+            strategy=self.scaling.placement_strategy,
+        )
+        try:
+            coordinator = wg.execute_single(0, _pick_coordinator)
+            fn_blob = serialization.pack_callable(self.train_fn)
+            histories = wg.execute(
+                _run_worker, fn_blob, self.config, coordinator,
+                self.scaling.num_workers, timeout=1800,
+            )
+        finally:
+            wg.shutdown()
+        rank0 = histories[0]
+        metrics = rank0[-1]["metrics"] if rank0 else None
+        ckpt = next(
+            (h["checkpoint"] for h in reversed(rank0)
+             if h.get("checkpoint") is not None),
+            None,
+        )
+        return Result(
+            metrics=metrics, checkpoint=ckpt,
+            metrics_history=[h["metrics"] for h in rank0],
+        )

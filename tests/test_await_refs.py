@@ -1,0 +1,59 @@
+"""Awaitable ObjectRefs (reference `await ref` / ObjectRef.as_future)."""
+
+import asyncio
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(head_resources={"CPU": 4, "memory": 4 * 2**30})
+    c.connect()
+    yield c
+    c.shutdown()
+
+
+def test_await_ref_in_driver_loop(cluster):
+    @ray_tpu.remote
+    def slow(x):
+        time.sleep(0.2)
+        return x * 2
+
+    async def main():
+        vals = await asyncio.gather(*(slow.remote(i) for i in range(4)))
+        return vals
+
+    assert asyncio.run(main()) == [0, 2, 4, 6]
+
+
+def test_await_ref_inside_async_actor(cluster):
+    @ray_tpu.remote
+    def produce(x):
+        return x
+
+    @ray_tpu.remote
+    class Consumer:
+        async def consume(self, refs):
+            # refs travel NESTED in a list (top-level auto-resolution
+            # doesn't touch them) and are awaited on the actor's loop
+            return sum([await r for r in refs])
+
+    c = Consumer.remote()
+    refs = [produce.remote(20), produce.remote(22)]
+    assert ray_tpu.get(c.consume.remote(list(refs)), timeout=60) == 42
+
+
+def test_await_error_propagates(cluster):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("async-boom")
+
+    async def main():
+        with pytest.raises(Exception, match="async-boom"):
+            await boom.remote()
+
+    asyncio.run(main())

@@ -76,3 +76,38 @@ def test_cli_submit(head_proc, tmp_path):
         env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd="/root/repo",
     )
     assert "submitted-ok" in out.stdout, out.stdout + out.stderr
+
+
+def test_cli_list_state(head_proc):
+    _, address = head_proc
+    import ray_tpu
+
+    ray_tpu.init(address=address)
+    try:
+        @ray_tpu.remote
+        def noop():
+            return 1
+
+        ray_tpu.get(noop.remote(), timeout=60)
+    finally:
+        ray_tpu.shutdown()
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+    def listing(kind):
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.scripts", "list", kind,
+             "--address", address],
+            capture_output=True, text=True, timeout=120, env=env,
+            cwd="/root/repo",
+        )
+        assert out.returncode == 0, out.stderr
+        return out.stdout
+
+    assert "resources_total" in listing("nodes")
+    assert "job_id" in listing("jobs")
+    deadline = time.time() + 20
+    while time.time() < deadline:  # task events flush asynchronously
+        if "noop" in listing("tasks"):
+            break
+        time.sleep(1.0)
+    assert "noop" in listing("tasks")

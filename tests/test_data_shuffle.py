@@ -142,6 +142,22 @@ def test_map_chain_fuses_into_one_task_per_block(cluster):
     assert ran == 4, f"expected 4 fused tasks, saw {ran}"
 
 
+def test_branch_shares_materialized_ancestor(cluster):
+    # d2 branches off d1 BEFORE d1 materializes; once d1 runs, d2 must
+    # reuse d1's cached blocks (nondeterministic stages can't re-run)
+    import uuid
+
+    def tag(block):
+        return [(row, uuid.uuid4().hex) for row in block]
+
+    ds = rdata.from_items(list(range(8)), parallelism=2)
+    d1 = ds.map_batches(tag)
+    d2 = d1.map_batches(lambda b: [t for (_, t) in b])
+    tags_d1 = {t for (_, t) in d1.iter_rows()}  # materializes d1
+    tags_d2 = set(d2.iter_rows())
+    assert tags_d2 == tags_d1  # same uuids -> tag() ran exactly once
+
+
 def test_lazy_dataset_reuse_executes_once(cluster):
     ds = rdata.from_items(list(range(12)), parallelism=2)
     mapped = ds.map_batches(lambda b: [x * 10 for x in b])

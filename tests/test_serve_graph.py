@@ -73,6 +73,28 @@ def test_diamond_graph_with_methods(cluster):
     assert ray_tpu.get(h.remote("1,2,3"), timeout=60) == 90
 
 
+def test_duplicate_bind_nodes_stay_distinct(cluster):
+    @serve.deployment(name="scale")
+    class Scale:
+        def __init__(self, k):
+            self.k = k
+
+        def __call__(self, x):
+            return self.k * x
+
+    @serve.deployment(name="addpair")
+    class AddPair:
+        def merge(self, a, b):
+            return a + b
+
+    inp = serve.InputNode()
+    a = Scale.bind(10)   # two bound instances of the SAME deployment
+    b = Scale.bind(100)  # must NOT collapse into one
+    out = AddPair.bind().merge.bind(a.bind(inp), b.bind(inp))
+    h = serve.run_graph(out)
+    assert ray_tpu.get(h.remote(3), timeout=60) == 330
+
+
 def test_unbuilt_graph_raises(cluster):
     @serve.deployment(name="orphan")
     class Orphan:
