@@ -90,36 +90,16 @@ class ObjectRef:
 
     def as_future(self):
         """asyncio.Future resolving to the object (reference
-        ObjectRef.as_future / `await ref` in _raylet.pyx). Resolution
-        happens on a thread so the event loop (e.g. an async actor's)
-        never blocks on the fetch."""
+        ObjectRef.as_future / `await ref` in _raylet.pyx). One shared
+        resolver thread multiplexes every pending await via wait() —
+        gathering thousands of refs costs one thread, not one each."""
         import asyncio
-        import threading
 
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
-
-        def work():
-            # NOTE: never close over the `except ... as e` target —
-            # CPython deletes it when the block exits, racing the loop
-            # callback (NameError, future never resolves)
-            err = val = None
-            try:
-                val = get(self)
-            except BaseException as e:  # noqa: BLE001
-                err = e
-
-            def resolve():
-                if fut.cancelled():
-                    return
-                if err is not None:
-                    fut.set_exception(err)
-                else:
-                    fut.set_result(val)
-
-            loop.call_soon_threadsafe(resolve)
-
-        threading.Thread(target=work, daemon=True).start()
+        # pass the ref itself: the resolver must keep it alive or the
+        # awaited object could be GC-freed cluster-wide mid-await
+        _future_resolver().register(self, loop, fut)
         return fut
 
     def __await__(self):
@@ -137,6 +117,80 @@ class ObjectRef:
                     w.remove_local_ref(self._id)
                 except Exception:  # noqa: BLE001 — interpreter teardown
                     pass
+
+
+class _FutureResolver:
+    """One thread resolving every awaited ref (wait() multiplexing)."""
+
+    def __init__(self):
+        # oid -> (ref, [(loop, fut)]): holding the ref pins its refcount
+        # (GC must not free an object someone is awaiting)
+        self._pending: dict[bytes, tuple] = {}
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        threading.Thread(target=self._drive, daemon=True,
+                         name="ray_tpu-await").start()
+
+    def register(self, ref: "ObjectRef", loop, fut):
+        with self._lock:
+            entry = self._pending.get(ref._id)
+            if entry is None:
+                entry = self._pending[ref._id] = (ref, [])
+            entry[1].append((loop, fut))
+        self._wake.set()
+
+    def _drive(self):
+        while True:
+            with self._lock:
+                oids = list(self._pending)
+            if not oids:
+                self._wake.wait()
+                self._wake.clear()
+                continue
+            try:
+                ready, _ = _get_worker().wait(
+                    oids, num_returns=1, timeout=0.5
+                )
+            except Exception:  # noqa: BLE001 — cluster going down
+                time.sleep(0.2)
+                continue
+            for oid in ready:
+                with self._lock:
+                    entry = self._pending.pop(oid, None)
+                if entry is None:
+                    continue
+                ref, waiters = entry
+                # NOTE: copy the except target — CPython deletes it at
+                # block exit, racing the loop callback
+                err = val = None
+                try:
+                    val = get(ref)
+                except BaseException as e:  # noqa: BLE001
+                    err = e
+                for loop, fut in waiters:
+                    def resolve(fut=fut, err=err, val=val):
+                        if fut.cancelled():
+                            return
+                        if err is not None:
+                            fut.set_exception(err)
+                        else:
+                            fut.set_result(val)
+
+                    try:
+                        loop.call_soon_threadsafe(resolve)
+                    except RuntimeError:
+                        pass  # loop closed; waiter is gone
+
+
+_resolver: _FutureResolver | None = None
+
+
+def _future_resolver() -> _FutureResolver:
+    global _resolver
+    with _state_lock:
+        if _resolver is None:
+            _resolver = _FutureResolver()
+        return _resolver
 
 
 class _RefProxy:

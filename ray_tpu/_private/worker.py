@@ -135,6 +135,12 @@ class CoreWorker:
         self._actor_seq: dict[bytes, _Counter] = {}
         self._actor_pending: dict[bytes, set[bytes]] = {}  # aid → task_ids
         self._peer_clients: dict[tuple, rpc.SyncRpcClient] = {}
+        # direct-task worker leases (direct_task_transport.h:110 lease
+        # caching per SchedulingKey): resources-shape -> granted worker
+        self._lease_cache: dict[tuple, dict] = {}
+        self._lease_tasks: dict[bytes, tuple] = {}  # task_id -> lease key
+        self._lease_lock = threading.Lock()
+        self._failing_tasks: dict[bytes, float] = {}  # failure dedup window
         self._lock = threading.Lock()
 
         # the worker's own RPC server (owner endpoint + executor endpoint)
@@ -237,6 +243,11 @@ class CoreWorker:
         if p.get("task_id") and not p.get("partial"):
             self._task_nodes.pop(p["task_id"], None)
             self._release_task_pins(p["task_id"])
+            # no unlocked membership pre-check: the submitter records the
+            # lease task under _lease_lock and this result can land while
+            # it still holds it — _on_lease_task_done checks under the
+            # lock and no-ops for non-leased tasks
+            self._on_lease_task_done(p["task_id"], failed=False)
         oid = p["object_id"]
         if p.get("dynamic_items"):
             # generator items live as long as their descriptor object
@@ -267,7 +278,25 @@ class CoreWorker:
 
     def _handle_task_failed(self, p):
         tid = p["task_id"]
+        # idempotence guard: a leased-worker death deterministically sends
+        # BOTH an agent task_failed and a lease_revoked fail-over for the
+        # same task (often sequentially, not overlapping) — only one may
+        # burn a retry / resubmit, so dedup over a time window
+        now = time.monotonic()
+        with self._lease_lock:
+            ts = self._failing_tasks.get(tid)
+            if ts is not None and now - ts < 60.0:
+                return
+            self._failing_tasks[tid] = now
+            for k, t0 in list(self._failing_tasks.items()):
+                if now - t0 > 120.0:
+                    del self._failing_tasks[k]
+        self._handle_task_failed_inner(p)
+
+    def _handle_task_failed_inner(self, p):
+        tid = p["task_id"]
         self._task_nodes.pop(tid, None)
+        self._on_lease_task_done(tid, failed=True)
         spec = None
         with self._mem_lock:
             for e in self.memory.values():
@@ -786,8 +815,150 @@ class CoreWorker:
         # Submitted-task references: args stay pinned until the task
         # completes or exhausts retries (reference_count.h:115).
         self._pin_task_deps(task_id, list(deps))
-        self.agent.call("submit_task", spec)
+        if not self._try_lease_submit(spec):
+            self.agent.call("submit_task", spec)
         return return_ids
+
+    # -- direct-task lease caching (direct_task_transport.h:110): repeat
+    # same-shape tasks push straight to a leased worker, skipping the
+    # agent queue/dispatch hop. The agent still learns about each leased
+    # task (async fire) so its worker-death machinery covers them. --
+
+    def _lease_key(self, spec) -> tuple | None:
+        if (spec.get("pg_id") or spec.get("scheduling_strategy")
+                or spec.get("runtime_env")
+                or spec.get("num_returns") == "dynamic"):
+            return None
+        inline = spec.get("inline_values", {})
+        for d in spec.get("deps", []):
+            if d not in inline and not self.store.contains(d):
+                return None  # remote dep: the agent's dep staging handles it
+        return tuple(sorted(spec.get("resources", {}).items()))
+
+    def _try_lease_submit(self, spec) -> bool:
+        # LOCK DISCIPLINE: never touch the io loop (agent.call / oneway —
+        # both block on it) while holding _lease_lock: the io thread takes
+        # the same lock in _on_lease_task_done, which deadlocks the loop.
+        # The lease is reserved (busy + task recorded) BEFORE the push, so
+        # a result can never race its own bookkeeping.
+        from ray_tpu._private import config as _cfg
+
+        if not _cfg.get("worker_lease_enabled"):
+            return False
+        key = self._lease_key(spec)
+        if key is None:
+            return False
+        now = time.monotonic()
+        tid = spec["task_id"]
+        expired = None
+        with self._lease_lock:
+            lease = self._lease_cache.get(key)
+            if lease is not None and now > lease["expires"]:
+                expired = self._lease_cache.pop(key)
+                lease = None
+            if lease is not None:
+                if lease["busy"]:
+                    lease = None  # one in-flight per lease; queue path
+                else:
+                    lease["busy"] = True
+                    self._lease_tasks[tid] = key
+            reserved = lease is not None
+        if expired is not None and not expired["busy"]:
+            self.agent.fire("return_lease",
+                            {"lease_id": expired["lease_id"]})
+        if not reserved:
+            if expired is None and key in self._lease_cache:
+                return False  # busy lease: fall back to queued submit
+            try:
+                grant = self.agent.call("lease_worker", {
+                    "resources": spec.get("resources", {}),
+                    "job_id": self.job_id,
+                    "owner": self.owner_address,
+                }, timeout=10.0)
+            except (rpc.ConnectionLost, rpc.RpcError):
+                return False
+            if not grant:
+                return False
+            lease = {
+                **grant, "busy": True,
+                "expires": now + grant["ttl_s"] * 0.8,
+            }
+            with self._lease_lock:
+                if key in self._lease_cache:
+                    extra = True  # another thread granted concurrently
+                else:
+                    extra = False
+                    self._lease_cache[key] = lease
+                    self._lease_tasks[tid] = key
+            if extra:
+                self.agent.fire("return_lease",
+                                {"lease_id": grant["lease_id"]})
+                return False
+        push = {k: v for k, v in spec.items() if not k.startswith("_")}
+        cli = self._peer({"addr": lease["addr"], "port": lease["port"]})
+        ok = cli is not None
+        if ok:
+            try:
+                cli.oneway("execute_task", push)
+            except (rpc.ConnectionLost, rpc.RpcError):
+                ok = False
+        if not ok:
+            with self._lease_lock:
+                self._lease_tasks.pop(tid, None)
+                self._lease_cache.pop(key, None)
+            self.agent.fire("return_lease", {"lease_id": lease["lease_id"]})
+            return False
+        # async: let the agent track the leased task so its worker-death
+        # notification path covers direct pushes too
+        self.agent.fire("lease_task_started", {
+            "lease_id": lease["lease_id"], "spec": push,
+        })
+        return True
+
+    async def rpc_lease_revoked(self, conn, p):
+        """Agent reclaimed our lease (TTL lapse, actor priority, or the
+        leased worker died): drop the cache entry and fail over any task
+        still in flight on it — the direct push may have raced the
+        agent's own task tracking, so the owner is the backstop."""
+        wid = p.get("worker_id")
+        orphans: list[bytes] = []
+        with self._lease_lock:
+            dead_keys = [
+                key for key, lease in self._lease_cache.items()
+                if lease.get("worker_id") == wid
+            ]
+            for key in dead_keys:
+                self._lease_cache.pop(key, None)
+                orphans.extend(
+                    tid for tid, k in self._lease_tasks.items() if k == key
+                )
+        for tid in orphans:
+            threading.Thread(
+                target=self._handle_task_failed,
+                args=({"task_id": tid, "reason": "lease revoked",
+                       "retriable": True},),
+                daemon=True,
+            ).start()
+        return True
+
+    def _on_lease_task_done(self, task_id: bytes, failed: bool):
+        with self._lease_lock:
+            key = self._lease_tasks.pop(task_id, None)
+            if key is None:
+                return
+            lease = self._lease_cache.get(key)
+            if lease is None:
+                return
+            if failed:
+                # worker likely died; agent released its half already
+                self._lease_cache.pop(key, None)
+                return
+            lease["busy"] = False
+            lease["expires"] = time.monotonic() + lease["ttl_s"] * 0.8
+        try:
+            self.agent.fire("renew_lease", {"lease_id": lease["lease_id"]})
+        except (rpc.ConnectionLost, rpc.RpcError):
+            pass
 
     def _pack_args(self, args, kwargs):
         """Serialize args; extract refs as deps; inline owned small values.

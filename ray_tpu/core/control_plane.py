@@ -495,6 +495,19 @@ class ControlPlane:
                 # only non-PG actors are gated on node availability here.
                 continue
             candidates.append(node)
+        if not candidates and pg is None:
+            # Nobody has availability RIGHT NOW (often just heartbeat lag
+            # after a task burst). Fall back to any node whose total
+            # capacity fits: its agent reserves the next freed resources
+            # for the actor ahead of queued tasks (actor priority), so a
+            # task flood can't starve actor creation.
+            candidates = [
+                n for n in self.nodes.values()
+                if n.alive and all(
+                    n.resources_total.get(r, 0) >= v
+                    for r, v in need.items()
+                )
+            ]
         if not candidates:
             # stays PENDING; retried when resources free up / nodes join
             return
@@ -1029,6 +1042,13 @@ class ControlPlane:
         job_id = p.get("job_id")
         if job_id:
             events = [e for e in events if e.get("job_id") == job_id]
+        # last event per task wins: a failed result push can follow a
+        # FINISHED with a corrective FAILED — listings/timeline must show
+        # one terminal state per task
+        last: dict = {}
+        for ev in events:
+            last[ev.get("task_id")] = ev
+        events = [ev for ev in events if last.get(ev.get("task_id")) is ev]
         limit = p.get("limit", 10_000)
         return events[-limit:]
 

@@ -146,6 +146,14 @@ class NodeAgent:
         self.bundle_available: dict[tuple[bytes, int], dict] = {}
         self._peer_clients: dict[bytes, AsyncRpcClient] = {}
         self._pulls_inflight: dict[bytes, asyncio.Future] = {}
+        # worker leases for owner-direct task pushes (lease caching,
+        # reference direct_task_transport.h:110): lease_id -> grant
+        self.leases: dict[bytes, dict] = {}
+        # task_done that beat its lease_task_started fire (both async)
+        self._done_before_started: set[bytes] = set()
+        self._done_order: deque[bytes] = deque()
+        # actors waiting for resources reserve ahead of queued tasks
+        self._actor_reservations: list[dict] = []
         # Spilling state (reference local_object_manager.h:110 SpillObjects
         # + external_storage.py:246 FileSystemStorage): pinned primaries in
         # seal order (the spill queue) and oid -> spill file for restores.
@@ -453,10 +461,20 @@ class NodeAgent:
                     w.proc.kill()
 
     async def _reap_loop(self):
-        """Detect dead workers; cull long-idle non-TPU workers."""
+        """Detect dead workers; cull long-idle non-TPU workers; expire
+        worker leases whose owners stopped renewing."""
         while not self._dead:
             await asyncio.sleep(0.2)
             now = time.monotonic()
+            for lease_id, lease in list(self.leases.items()):
+                if now > lease["expires"]:
+                    if lease.get("active") is not None:
+                        # a direct-pushed task is still running: revoking
+                        # now would hand its cpu to someone else and
+                        # double-run the task — extend until it finishes
+                        lease["expires"] = now + 1.0
+                    else:
+                        self._release_lease(lease_id)
             for w in list(self.workers.values()):
                 code = w.proc.poll()
                 if code is not None:
@@ -478,6 +496,17 @@ class NodeAgent:
                 })
             except (rpc.ConnectionLost, rpc.RpcError):
                 pass
+        for lease_id, lease in list(self.leases.items()):
+            if lease["worker_id"] == w.worker_id:
+                # release + owner revocation notice (the owner resubmits
+                # any in-flight direct-pushed task through the queue)
+                self._release_lease(lease_id)
+                for tid, spec in list(self.running.items()):
+                    if spec.get("_lease_id") == lease_id:
+                        self.running.pop(tid, None)
+                        await self._notify_task_failed(
+                            spec, f"leased worker died (exit {code})"
+                        )
         if w.busy_task is not None:
             spec = self.running.pop(w.busy_task, None)
             if spec is not None:
@@ -798,6 +827,12 @@ class NodeAgent:
                 self.task_queue.append(spec)
                 continue
             need = spec.get("resources", {})
+            if (pool is self.resources_available
+                    and self._actor_reservations
+                    and not self._fits_with_reservations(need)):
+                # a pending actor has dibs on the next freed resources
+                self.task_queue.append(spec)
+                continue
             if not self._fits(need, pool):
                 # A task this node can never satisfy re-evaluates the
                 # cluster as nodes join (autoscaled capacity) instead of
@@ -843,6 +878,14 @@ class NodeAgent:
             asyncio.ensure_future(self._run_task(spec))
         return progressed
 
+    def _fits_with_reservations(self, need: dict) -> bool:
+        """Does `need` fit after pending actor reservations are held back?"""
+        shadow = dict(self.resources_available)
+        for res in self._actor_reservations:
+            for r, v in res.items():
+                shadow[r] = shadow.get(r, 0) - v
+        return self._fits(need, shadow)
+
     def _is_inline(self, dep: bytes, spec: dict) -> bool:
         return dep in spec.get("inline_deps", ())
 
@@ -871,6 +914,114 @@ class NodeAgent:
             self._free_task_resources(spec)
             await self._notify_task_failed(spec, f"dispatch failed: {e}")
 
+    # -- worker leases (reference direct_task_transport.h:110
+    # RequestNewWorkerIfNeeded + lease caching per SchedulingKey): the
+    # owner leases a granted worker once, then pushes repeat same-shape
+    # tasks straight to it, skipping the agent's queue/dispatch hop. --
+
+    @property
+    def LEASE_TTL_S(self):  # read per call: honors late config overrides
+        return cfg.get("worker_lease_ttl_s")
+
+    async def rpc_lease_worker(self, conn, p):
+        need = p.get("resources", {})
+        if not self._fits(need, self.resources_available):
+            return None  # busy: owner falls back to queued submission
+        if self._actor_reservations and not self._fits_with_reservations(
+            need
+        ):
+            # a pending actor has dibs — the fast path must honor the
+            # same holdback as the dispatch loop or leases starve actors
+            return None
+        # take BEFORE the await: worker spawn can suspend for seconds and
+        # the dispatch loop (or a concurrent lease) would double-book the
+        # same resources
+        self._take(need, self.resources_available)
+        try:
+            w = await self._pop_worker(
+                p.get("job_id"), holds_tpu=need.get("TPU", 0) > 0,
+                runtime_env=p.get("runtime_env"),
+            )
+        except (asyncio.TimeoutError, OSError):
+            for r, v in need.items():
+                self._release(r, v)
+            return None
+        lease_id = os.urandom(8)
+        w.busy_task = b"__lease__" + lease_id
+        now = time.monotonic()
+        self.leases[lease_id] = {
+            "worker_id": w.worker_id,
+            "resources": dict(need),
+            "expires": now + self.LEASE_TTL_S,
+            "active": None,  # in-flight direct-pushed task id
+            "last_activity": now,
+            "owner": p.get("owner"),
+        }
+        return {"lease_id": lease_id, "worker_id": w.worker_id,
+                "addr": w.addr, "port": w.port,
+                "ttl_s": self.LEASE_TTL_S}
+
+    async def rpc_renew_lease(self, conn, p):
+        lease = self.leases.get(p["lease_id"])
+        if lease is None:
+            return False
+        now = time.monotonic()
+        lease["expires"] = now + self.LEASE_TTL_S
+        lease["last_activity"] = now
+        return True
+
+    async def rpc_return_lease(self, conn, p):
+        return self._release_lease(p["lease_id"])
+
+    async def rpc_lease_task_started(self, conn, p):
+        """Owner pushed a task to its leased worker: track it so the
+        worker-death path can notify the owner (the push itself skipped
+        this agent)."""
+        lease = self.leases.get(p["lease_id"])
+        if lease is None:
+            return False
+        spec = p["spec"]
+        tid = spec["task_id"]
+        if tid in self._done_before_started:
+            # the worker's task_done outran this fire — never register a
+            # spec for an already-finished task (it would leak forever)
+            self._done_before_started.discard(tid)
+            return True
+        spec["_leased"] = True
+        spec["_lease_id"] = p["lease_id"]
+        spec["_worker_id"] = lease["worker_id"]
+        lease["active"] = tid
+        lease["last_activity"] = time.monotonic()
+        self.running[tid] = spec
+        return True
+
+    def _release_lease(self, lease_id: bytes) -> bool:
+        lease = self.leases.pop(lease_id, None)
+        if lease is None:
+            return False
+        for r, v in lease["resources"].items():
+            self._release(r, v)
+        w = self.workers.get(lease["worker_id"])
+        if w is not None:
+            w.busy_task = None
+            w.idle_since = time.monotonic()
+        if lease.get("owner"):
+            # agent-initiated revocation (TTL lapse / actor reclaim): tell
+            # the owner so its cache doesn't push to an unleased worker
+            asyncio.ensure_future(self._notify_lease_revoked(lease))
+        self._kick_dispatch()
+        return True
+
+    async def _notify_lease_revoked(self, lease: dict):
+        try:
+            cli = await self._peer_worker(lease["owner"])
+            if cli is not None:
+                await cli.oneway("lease_revoked", {
+                    "worker_id": lease["worker_id"],
+                })
+        except (rpc.ConnectionLost, rpc.RpcError, OSError):
+            pass
+
     async def rpc_dump_stacks(self, conn, p):
         """Aggregate thread stacks across this node's workers (dashboard
         profiling endpoint; reference reporter_agent.py:348)."""
@@ -888,8 +1039,21 @@ class NodeAgent:
 
     async def rpc_task_done(self, conn, p):
         """Worker reports completion; frees resources, worker back to pool."""
-        spec = self.running.pop(p["task_id"], None)
-        if spec is not None:
+        tid = p["task_id"]
+        spec = self.running.pop(tid, None)
+        if spec is None:
+            # possibly a leased task whose started-fire hasn't landed yet
+            self._done_before_started.add(tid)
+            self._done_order.append(tid)
+            while len(self._done_order) > 10_000:  # bounded, evict oldest
+                self._done_before_started.discard(self._done_order.popleft())
+        elif spec.get("_leased"):
+            # lease holds the resources/worker until returned or expired
+            lease = self.leases.get(spec.get("_lease_id", b""))
+            if lease is not None and lease.get("active") == tid:
+                lease["active"] = None
+                lease["last_activity"] = time.monotonic()
+        else:
             self._free_task_resources(spec)
             w = self.workers.get(spec.get("_worker_id", b""))
             if w is not None:
@@ -945,10 +1109,43 @@ class NodeAgent:
             self._take(need, self.bundle_available[bundle_key])
         else:
             if not self._fits(need, self.resources_available):
-                raise rpc.RpcError("insufficient resources")
+                # Actor-priority wait: a saturating task flood must not
+                # starve actor creation (tasks would otherwise grab every
+                # freed cpu; with tasks blocked on this very actor that
+                # deadlocks). The reservation makes the dispatch loop
+                # leave room, and idle worker leases are reclaimed.
+                if not await self._wait_for_actor_resources(need):
+                    raise rpc.RpcError("insufficient resources")
             self._take(need, self.resources_available)
         asyncio.ensure_future(self._start_actor_async(p, need, bundle_key))
         return True
+
+    async def _wait_for_actor_resources(self, need: dict,
+                                        timeout: float = 60.0) -> bool:
+        self._actor_reservations.append(need)
+        try:
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if self._fits(need, self.resources_available):
+                    return True
+                # idle leases (no in-flight direct task) give way to
+                # actors; their owners just fall back to queued submits.
+                # The 1s activity grace covers the window where a direct
+                # push is in flight but its lease_task_started fire
+                # hasn't landed yet (reclaiming then would double-book).
+                now_ = time.monotonic()
+                for lease_id, lease in list(self.leases.items()):
+                    if (lease.get("active") is None
+                            and now_ - lease.get("last_activity", 0)
+                            > 1.0):
+                        self._release_lease(lease_id)
+                        break
+                if self._fits(need, self.resources_available):
+                    return True
+                await asyncio.sleep(0.05)
+            return self._fits(need, self.resources_available)
+        finally:
+            self._actor_reservations.remove(need)
 
     async def _start_actor_async(self, p: dict, need: dict,
                                  bundle_key=None):

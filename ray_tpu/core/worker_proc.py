@@ -306,7 +306,6 @@ class Executor(CoreWorker):
     def _execute_task(self, spec):
         owner = spec["owner"]
         t_start = time.time()
-        emitted = False
         try:
             fn = self.load_function(spec["func_id"])
             args, kwargs = self._resolve_args(spec)
@@ -323,14 +322,12 @@ class Executor(CoreWorker):
                 # the generator runs while streaming; only then is the
                 # task finished
                 self._push_dynamic_results(spec, owner, results)
-                emitted = True
                 self._emit_task_event(spec, "FINISHED", t_start,
                                       time.time())
             else:
                 # event BEFORE the result push: the push unblocks the
                 # owner's get(), and a fast driver exit tears down this
                 # worker — the event would be lost in that race
-                emitted = True
                 self._emit_task_event(spec, "FINISHED", t_start,
                                       time.time())
                 self._push_results(spec, owner, results)
@@ -341,8 +338,10 @@ class Executor(CoreWorker):
                 e if _picklable(e) else
                 RayTaskError(f"{type(e).__name__}: {e}\n{tb}")
             )
-            if not emitted:  # one terminal event per task
-                self._emit_task_event(spec, "FAILED", t_start, time.time())
+            # if FINISHED already went out (result push itself failed),
+            # the corrective FAILED still fires: consumers take the LAST
+            # event per task id as the terminal state
+            self._emit_task_event(spec, "FAILED", t_start, time.time())
             self._push_results(spec, owner, None, error=err)
         finally:
             try:

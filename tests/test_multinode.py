@@ -28,10 +28,14 @@ def test_spillback_uses_other_nodes(cluster3):
     @ray_tpu.remote(num_cpus=2)
     def node_store():
         import os
+        import time as _t
 
+        _t.sleep(1.0)  # hold the cpus so the three tasks truly overlap
         return os.environ["RAY_TPU_NODE_ID"]
 
     # 3 concurrent 2-CPU tasks can only run by using all three nodes
+    # (without the sleep, fast completions let the worker-lease fast path
+    # legitimately serialize them on one node)
     refs = [node_store.remote() for _ in range(3)]
     nodes = set(ray_tpu.get(refs, timeout=120))
     assert len(nodes) >= 2  # spilled beyond the head node
