@@ -159,27 +159,43 @@ class _FutureResolver:
                     entry = self._pending.pop(oid, None)
                 if entry is None:
                     continue
-                ref, waiters = entry
-                # NOTE: copy the except target — CPython deletes it at
-                # block exit, racing the loop callback
-                err = val = None
-                try:
-                    val = get(ref)
-                except BaseException as e:  # noqa: BLE001
-                    err = e
-                for loop, fut in waiters:
-                    def resolve(fut=fut, err=err, val=val):
-                        if fut.cancelled():
-                            return
-                        if err is not None:
-                            fut.set_exception(err)
-                        else:
-                            fut.set_result(val)
+                # fetch on a small pool: one slow get (spill restore,
+                # remote pull) must not head-of-line-block every other
+                # pending await in the process
+                self._pool().submit(self._resolve_one, entry)
 
-                    try:
-                        loop.call_soon_threadsafe(resolve)
-                    except RuntimeError:
-                        pass  # loop closed; waiter is gone
+    def _pool(self):
+        import concurrent.futures
+
+        if getattr(self, "_fetch_pool", None) is None:
+            self._fetch_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=8, thread_name_prefix="ray_tpu-await-fetch"
+            )
+        return self._fetch_pool
+
+    @staticmethod
+    def _resolve_one(entry):
+        ref, waiters = entry
+        # NOTE: copy the except target — CPython deletes it at block
+        # exit, racing the loop callback
+        err = val = None
+        try:
+            val = get(ref)
+        except BaseException as e:  # noqa: BLE001
+            err = e
+        for loop, fut in waiters:
+            def resolve(fut=fut, err=err, val=val):
+                if fut.cancelled():
+                    return
+                if err is not None:
+                    fut.set_exception(err)
+                else:
+                    fut.set_result(val)
+
+            try:
+                loop.call_soon_threadsafe(resolve)
+            except RuntimeError:
+                pass  # loop closed; waiter is gone
 
 
 _resolver: _FutureResolver | None = None

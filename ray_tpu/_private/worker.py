@@ -138,9 +138,12 @@ class CoreWorker:
         # direct-task worker leases (direct_task_transport.h:110 lease
         # caching per SchedulingKey): resources-shape -> granted worker
         self._lease_cache: dict[tuple, dict] = {}
-        self._lease_tasks: dict[bytes, tuple] = {}  # task_id -> lease key
+        # task_id -> (key, lease_id): lease_id disambiguates when an
+        # expired-busy lease is replaced under the same scheduling key
+        self._lease_tasks: dict[bytes, tuple] = {}
         self._lease_lock = threading.Lock()
-        self._failing_tasks: dict[bytes, float] = {}  # failure dedup window
+        # (task_id, retries_left) -> ts: per-attempt failure dedup
+        self._failing_tasks: dict[tuple, float] = {}
         self._lock = threading.Lock()
 
         # the worker's own RPC server (owner endpoint + executor endpoint)
@@ -158,6 +161,8 @@ class CoreWorker:
         # retry in-flight tasks when that node dies (the dying agent cannot
         # send task_failed itself).
         self._task_nodes: dict[bytes, bytes] = {}
+        self._task_node_hops: dict[bytes, int] = {}
+        self._dead_nodes: set[bytes] = set()
         self.head.on_push("node_dead", self._on_node_dead)
         self.head.call("subscribe", {"channel": "node_dead"})
         # Head restart (GCS FT): the SyncRpcClient reconnects transparently;
@@ -242,6 +247,7 @@ class CoreWorker:
         """An executor finished a task we own (or serves a borrowed get)."""
         if p.get("task_id") and not p.get("partial"):
             self._task_nodes.pop(p["task_id"], None)
+            self._task_node_hops.pop(p["task_id"], None)
             self._release_task_pins(p["task_id"])
             # no unlocked membership pre-check: the submitter records the
             # lease task under _lease_lock and this result can land while
@@ -278,24 +284,8 @@ class CoreWorker:
 
     def _handle_task_failed(self, p):
         tid = p["task_id"]
-        # idempotence guard: a leased-worker death deterministically sends
-        # BOTH an agent task_failed and a lease_revoked fail-over for the
-        # same task (often sequentially, not overlapping) — only one may
-        # burn a retry / resubmit, so dedup over a time window
-        now = time.monotonic()
-        with self._lease_lock:
-            ts = self._failing_tasks.get(tid)
-            if ts is not None and now - ts < 60.0:
-                return
-            self._failing_tasks[tid] = now
-            for k, t0 in list(self._failing_tasks.items()):
-                if now - t0 > 120.0:
-                    del self._failing_tasks[k]
-        self._handle_task_failed_inner(p)
-
-    def _handle_task_failed_inner(self, p):
-        tid = p["task_id"]
         self._task_nodes.pop(tid, None)
+        self._task_node_hops.pop(tid, None)
         self._on_lease_task_done(tid, failed=True)
         spec = None
         with self._mem_lock:
@@ -319,6 +309,32 @@ class CoreWorker:
                 for oid in return_oids
             ):
                 return
+        # Attempt-level dedup: a leased-worker death sends BOTH an agent
+        # task_failed and a lease_revoked fail-over for the same attempt —
+        # only one may burn a retry. Keying on (task, retries_left) lets a
+        # RESUBMITTED attempt's own later failure through (same task id,
+        # decremented counter), unlike a plain time window.
+        if p.get("routing_failure"):
+            # a stale view sent the task to an already-dead node; nothing
+            # executed, so resubmission neither burns a retry nor counts
+            # as this attempt's failure (self-correcting once the view
+            # refreshes)
+            try:
+                self.agent.call("submit_task", spec)
+            except (rpc.ConnectionLost, rpc.RpcError):
+                pass
+            else:
+                return
+        attempt_key = (tid, spec.get("retries_left", 0))
+        now = time.monotonic()
+        with self._lease_lock:
+            ts = self._failing_tasks.get(attempt_key)
+            if ts is not None and now - ts < 120.0:
+                return
+            self._failing_tasks[attempt_key] = now
+            for k, t0 in list(self._failing_tasks.items()):
+                if now - t0 > 240.0:
+                    del self._failing_tasks[k]
         if p.get("retriable", True) and spec.get("retries_left", 0) > 0:
             spec["retries_left"] -= 1
             logger.warning("retrying task %s (%s left): %s", tid.hex()[:8],
@@ -344,16 +360,46 @@ class CoreWorker:
             e.event.set()
 
     async def rpc_task_located(self, conn, p):
-        """An agent accepted one of our tasks into its local queue."""
-        self._task_nodes[p["task_id"]] = p["node_id"]
+        """An agent accepted (or forwarded) one of our tasks.
+
+        Notifies from every hop of a spill chain race here out of order;
+        only the deepest hop names the node actually holding the task, so
+        keep the max-hop report per attempt (hops only grow)."""
+        tid = p["task_id"]
+        hop = p.get("hop", 0)
+        prev = self._task_node_hops.get(tid, -1)
+        if hop < prev:
+            return True
+        self._task_node_hops[tid] = hop
+        if len(self._task_node_hops) > 50_000:
+            self._task_node_hops.clear()
+        self._task_nodes[tid] = p["node_id"]
+        if p["node_id"] in self._dead_nodes:
+            # stale cluster views can forward a task to a node whose
+            # death we already processed — its node_dead event will never
+            # come again, so fail over right now (the per-attempt dedup
+            # keeps this from burning extra retries)
+            self._task_nodes.pop(p["task_id"], None)
+            self._task_node_hops.pop(p["task_id"], None)
+            threading.Thread(
+                target=self._handle_task_failed,
+                args=({"task_id": p["task_id"],
+                       "reason": "routed to dead node",
+                       "retriable": True, "routing_failure": True},),
+                daemon=True,
+            ).start()
         return True
 
     def _on_node_dead(self, payload: dict):
         dead = payload.get("node_id")
+        self._dead_nodes.add(dead)
+        if len(self._dead_nodes) > 1000:
+            self._dead_nodes.pop()
         stranded = [tid for tid, nid in self._task_nodes.items()
                     if nid == dead]
         for tid in stranded:
             self._task_nodes.pop(tid, None)
+            self._task_node_hops.pop(tid, None)
             threading.Thread(
                 target=self._handle_task_failed,
                 args=({"task_id": tid,
@@ -861,7 +907,7 @@ class CoreWorker:
                     lease = None  # one in-flight per lease; queue path
                 else:
                     lease["busy"] = True
-                    self._lease_tasks[tid] = key
+                    self._lease_tasks[tid] = (key, lease["lease_id"])
             reserved = lease is not None
         if expired is not None and not expired["busy"]:
             self.agent.fire("return_lease",
@@ -889,7 +935,7 @@ class CoreWorker:
                 else:
                     extra = False
                     self._lease_cache[key] = lease
-                    self._lease_tasks[tid] = key
+                    self._lease_tasks[tid] = (key, lease["lease_id"])
             if extra:
                 self.agent.fire("return_lease",
                                 {"lease_id": grant["lease_id"]})
@@ -913,6 +959,9 @@ class CoreWorker:
         self.agent.fire("lease_task_started", {
             "lease_id": lease["lease_id"], "spec": push,
         })
+        # owner-side node tracking for direct pushes (they bypass the
+        # agents' task_located notifies entirely)
+        self._task_nodes[tid] = self.node_id
         return True
 
     async def rpc_lease_revoked(self, conn, p):
@@ -923,15 +972,18 @@ class CoreWorker:
         wid = p.get("worker_id")
         orphans: list[bytes] = []
         with self._lease_lock:
-            dead_keys = [
-                key for key, lease in self._lease_cache.items()
+            dead = [
+                (key, lease["lease_id"])
+                for key, lease in self._lease_cache.items()
                 if lease.get("worker_id") == wid
             ]
-            for key in dead_keys:
+            for key, _lid in dead:
                 self._lease_cache.pop(key, None)
-                orphans.extend(
-                    tid for tid, k in self._lease_tasks.items() if k == key
-                )
+            dead_ids = {lid for _, lid in dead}
+            orphans.extend(
+                tid for tid, (_k, lid) in self._lease_tasks.items()
+                if lid in dead_ids
+            )
         for tid in orphans:
             threading.Thread(
                 target=self._handle_task_failed,
@@ -943,12 +995,13 @@ class CoreWorker:
 
     def _on_lease_task_done(self, task_id: bytes, failed: bool):
         with self._lease_lock:
-            key = self._lease_tasks.pop(task_id, None)
-            if key is None:
+            entry = self._lease_tasks.pop(task_id, None)
+            if entry is None:
                 return
+            key, lease_id = entry
             lease = self._lease_cache.get(key)
-            if lease is None:
-                return
+            if lease is None or lease.get("lease_id") != lease_id:
+                return  # the task's lease was replaced; don't touch the new one
             if failed:
                 # worker likely died; agent released its half already
                 self._lease_cache.pop(key, None)

@@ -617,12 +617,18 @@ class NodeAgent:
         except (rpc.ConnectionLost, rpc.RpcError, OSError):
             pass
 
-    async def _notify_task_located(self, spec: dict):
+    async def _notify_task_located(self, spec: dict,
+                                   node_id: bytes | None = None):
         try:
             cli = await self._peer_worker(spec["owner"])
             if cli is not None:
                 await cli.oneway("task_located", {
-                    "task_id": spec["task_id"], "node_id": self.node_id,
+                    "task_id": spec["task_id"],
+                    "node_id": node_id or self.node_id,
+                    # forward-hop depth: the notifies from every hop of a
+                    # spill chain race to the owner, and only the deepest
+                    # one names the node actually holding the task
+                    "hop": spec.get("_spills", 0),
                 })
         except (rpc.ConnectionLost, rpc.RpcError, OSError):
             pass
@@ -777,9 +783,17 @@ class NodeAgent:
         fwd["_spills"] = spec["_spills"]
         try:
             await cli.call("submit_task", fwd)
-            return True
         except (rpc.ConnectionLost, rpc.RpcError):
             return False
+        # the SENDER also tells the owner where the task went: if the
+        # target dies before its own task_located fires, the owner would
+        # otherwise never associate the task with the dead node — the
+        # task silently vanishes (no retry, get() hangs)
+        if spec.get("owner"):
+            asyncio.ensure_future(
+                self._notify_task_located(spec, node_id)
+            )
+        return True
 
     async def _peer_agent(self, node_id: bytes) -> AsyncRpcClient | None:
         cli = self._peer_clients.get(node_id)
@@ -1128,16 +1142,17 @@ class NodeAgent:
             while time.monotonic() < deadline:
                 if self._fits(need, self.resources_available):
                     return True
-                # idle leases (no in-flight direct task) give way to
-                # actors; their owners just fall back to queued submits.
-                # The 1s activity grace covers the window where a direct
-                # push is in flight but its lease_task_started fire
-                # hasn't landed yet (reclaiming then would double-book).
+                # Idle leases give way to actors — but only past the
+                # OWNER's own reuse horizon (0.8*TTL since last activity,
+                # plus slack): inside that window the owner may reserve-
+                # and-push at any moment without asking the agent, so
+                # reclaiming would double-book the worker.
                 now_ = time.monotonic()
+                grace = self.LEASE_TTL_S * 0.9
                 for lease_id, lease in list(self.leases.items()):
                     if (lease.get("active") is None
                             and now_ - lease.get("last_activity", 0)
-                            > 1.0):
+                            > grace):
                         self._release_lease(lease_id)
                         break
                 if self._fits(need, self.resources_available):

@@ -93,5 +93,15 @@ def test_head_restart_objects_reannounced(cluster):
         except Exception:
             pass
         time.sleep(0.3)
-    out = ray_tpu.get(ref, timeout=90)
+    out = None
+    for attempt in (0, 1):
+        try:
+            out = ray_tpu.get(ref, timeout=90)
+            break
+        except ray_tpu.GetTimeoutError:
+            # full-suite load can stretch the reconnect+replay window
+            # past one get budget; one settle-and-retry cycle
+            if attempt:
+                raise
+            time.sleep(5)
     assert out[-1] == 299_999
