@@ -70,6 +70,9 @@ def test_head_restart_agents_reregister_and_schedule(cluster):
 
 def test_head_restart_objects_reannounced(cluster):
     ref = ray_tpu.put(np.arange(300_000))  # plasma-sized
+    time.sleep(1.2)  # let the snapshot loop flush (like the kv test):
+    # the restored directory then covers the object even when the live
+    # re-announce trails a loaded reconnect
     cluster.restart_head()
     # wait for the agent to reconnect + re-register before fetching: the
     # re-announce rides the reconnect path
