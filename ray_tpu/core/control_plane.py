@@ -185,6 +185,7 @@ class ControlPlane:
         )
         self._install_routes()
         self._bg: list[asyncio.Task] = []
+        self._stopping = False
         # GCS fault tolerance (reference gcs_table_storage.h:252 +
         # redis_store_client.h:28, scaled to a file-backed store): durable
         # tables are snapshotted; a restarted head reloads them, agents
@@ -270,6 +271,12 @@ class ControlPlane:
         return port
 
     async def stop(self):
+        # Orderly shutdown (e.g. a head restart for FT): the connection
+        # drops that follow are caused by US, not by client death — they
+        # must not trigger node-death, ref sweeps, or job finish, or a
+        # restarting head GCs the very state it persisted (reference: GCS
+        # shutdown never implies cluster death).
+        self._stopping = True
         for t in self._bg:
             t.cancel()
         if self.persist_path and self._dirty:
@@ -1179,6 +1186,8 @@ class ControlPlane:
                          {"node_id": node_id, "reason": reason})
 
     async def _on_disconnect(self, conn: ServerConn):
+        if self._stopping:
+            return  # our own shutdown closed the socket, not client death
         self.pub.unsubscribe_conn(conn)
         node_id = conn.state.get("node_id")
         if node_id is not None:

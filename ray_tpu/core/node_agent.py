@@ -269,9 +269,15 @@ class NodeAgent:
             for ch in ("node_dead", "node_added", "job_finished"):
                 await cli.call("subscribe", {"channel": ch})
             # re-announce local primaries so the rebuilt directory knows us
-            for oid in list(self.primaries):
+            for oid, size in list(self.primaries.items()):
                 await cli.call("object_add_location", {
-                    "object_id": oid, "node_id": self.node_id,
+                    "object_id": oid, "node_id": self.node_id, "size": size,
+                })
+            # spilled primaries live on this node's disk: re-announce the
+            # spill urls too so restores keep working after a head restart
+            for oid, path in list(self.spilled_files.items()):
+                await cli.call("object_spilled", {
+                    "object_id": oid, "url": self._spill_url(path),
                 })
         except (rpc.ConnectionLost, rpc.RpcError):
             return False
@@ -1474,6 +1480,11 @@ class NodeAgent:
         finally:
             self._spilling = False
 
+    def _spill_url(self, path: str) -> str:
+        """Spill url format; the control plane parses the node id back out
+        of it (rpc_object_spilled), so every producer must share this."""
+        return f"file://{self.node_id.hex()}/{path}"
+
     async def _spill_one(self, oid: bytes) -> bool:
         buf = self.store.get(oid)
         if buf is None:
@@ -1491,7 +1502,7 @@ class NodeAgent:
         finally:
             buf.release()
         self.spilled_files[oid] = path
-        url = f"file://{self.node_id.hex()}/{path}"
+        url = self._spill_url(path)
         try:
             await self.head.call("object_spilled",
                                  {"object_id": oid, "url": url})
