@@ -121,6 +121,30 @@ def _dashboard(args):
         ray_tpu.shutdown()
 
 
+def _serve(args):
+    """`serve deploy/status/shutdown` (reference serve CLI + REST deploy)."""
+    import json
+
+    import ray_tpu
+    from ray_tpu.serve import schema as serve_schema
+
+    ray_tpu.init(address=args.address)
+    try:
+        if args.serve_cmd == "deploy":
+            sys.path.insert(0, os.getcwd())  # resolve import_path locally
+            names = serve_schema.apply(args.config)
+            print(f"deployed: {', '.join(names)}")
+        elif args.serve_cmd == "status":
+            print(json.dumps(serve_schema.status(), indent=2))
+        elif args.serve_cmd == "shutdown":
+            from ray_tpu import serve
+
+            serve.shutdown()
+            print("serve shut down")
+    finally:
+        ray_tpu.shutdown()
+
+
 def _submit(args):
     env = dict(os.environ)
     env["RAY_TPU_ADDRESS"] = args.address
@@ -165,6 +189,16 @@ def main(argv=None):
     db.add_argument("--address", required=True)
     db.add_argument("--dash-port", type=int, default=8265)
 
+    sv = sub.add_parser("serve", help="declarative serve deploy/status")
+    sv_sub = sv.add_subparsers(dest="serve_cmd", required=True)
+    sv_d = sv_sub.add_parser("deploy", help="apply a serve config file")
+    sv_d.add_argument("config", help="YAML/JSON serve config")
+    sv_d.add_argument("--address", required=True)
+    sv_s = sv_sub.add_parser("status", help="list running deployments")
+    sv_s.add_argument("--address", required=True)
+    sv_x = sv_sub.add_parser("shutdown", help="tear down all deployments")
+    sv_x.add_argument("--address", required=True)
+
     args = p.parse_args(argv)
     if args.cmd == "start":
         if args.head:
@@ -181,6 +215,8 @@ def main(argv=None):
         _list_state(args)
     elif args.cmd == "dashboard":
         _dashboard(args)
+    elif args.cmd == "serve":
+        _serve(args)
 
 
 if __name__ == "__main__":

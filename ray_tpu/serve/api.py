@@ -35,29 +35,43 @@ class _ReplicaActor:
 
         cls = serialization.unpack_payload(cls_blob)
         self._user = cls(*init_args, **init_kwargs)
+        self._req_lock = threading.Lock()
+        self._num_inflight = 0
 
     def handle_request(self, method: str, args, kwargs, model_id: str = ""):
         import ray_tpu as rt
         from ray_tpu.serve.multiplex import _set_model_id
 
-        # set unconditionally: pooled executor threads would otherwise leak
-        # a previous request's model id into non-multiplexed requests
-        _set_model_id(model_id)
-        # deployment-graph edges arrive as ObjectRefs nested in the args
-        # list (the runtime only auto-resolves top-level task args) —
-        # resolve them here so composed deployments pipeline replica to
-        # replica without a driver hop
-        args = [
-            rt.get(a, timeout=300) if isinstance(a, rt.ObjectRef) else a
-            for a in args
-        ]
-        kwargs = {
-            k: rt.get(v, timeout=300) if isinstance(v, rt.ObjectRef) else v
-            for k, v in kwargs.items()
-        }
-        fn = (self._user if method == "__call__"
-              else getattr(self._user, method))
-        return fn(*args, **kwargs)
+        with self._req_lock:
+            self._num_inflight += 1
+        try:
+            # set unconditionally: pooled executor threads would otherwise
+            # leak a previous request's model id into non-multiplexed
+            # requests
+            _set_model_id(model_id)
+            # deployment-graph edges arrive as ObjectRefs nested in the
+            # args list (the runtime only auto-resolves top-level task
+            # args) — resolve them here so composed deployments pipeline
+            # replica to replica without a driver hop
+            args = [
+                rt.get(a, timeout=300) if isinstance(a, rt.ObjectRef) else a
+                for a in args
+            ]
+            kwargs = {
+                k: rt.get(v, timeout=300) if isinstance(v, rt.ObjectRef) else v
+                for k, v in kwargs.items()
+            }
+            fn = (self._user if method == "__call__"
+                  else getattr(self._user, method))
+            return fn(*args, **kwargs)
+        finally:
+            with self._req_lock:
+                self._num_inflight -= 1
+
+    def num_inflight(self) -> int:
+        """Requests currently executing here (drain poll target)."""
+        with self._req_lock:
+            return self._num_inflight
 
     def reconfigure(self, user_config):
         if hasattr(self._user, "reconfigure"):
@@ -92,11 +106,31 @@ class _Controller:
 
     # -- control --
 
+    ROLLING_BATCH_FRACTION = 0.34  # replicas replaced per rolling round
+    # Settle before the first idle check: must cover the window in which a
+    # handle that has not yet seen the unpublish push keeps routing here —
+    # including the handle poll loop's 1.0s error-backoff sleep — so those
+    # in-transit requests arrive (and count) before any kill decision.
+    DRAIN_SETTLE_S = 1.5
+    DRAIN_TIMEOUT_S = 30.0  # then kill even if still busy
+
     def deploy(self, name: str, cls_blob, init_args, init_kwargs,
                num_replicas: int, max_concurrent_queries: int,
                version: str, resources: dict,
                route_prefix: str | None = None,
-               autoscaling_config: dict | None = None):
+               autoscaling_config: dict | None = None,
+               user_config: dict | None = None):
+        """Deploy or redeploy.
+
+        A version change is a ROLLING replacement (reference
+        _private/deployment_state.py rollout semantics): new replicas start
+        and join the routing table in batches, and each displaced old
+        replica is drained — unpublished, then killed only once its
+        in-flight count reaches zero — so a redeploy under live traffic
+        drops no requests.
+        """
+        import math
+
         import ray_tpu as rt
 
         with self._lock:
@@ -105,17 +139,7 @@ class _Controller:
                 num_replicas = autoscaling_config.get(
                     "min_replicas", num_replicas
                 )
-            replicas = [
-                self._start_replica(
-                    cls_blob, init_args, init_kwargs, resources,
-                    max_concurrent_queries,
-                )
-                for _ in range(num_replicas)
-            ]
-            # wait for constructors (health check) before flipping traffic
-            rt.get([r.health.remote() for r in replicas], timeout=300)
-            self.deployments[name] = {
-                "replicas": replicas,
+            new_cfg = {
                 "version": version,
                 "max_concurrent_queries": max_concurrent_queries,
                 "cls_blob": cls_blob,
@@ -123,18 +147,164 @@ class _Controller:
                 "init_kwargs": init_kwargs,
                 "resources": resources,
                 "autoscaling": autoscaling_config,
+                "user_config": user_config,
             }
-            if route_prefix:
-                self.routes[route_prefix] = name
-                self.long_poll_host.set("routes", dict(self.routes))
-            self._publish(name)
-            if old is not None:
-                for r in old["replicas"]:  # rolling-replace: drain = kill
+
+            if old is None:
+                replicas = self._start_batch(num_replicas, new_cfg)
+                self.deployments[name] = {"replicas": replicas, **new_cfg}
+                # route goes live only once replicas are healthy: the
+                # proxy must never resolve a prefix to an empty deployment
+                self._set_route(name, route_prefix)
+                self._publish(name)
+                return num_replicas
+
+            if old["version"] == version:
+                # same code version: scale / reconfigure in place
+                old.update(new_cfg)
+                survivors = list(old["replicas"])
+                cur = len(survivors)
+                victims: list = []
+                if num_replicas > cur:
+                    # _start_batch applies user_config to the fresh ones
+                    old["replicas"] = survivors + self._start_batch(
+                        num_replicas - cur, new_cfg)
+                elif num_replicas < cur:
+                    victims = survivors[num_replicas:]
+                    survivors = survivors[:num_replicas]
+                    old["replicas"] = survivors
+                self._set_route(name, route_prefix)
+                # publish BEFORE draining so routers stop sending to the
+                # victims immediately (reconfigure below can be slow)
+                self._publish(name)
+                self._drain_and_kill(victims)
+                if user_config is not None:
+                    rt.get([r.reconfigure.remote(user_config)
+                            for r in survivors], timeout=300)
+                return num_replicas
+
+            # rolling replacement
+            batch = max(1, math.ceil(
+                num_replicas * self.ROLLING_BATCH_FRACTION))
+            old_replicas = list(old["replicas"])
+            old_version = old["version"]
+            new_replicas: list = []
+            d = self.deployments[name] = {
+                "replicas": list(old_replicas), **new_cfg}
+            try:
+                while len(new_replicas) < num_replicas or old_replicas:
+                    n = min(batch,
+                            max(0, num_replicas - len(new_replicas)))
+                    new_replicas.extend(self._start_batch(n, new_cfg))
+                    # retire as many old replicas as possible while keeping
+                    # the serving set at the target size mid-roll
+                    n_retire = min(
+                        len(old_replicas),
+                        max(0, len(new_replicas) + len(old_replicas)
+                            - num_replicas),
+                    )
+                    retired = old_replicas[:n_retire]
+                    old_replicas = old_replicas[n_retire:]
+                    d["replicas"] = new_replicas + old_replicas
+                    self._publish(name)  # handles stop routing to retired
+                    self._drain_and_kill(retired)
+                self._set_route(name, route_prefix)
+            except Exception:
+                # mid-roll failure: keep serving with whatever started plus
+                # the surviving old replicas (already-retired ones are
+                # gone). The recorded version stays the OLD one — old-code
+                # replicas are still serving, and a retry of the same
+                # deploy must re-enter THIS rolling path, not the
+                # same-version scale path.
+                d["replicas"] = new_replicas + old_replicas
+                d["version"] = old_version
+                self._publish(name)
+                raise
+        return num_replicas
+
+    def _set_route(self, name: str, route_prefix: str | None):
+        if route_prefix:
+            self.routes[route_prefix] = name
+            self.long_poll_host.set("routes", dict(self.routes))
+
+    def _start_batch(self, n: int, cfg: dict) -> list:
+        """Start n replicas and wait for their constructors + initial
+        reconfigure; on ANY failure, reap every replica of the batch
+        (never leak actors whose health was not confirmed)."""
+        import ray_tpu as rt
+
+        fresh = [
+            self._start_replica(
+                cfg["cls_blob"], cfg["init_args"], cfg["init_kwargs"],
+                cfg["resources"], cfg["max_concurrent_queries"],
+            )
+            for _ in range(n)
+        ]
+        try:
+            rt.get([r.health.remote() for r in fresh], timeout=300)
+            if cfg.get("user_config") is not None:
+                rt.get([r.reconfigure.remote(cfg["user_config"])
+                        for r in fresh], timeout=300)
+        except Exception:
+            for r in fresh:
+                try:
+                    rt.kill(r)
+                except Exception:  # noqa: BLE001
+                    pass
+            raise
+        return fresh
+
+    def _drain_and_kill(self, replicas: list):
+        """Gracefully retire replicas that are no longer published: wait
+        for their in-flight requests to finish, then kill — in the
+        background so deploys/autoscaling don't block on slow requests."""
+        import ray_tpu as rt
+
+        if not replicas:
+            return
+
+        def _idle_twice(r) -> bool:
+            """num_inflight counts only requests that entered
+            handle_request — a request can sit in the actor's mailbox
+            between a decrement and the next increment. Two zero reads
+            with a gap bound that window: a queued request starts
+            executing (and counts) well within the gap."""
+            if rt.get(r.num_inflight.remote(), timeout=10) > 0:
+                return False
+            time.sleep(0.25)
+            return rt.get(r.num_inflight.remote(), timeout=10) == 0
+
+        def _drain():
+            time.sleep(self.DRAIN_SETTLE_S)
+            deadline = time.time() + self.DRAIN_TIMEOUT_S
+            pending = list(replicas)
+            while pending and time.time() < deadline:
+                still = []
+                for r in pending:
+                    try:
+                        idle = _idle_twice(r)
+                    except rt.RayActorError:
+                        continue  # already dead — nothing to kill
+                    except Exception:  # noqa: BLE001 — busy/slow reply:
+                        still.append(r)  # NOT dead; keep until idle/deadline
+                        continue
+                    if not idle:
+                        still.append(r)
+                        continue
                     try:
                         rt.kill(r)
                     except Exception:  # noqa: BLE001
                         pass
-        return num_replicas
+                pending = still
+                if pending:
+                    time.sleep(0.1)
+            for r in pending:  # drain timeout: kill regardless
+                try:
+                    rt.kill(r)
+                except Exception:  # noqa: BLE001
+                    pass
+
+        threading.Thread(target=_drain, daemon=True).start()
 
     def _start_replica(self, cls_blob, init_args, init_kwargs, resources,
                        max_concurrent_queries):
@@ -266,11 +436,8 @@ class _Controller:
             victims = d["replicas"][desired:]
             d["replicas"] = d["replicas"][:desired]
             self._publish(name)
-            for r in victims:
-                try:
-                    rt.kill(r)
-                except Exception:  # noqa: BLE001
-                    pass
+            # same zero-drop contract as redeploys: drain, then kill
+            self._drain_and_kill(victims)
 
 
 # ---------------- driver-side API ----------------
@@ -335,7 +502,7 @@ class Deployment:
 
     def __init__(self, cls, *, num_replicas=1, max_concurrent_queries=8,
                  resources=None, name=None, route_prefix=None,
-                 autoscaling_config=None):
+                 autoscaling_config=None, user_config=None):
         self._cls = cls
         self.num_replicas = num_replicas
         self.max_concurrent_queries = max_concurrent_queries
@@ -343,6 +510,7 @@ class Deployment:
         self.name = name or cls.__name__
         self.route_prefix = route_prefix
         self.autoscaling_config = autoscaling_config
+        self.user_config = user_config
 
     def options(self, **kw) -> "Deployment":
         merged = {
@@ -352,6 +520,7 @@ class Deployment:
             "name": self.name,
             "route_prefix": self.route_prefix,
             "autoscaling_config": self.autoscaling_config,
+            "user_config": self.user_config,
         }
         merged.update(kw)
         return Deployment(self._cls, **merged)
@@ -376,7 +545,8 @@ def deployment(_cls=None, **kw):
 
 
 def run(dep: Deployment, *, name: str | None = None, init_args=(),
-        init_kwargs=None, version: str = "1") -> "DeploymentHandle":
+        init_kwargs=None, version: str = "1",
+        user_config: dict | None = None) -> "DeploymentHandle":
     """Deploy (or redeploy) and return a handle."""
     from ray_tpu._private import serialization
 
@@ -391,6 +561,7 @@ def run(dep: Deployment, *, name: str | None = None, init_args=(),
             dep.resources,
             dep.route_prefix or f"/{name}",
             dep.autoscaling_config,
+            user_config if user_config is not None else dep.user_config,
         ),
         timeout=600,
     )

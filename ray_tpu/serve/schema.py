@@ -1,0 +1,178 @@
+"""Declarative Serve config (reference python/ray/serve/schema.py +
+dashboard/modules/serve REST deploy, scaled to this framework).
+
+A config file (YAML or JSON) describes applications and per-deployment
+overrides; `apply()` makes the running cluster match it. Deployment classes
+are named by ``import_path`` ("pkg.module:attr") and resolved in the
+calling process, like the reference's build/deploy flow.
+
+Example::
+
+    applications:
+      - name: app1
+        route_prefix: /app1
+        import_path: my_service:Model
+        version: "2"
+        deployments:
+          - name: Model
+            num_replicas: 3
+            max_concurrent_queries: 16
+"""
+
+from __future__ import annotations
+
+import importlib
+from dataclasses import dataclass, field
+from typing import Any
+
+from ray_tpu.serve import api as serve_api
+
+
+@dataclass
+class DeploymentSchema:
+    name: str
+    num_replicas: int | None = None
+    max_concurrent_queries: int | None = None
+    resources: dict | None = None
+    autoscaling_config: dict | None = None
+    user_config: dict | None = None
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DeploymentSchema":
+        known = {f for f in cls.__dataclass_fields__}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"unknown deployment config keys: {sorted(unknown)}")
+        if "name" not in d:
+            raise ValueError("deployment config requires a 'name'")
+        return cls(**d)
+
+
+@dataclass
+class ApplicationSchema:
+    name: str
+    import_path: str
+    route_prefix: str | None = None
+    version: str = "1"
+    init_args: list = field(default_factory=list)
+    init_kwargs: dict = field(default_factory=dict)
+    deployments: list[DeploymentSchema] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ApplicationSchema":
+        d = dict(d)
+        if "import_path" not in d:
+            raise ValueError(
+                f"application {d.get('name', '?')!r} requires 'import_path'")
+        if ":" not in d["import_path"]:
+            raise ValueError(
+                "import_path must look like 'module.sub:attribute', got "
+                f"{d['import_path']!r}")
+        deps = [DeploymentSchema.from_dict(x)
+                for x in d.pop("deployments", [])]
+        d.setdefault("name", d["import_path"].split(":")[-1])
+        known = {f for f in cls.__dataclass_fields__}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"unknown application config keys: {sorted(unknown)}")
+        return cls(deployments=deps, **d)
+
+    def resolve(self):
+        """Import the target Deployment (or plain class)."""
+        mod_name, _, attr = self.import_path.partition(":")
+        obj = importlib.import_module(mod_name)
+        for part in attr.split("."):
+            obj = getattr(obj, part)
+        if isinstance(obj, serve_api.Deployment):
+            return obj
+        if isinstance(obj, type):
+            return serve_api.Deployment(obj)
+        raise TypeError(
+            f"{self.import_path} resolved to {type(obj).__name__}; expected "
+            "a @serve.deployment or a class")
+
+
+@dataclass
+class ServeConfigSchema:
+    applications: list[ApplicationSchema]
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ServeConfigSchema":
+        apps = d.get("applications")
+        if not isinstance(apps, list) or not apps:
+            raise ValueError("config requires a non-empty 'applications' list")
+        parsed = [ApplicationSchema.from_dict(a) for a in apps]
+        names = [a.name for a in parsed]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate application names: {names}")
+        return cls(applications=parsed)
+
+    @classmethod
+    def from_file(cls, path: str) -> "ServeConfigSchema":
+        import json
+
+        with open(path) as f:
+            text = f.read()
+        if path.endswith(".json"):
+            data = json.loads(text)
+        else:
+            import yaml
+
+            data = yaml.safe_load(text)
+        return cls.from_dict(data)
+
+
+def apply(config: ServeConfigSchema | dict | str) -> list[str]:
+    """Deploy every application in the config; returns deployed names.
+
+    Redeploys roll per the controller's versioned rolling-update path, so
+    applying an updated config to a live cluster drops no requests.
+    """
+    if isinstance(config, str):
+        config = ServeConfigSchema.from_file(config)
+    elif isinstance(config, dict):
+        config = ServeConfigSchema.from_dict(config)
+    deployed = []
+    for app in config.applications:
+        dep = app.resolve()
+        if len(app.deployments) > 1:
+            # one application == one deployment here; a silent drop of the
+            # extra blocks would be worse than an error
+            raise ValueError(
+                f"application {app.name!r} lists {len(app.deployments)} "
+                "deployment blocks; exactly one is supported")
+        overrides: dict[str, Any] = {}
+        if app.deployments:
+            ov = app.deployments[0]
+            if ov.num_replicas is not None:
+                overrides["num_replicas"] = ov.num_replicas
+            if ov.max_concurrent_queries is not None:
+                overrides["max_concurrent_queries"] = ov.max_concurrent_queries
+            if ov.resources is not None:
+                overrides["resources"] = ov.resources
+            if ov.autoscaling_config is not None:
+                overrides["autoscaling_config"] = ov.autoscaling_config
+            if ov.user_config is not None:
+                overrides["user_config"] = ov.user_config
+        if app.route_prefix:
+            overrides["route_prefix"] = app.route_prefix
+        dep = dep.options(**overrides) if overrides else dep
+        serve_api.run(
+            dep, name=app.name, init_args=tuple(app.init_args),
+            init_kwargs=app.init_kwargs, version=app.version,
+        )
+        deployed.append(app.name)
+    return deployed
+
+
+def status() -> dict:
+    """Running deployments (reference `serve status`)."""
+    import ray_tpu
+
+    try:
+        c = serve_api._controller()
+    except ValueError:
+        return {}
+    return ray_tpu.get(c.list_deployments.remote(), timeout=60)
