@@ -40,8 +40,8 @@ _DEFS: dict[str, Any] = {
     "put_pressure_retry_s": 10.0,
     "fetch_retry_timeout_s": 60.0,
     # -- pallas kernels --
-    "flash_block_q": 256,   # v5e-tuned (see ops/flash_attention.py)
-    "flash_block_k": 1024,
+    "flash_block_q": 1024,  # v5e-tuned round 3: fewer, bigger grid cells
+    "flash_block_k": 1024,  # win — per-cell overhead dominates at T=2048
     # -- memory monitor --
     "memory_monitor_interval_s": 2.0,
     "memory_usage_kill_fraction": 0.95,  # memory_monitor.h:52 analog

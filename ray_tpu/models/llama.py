@@ -249,18 +249,29 @@ def _attn_out_and_mlp(cfg: LlamaConfig, p, h, o):
     x = rms_norm(h, p["mlp_norm"], cfg.rms_eps)
     if cfg.n_experts > 0:
         return h + _moe_mlp(cfg, p, x)
-    gate = x @ p["w_gate"].astype(cdt)
-    up = x @ p["w_up"].astype(cdt)
+    from jax.ad_checkpoint import checkpoint_name
+
+    # policy-addressable: "dots_flash_qkv_mlp" saves the two widest
+    # activations so the backward skips the gate/up matmul recomputes
+    gate = checkpoint_name(x @ p["w_gate"].astype(cdt), "mlp_gate")
+    up = checkpoint_name(x @ p["w_up"].astype(cdt), "mlp_up")
     y = (jax.nn.silu(gate) * up) @ p["w_down"].astype(cdt)
     return h + shard_constraint(y, ("batch", "seq", "embed"))
 
 
 def _layer(cfg: LlamaConfig, h, layer_params, sin, cos):
     """One pre-norm transformer block. h: [B, T, D] in compute dtype."""
+    from jax.ad_checkpoint import checkpoint_name
+
     p = layer_params
     q, k, v = _qkv(cfg, p, h, sin, cos)
     q = shard_constraint(q, ("batch", "seq", "heads", "head_dim"))
     k = shard_constraint(k, ("batch", "seq", "kv_heads", "head_dim"))
+    # policy-addressable: "dots_flash_qkv" saves these so the flash
+    # backward's q/k/v inputs skip the qkv-projection recompute
+    q = checkpoint_name(q, "qkv_q")
+    k = checkpoint_name(k, "qkv_k")
+    v = checkpoint_name(v, "qkv_v")
     o = attention(q, k, v, causal=True, use_flash=cfg.use_flash)
     return _attn_out_and_mlp(cfg, p, h, o)
 
@@ -300,12 +311,36 @@ def forward(params, tokens, cfg: LlamaConfig, *, positions=None):
                     "flash_out", "flash_lse"
                 ),
             )
+        elif cfg.remat_policy == "dots_flash_qkv":
+            # + the rotary'd q/k/v: the flash backward consumes them
+            # directly, so saving them skips the qkv-projection recompute
+            # (~3/12 of the per-layer matmul FLOPs) for ~3*B*T*D*H bytes
+            # per layer.
+            policy = jax.checkpoint_policies.save_from_both_policies(
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                jax.checkpoint_policies.save_only_these_names(
+                    "flash_out", "flash_lse", "qkv_q", "qkv_k", "qkv_v"
+                ),
+            )
+        elif cfg.remat_policy == "dots_flash_qkv_mlp":
+            # + the two widest MLP activations: skips the gate/up matmul
+            # recomputes too (~8.5/12 of per-layer matmul FLOPs saved
+            # overall) — the max-HBM, min-recompute point short of
+            # remat=False.
+            policy = jax.checkpoint_policies.save_from_both_policies(
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                jax.checkpoint_policies.save_only_these_names(
+                    "flash_out", "flash_lse", "qkv_q", "qkv_k", "qkv_v",
+                    "mlp_gate", "mlp_up"
+                ),
+            )
         elif cfg.remat_policy == "nothing":
             policy = None  # full remat: only layer inputs survive
         else:
             raise ValueError(
                 f"unknown remat_policy {cfg.remat_policy!r}; expected "
-                "'dots', 'dots_flash', or 'nothing'"
+                "'dots', 'dots_flash', 'dots_flash_qkv', "
+                "'dots_flash_qkv_mlp', or 'nothing'"
             )
         layer_fn = jax.checkpoint(layer_fn, policy=policy)
 

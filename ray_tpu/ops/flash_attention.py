@@ -27,8 +27,9 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ray_tpu.utils.math import cdiv
 
-DEFAULT_BLOCK_Q = 256  # measured on v5e: b8xT2048xh8xd128 fwd 4.2ms vs
-DEFAULT_BLOCK_K = 1024  # 5.5ms at bq=512 (full-row k tiles)
+# Runtime block-size defaults live in _private/config.py (flash_block_q/_k,
+# env-overridable); round-3 v5e measurement: bq=1024 with a full-row k tile
+# wins at T=2048 — per-grid-cell overhead dominates, fewer/bigger cells win.
 # Up to this sequence length the kernels take the whole row/column as one
 # inner tile: per-block overhead and dead-block DMA cost more than the
 # causal-flop saving at short-to-medium T (measured on v5e: full-row
@@ -36,6 +37,7 @@ DEFAULT_BLOCK_K = 1024  # 5.5ms at bq=512 (full-row k tiles)
 _FULL_INNER_MAX = 2048
 _BWD_INNER = 1024  # min tile width along each bwd kernel's inner grid dim
 _NEG_INF = -1e30
+_LOG2E = 1.4426950408889634
 
 
 def _causal_mask(s, q_start, k_start, offset):
@@ -80,12 +82,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, c
     def _compute(masked: bool):
         # Matmul operands stay in the input dtype (bf16 hits the MXU's native
         # mode; f32 operands would run at a fraction of peak); accumulation
-        # and all softmax statistics are f32.
+        # and all softmax statistics are f32 — in LOG2 domain: exp2 is the
+        # VPU primitive, so scale*log2e folds into the one post-dot multiply
+        # and the natural-log path's extra per-element pass disappears.
         q = q_ref[0, 0]  # [bq, d]
         k = k_ref[0, 0]  # [bk, d]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # [bq, bk]
+        ) * (scale * _LOG2E)  # [bq, bk], log2 domain
         if masked:
             s = _causal_mask(s, q_start, k_start, offset)
 
@@ -94,14 +98,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, c
         m_new = jnp.maximum(m_prev, m_cur)
         if masked:
             # Rows whose every key is masked (possible when T > S under
-            # causal) keep m_new at _NEG_INF; exp(s - m_new) would be
-            # exp(0) = 1 there, so force p to 0 on dead rows.
+            # causal) keep m_new at _NEG_INF; exp2(s - m_new) would be
+            # exp2(0) = 1 there, so force p to 0 on dead rows.
             p = jnp.where(
-                m_new > _NEG_INF * 0.5, jnp.exp(s - m_new), 0.0
+                m_new > _NEG_INF * 0.5, jnp.exp2(s - m_new), 0.0
             )  # [bq, bk]
         else:
-            p = jnp.exp(s - m_new)
-        corr = jnp.exp(m_prev - m_new)  # [bq, 1]
+            p = jnp.exp2(s - m_new)
+        corr = jnp.exp2(m_prev - m_new)  # [bq, 1]
         l_new = corr * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
 
         v = v_ref[0, 0]  # [bk, d]
@@ -130,10 +134,54 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, c
         l = l_scr[:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
-        # Rows that attend nothing (only possible when T > S under causal)
-        # get lse = +LARGE so the backward's exp(s - lse) underflows to 0.
-        lse = jnp.where(l == 0.0, -_NEG_INF, m_scr[:, :1] + jnp.log(l_safe))
+        # lse is exposed in NATURAL log (public residual contract); the
+        # kernel's m statistic is log2-domain, so convert: ln Z =
+        # (m2 + log2 l) * ln2. Rows that attend nothing (only possible
+        # when T > S under causal) get lse = +LARGE so the backward's
+        # exp2(s - lse*log2e) underflows to 0.
+        lse = jnp.where(
+            l == 0.0, -_NEG_INF,
+            (m_scr[:, :1] + jnp.log2(l_safe)) * (1.0 / _LOG2E),
+        )
         lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
+
+
+def _fwd_kernel_1pass(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal,
+                      scale, block_q, offset):
+    """Whole k row in one tile (nk == 1): plain softmax, no online-update
+    machinery — no scratch init/finalize, no running max/corr passes.
+    The common short-to-medium-T case."""
+    iq = pl.program_id(2)
+    q_start = iq * block_q
+
+    def _compute(masked: bool):
+        q = q_ref[0, 0]  # [bq, d]
+        k = k_ref[0, 0]  # [s, d]
+        s_ = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * (scale * _LOG2E)  # log2 domain
+        if masked:
+            s_ = _causal_mask(s_, q_start, 0, offset)
+        m = jnp.max(s_, axis=-1, keepdims=True)
+        if masked:
+            p = jnp.where(m > _NEG_INF * 0.5, jnp.exp2(s_ - m), 0.0)
+        else:
+            p = jnp.exp2(s_ - m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        v = v_ref[0, 0]
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        o_ref[0, 0] = (pv / l_safe).astype(o_ref.dtype)
+        lse = jnp.where(
+            l == 0.0, -_NEG_INF, (m + jnp.log2(l_safe)) * (1.0 / _LOG2E))
+        lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
+
+    # every tile in a causal single-pass row straddles the diagonal
+    _compute(masked=causal)
 
 
 def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
@@ -151,50 +199,64 @@ def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
             f"({block_q}, {block_k}); pad inputs or use attention()."
         )
     scale = d ** -0.5
-    grid = (b, hq, cdiv(t, block_q), cdiv(s, block_k))
+    nk = cdiv(s, block_k)
+    grid = (b, hq, cdiv(t, block_q), nk)
 
-    kernel = functools.partial(
-        _fwd_kernel, causal=causal, scale=scale, block_q=block_q,
-        block_k=block_k, offset=s - t,
-    )
+    if nk == 1:
+        kernel = functools.partial(
+            _fwd_kernel_1pass, causal=causal, scale=scale,
+            block_q=block_q, offset=s - t,
+        )
+        grid = grid[:3]
+        scratch = []
+    else:
+        kernel = functools.partial(
+            _fwd_kernel, causal=causal, scale=scale, block_q=block_q,
+            block_k=block_k, offset=s - t,
+        )
+        scratch = [
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running max m
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running denom l
+            pltpu.VMEM((block_q, d), jnp.float32),  # output accumulator
+        ]
+
+    # grid is (b, h, q) single-pass or (b, h, q, k) tiled
+    def q_idx(bi, hi, qi, *k):
+        return (bi, hi, qi, 0)
+
+    def kv_idx(bi, hi, qi, *k):
+        return (bi, hi // group, k[0] if k else 0, 0)
+
+    o_idx = q_idx
+
     out, lse4 = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            pl.BlockSpec(
-                (1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)
-            ),
-            pl.BlockSpec(
-                (1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)
-            ),
+            pl.BlockSpec((1, 1, block_q, d), q_idx),
+            pl.BlockSpec((1, 1, block_k, d), kv_idx),
+            pl.BlockSpec((1, 1, block_k, d), kv_idx),
         ],
         out_specs=[
-            pl.BlockSpec(
-                (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
-            ),
+            pl.BlockSpec((1, 1, block_q, d), o_idx),
             # lse is written 8-lane-replicated: mosaic requires the last
             # block dim be a multiple of 128 or the full array dim, so a
             # packed [B, H, T] output can't be blocked per-head; 8 lanes is
-            # the narrowest legal layout (16x less HBM than 128).
-            pl.BlockSpec(
-                (1, 1, block_q, 8), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
-            ),
+            # the narrowest legal layout (16x less HBM than 128); a lane-
+            # major [8, bq] tile measured WORSE (the in-kernel sublane->
+            # lane transpose outcosts the narrow DMA).
+            pl.BlockSpec((1, 1, block_q, 8), o_idx),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
             jax.ShapeDtypeStruct((b, hq, t, 8), jnp.float32),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((block_q, 128), jnp.float32),  # running max m
-            pltpu.VMEM((block_q, 128), jnp.float32),  # running denom l
-            pltpu.VMEM((block_q, d), jnp.float32),  # output accumulator
-        ],
+        scratch_shapes=scratch,
         # b/head/q rows are independent -> mosaic may pipeline them; only
         # the innermost k dim carries scratch state.
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel",
-                                 "arbitrary"),
+            dimension_semantics=("parallel",) * len(grid[:3])
+            + (("arbitrary",) if nk > 1 else ()),
         ),
         interpret=interpret,
     )(q, k, v)
@@ -318,15 +380,195 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, o, lse, do, *, causal, block_q, block_k, interpret):
-    """Two kernels with independently tuned tile shapes.
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse2_ref, delta_ref,
+                      dqp_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                      causal, scale, block_q, block_k, offset):
+    """Fused dq/dk/dv backward: grid (b, hq, ik, iq), iq innermost.
 
-    The dq kernel iterates k innermost, so it wants wide k tiles (fewer grid
-    steps, bigger contractions); the dkv kernel iterates q innermost and wants
-    wide q tiles. The caller's (block_q, block_k) seed the *outer* tile of
-    each kernel; the inner tile is widened to the sequence length capped at
-    _BWD_INNER.
+    The classic two-kernel split (dq with k inner, dkv with q inner) pays
+    for s, p and dp TWICE — 7 MXU dots and 2 softmax recomputes per tile
+    pair. Fused, each (q, k) tile is visited once: 5 dots, 1 exp2 pass.
+    dk/dv accumulate in VMEM scratch across the inner iq loop; dq would
+    have to accumulate across the OUTER ik loop, so each ik writes an f32
+    partial ([nk, B, H, T, D]) that the wrapper sums — sequential-grid
+    TPU's answer to the atomics a GPU would use here.
+
+    Softmax statistics ride in log2 domain: s2 = (q@k^T)*(scale*log2e),
+    p = exp2(s2 - lse*log2e) — exp2 is the VPU primitive, so the natural-
+    log path's extra per-element multiply disappears.
     """
+    ik = pl.program_id(2)
+    iq = pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q_start = iq * block_q
+    k_start = ik * block_k
+
+    def _compute(masked: bool):
+        q = q_ref[0, 0]  # [bq, d], input dtype (MXU-native)
+        k = k_ref[0, 0]  # [bk, d]
+        v = v_ref[0, 0]  # [bk, d]
+        do = do_ref[0, 0]  # [bq, d]
+        lse2 = jnp.expand_dims(lse2_ref[0, 0, 0], -1)  # [bq, 1] f32, log2
+        delta = jnp.expand_dims(delta_ref[0, 0, 0], -1)  # [bq, 1] f32
+
+        s2 = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * (scale * _LOG2E)  # [bq, bk], log2 domain
+        if masked:
+            s2 = _causal_mask(s2, q_start, k_start, offset)
+        p = jnp.exp2(s2 - lse2)  # [bq, bk] f32
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # p^T @ do -> [bk, d]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk]
+        ds = (p * (dp - delta) * scale).astype(q.dtype)  # [bq, bk]
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # ds^T @ q -> [bk, d]
+        dqp_ref[0, 0, 0] = jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(dqp_ref.dtype)  # [bq, d] partial
+
+    live = _block_live(causal, q_start, k_start, block_q, offset)
+    if causal:
+        straddle = _straddles(q_start, k_start, block_k, offset)
+        pl.when(jnp.logical_and(live, straddle))(
+            lambda: _compute(masked=True)
+        )
+        pl.when(jnp.logical_and(live, jnp.logical_not(straddle)))(
+            lambda: _compute(masked=False)
+        )
+        # dead tile: its dq partial still must be defined
+        pl.when(jnp.logical_not(live))(
+            lambda: dqp_ref.__setitem__(
+                (0, 0, 0), jnp.zeros_like(dqp_ref[0, 0, 0]))
+        )
+    else:
+        pl.when(live)(lambda: _compute(masked=False))
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+# Above this many dq partials the fused kernel's [nk, B, H, T, D]
+# side-array outgrows its win; fall back to the two-kernel path.
+_MAX_DQ_PARTIALS = 8
+
+
+def _fused_blocks(t: int, s: int, block_q: int, block_k: int):
+    """The fused backward's tile shape, or None when ineligible — the ONE
+    place this is computed, so the gate and the kernel can't disagree."""
+    bq = min(block_q, t, 1024)
+    bk = min(max(block_k, 512), s, 1024)
+    while bq * bk > 1024 * 1024:  # [bq, bk] f32 tiles dominate VMEM
+        bq //= 2
+    if t % bq or s % bk or cdiv(s, bk) > _MAX_DQ_PARTIALS:
+        return None
+    return bq, bk
+
+
+def _flash_bwd_fused(q, k, v, o, lse, do, *, causal, block_q, block_k,
+                     interpret):
+    b, hq, t, d = q.shape
+    _, hkv, s, _ = k.shape
+    group = hq // hkv
+    scale = d ** -0.5
+    offset = s - t
+
+    blocks = _fused_blocks(t, s, block_q, block_k)
+    assert blocks is not None, "caller gates on _fused_blocks"
+    block_q, block_k = blocks
+    nq, nk = cdiv(t, block_q), cdiv(s, block_k)
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    lse2 = lse * _LOG2E  # natural-log residual -> log2 domain
+    lse2_r = lse2[:, :, None, :]
+    delta_r = delta[:, :, None, :]
+
+    def row_spec(block, index):
+        return pl.BlockSpec((1, 1, 1, block), index)
+
+    dqp, dk_full, dv_full = pl.pallas_call(
+        functools.partial(
+            _bwd_fused_kernel, causal=causal, scale=scale,
+            block_q=block_q, block_k=block_k, offset=offset,
+        ),
+        grid=(b, hq, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki, qi: (bi, hi // group, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki, qi: (bi, hi // group, ki, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            row_spec(block_q, lambda bi, hi, ki, qi: (bi, hi, 0, qi)),
+            row_spec(block_q, lambda bi, hi, ki, qi: (bi, hi, 0, qi)),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (1, 1, 1, block_q, d),
+                lambda bi, hi, ki, qi: (ki, bi, hi, qi, 0),
+            ),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+        ],
+        out_shape=[
+            # partials ride in the INPUT dtype: f32 inputs keep exact
+            # accumulation, bf16 training halves the side-array traffic
+            # (each partial is itself an f32 MXU accumulation)
+            jax.ShapeDtypeStruct((nk, b, hq, t, d), q.dtype),
+            jax.ShapeDtypeStruct((b, hq, s, d), k.dtype),
+            jax.ShapeDtypeStruct((b, hq, s, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v, do, lse2_r, delta_r)
+
+    dq = jnp.sum(dqp.astype(jnp.float32), axis=0).astype(q.dtype)
+    if group > 1:
+        dk = dk_full.reshape(b, hkv, group, s, d).sum(axis=2).astype(k.dtype)
+        dv = dv_full.reshape(b, hkv, group, s, d).sum(axis=2).astype(v.dtype)
+    else:
+        dk, dv = dk_full, dv_full
+    return dq, dk, dv
+
+
+def _flash_bwd(q, k, v, o, lse, do, *, causal, block_q, block_k, interpret):
+    """Fused single-pass backward when the dq-partial side array is small
+    enough (the common case); otherwise two kernels with independently
+    tuned tile shapes.
+
+    Legacy path: the dq kernel iterates k innermost, so it wants wide k
+    tiles (fewer grid steps, bigger contractions); the dkv kernel iterates
+    q innermost and wants wide q tiles. The caller's (block_q, block_k)
+    seed the *outer* tile of each kernel; the inner tile is widened to the
+    sequence length capped at _BWD_INNER.
+    """
+    if _fused_blocks(q.shape[2], k.shape[2], block_q, block_k) is not None:
+        return _flash_bwd_fused(
+            q, k, v, o, lse, do, causal=causal, block_q=block_q,
+            block_k=block_k, interpret=interpret,
+        )
     b, hq, t, d = q.shape
     _, hkv, s, _ = k.shape
     group = hq // hkv

@@ -1,0 +1,128 @@
+"""Fused optimizers for TPU HBM efficiency.
+
+optax.adamw chains scale_by_adam -> add_decayed_weights -> scale, and XLA
+does not collapse the chain into one pass over the parameters: measured on
+v5e at 350M params the chain costs ~20 ms/step against an ~11 ms HBM
+round-trip bound. `fused_adamw` computes the whole update (moments, bias
+correction, weight decay, parameter write) in ONE tree_map whose per-leaf
+ops fuse into a single HBM pass.
+
+Same math as optax.adamw(lr, b1, b2, eps, weight_decay, mu_dtype): the
+update tests assert trajectory parity against optax. Reference framework
+has no TPU optimizer layer (torch optimizers, reference
+python/ray/train/torch/); this is framework-native.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class FusedAdamWState(NamedTuple):
+    count: jax.Array  # int32 step counter
+    mu: optax.Updates  # first moment (optionally low precision)
+    nu: optax.Updates  # second moment (optionally low precision)
+
+
+def _stochastic_round_bf16(x32: jax.Array, key) -> jax.Array:
+    """f32 -> bf16 with stochastic rounding.
+
+    A plain truncating cast FREEZES slow EMAs stored in bf16: with
+    b2=0.999 the per-step relative change (~1e-3) is below bf16's ~4e-3
+    ulp, so round-to-nearest returns the old value forever. Adding a
+    uniform 16-bit dither to the dropped mantissa bits before truncation
+    makes the rounding unbiased — the EMA drifts correctly in expectation
+    (the standard trick for bf16 optimizer states on TPU).
+    """
+    bits = jax.lax.bitcast_convert_type(x32, jnp.uint32)
+    # Dither source: a 2-round integer hash of (element index, step seed).
+    # Crypto-grade bits are overkill for rounding dither, and threefry /
+    # RngBitGenerator over 350M elements costs real step time (~0.5pp MFU
+    # measured); fmix32-style mixing is a few fused VPU int-ops and passes
+    # the unbiasedness test to 4 digits.
+    idx = jax.lax.iota(jnp.uint32, x32.size).reshape(x32.shape)
+    h = idx * jnp.uint32(2654435761) + key
+    h = (h ^ (h >> 15)) * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    rounded = bits + (h & jnp.uint32(0xFFFF))
+    return jax.lax.bitcast_convert_type(
+        (rounded >> 16).astype(jnp.uint16), jnp.bfloat16)
+
+
+def fused_adamw(
+    learning_rate: float | optax.Schedule = 1e-3,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 1e-4,
+    mu_dtype=None,
+    nu_dtype=None,
+) -> optax.GradientTransformation:
+    """Drop-in for optax.adamw, one fused HBM pass per parameter leaf.
+
+    nu_dtype=bfloat16 halves the second-moment HBM traffic; the sqrt(nu)
+    denominator then carries ~8 mantissa bits (an effective ±0.4% lr
+    jitter), an accepted memory/precision trade the same way mu_dtype is.
+    """
+
+    def init(params):
+        mu = jax.tree_util.tree_map(
+            lambda p: jnp.zeros_like(p, dtype=mu_dtype or p.dtype), params)
+        nu = jax.tree_util.tree_map(
+            lambda p: jnp.zeros_like(p, dtype=nu_dtype or jnp.float32),
+            params)
+        return FusedAdamWState(jnp.zeros((), jnp.int32), mu, nu)
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused_adamw needs params (weight decay)")
+        count = state.count + 1
+        # optax evaluates schedules at the PRE-increment count (0-based
+        # first step); bias correction is 1-based. Match both.
+        lr = (learning_rate(state.count) if callable(learning_rate)
+              else learning_rate)
+        c1 = 1.0 - b1 ** count.astype(jnp.float32)
+        c2 = 1.0 - b2 ** count.astype(jnp.float32)
+
+        def _store(x32, dtype, key):
+            # Slow EMAs (b2=0.999) stored in bf16 need stochastic
+            # rounding or they freeze (see _stochastic_round_bf16); the
+            # fast mu EMA (b1=0.9, ~10%/step updates) truncates fine.
+            if dtype == jnp.bfloat16 and key is not None:
+                return _stochastic_round_bf16(x32, key)
+            return x32.astype(dtype)
+
+        g_leaves, treedef = jax.tree_util.tree_flatten(grads)
+        m_leaves = treedef.flatten_up_to(state.mu)
+        n_leaves = treedef.flatten_up_to(state.nu)
+        p_leaves = treedef.flatten_up_to(params)
+
+        sr = any(n.dtype == jnp.bfloat16 for n in n_leaves)
+        keys = [None] * len(g_leaves)
+        if sr:
+            # per-leaf scalar seeds derived from the step counter
+            base = count.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+            keys = [base + jnp.uint32((i * 40503) % 2**16)
+                    for i in range(len(g_leaves))]
+
+        mu, nu, updates = [], [], []
+        for g, m, n, p, key in zip(g_leaves, m_leaves, n_leaves, p_leaves,
+                                   keys):
+            g32 = g.astype(jnp.float32)
+            m32 = m.astype(jnp.float32) * b1 + g32 * (1.0 - b1)
+            n32 = n.astype(jnp.float32) * b2 + jnp.square(g32) * (1.0 - b2)
+            upd = (m32 / c1) / (jnp.sqrt(n32 / c2) + eps) \
+                + weight_decay * p.astype(jnp.float32)
+            mu.append(m32.astype(m.dtype))
+            nu.append(_store(n32, n.dtype, key))
+            updates.append((-lr * upd).astype(p.dtype))
+
+        unflatten = treedef.unflatten
+        return unflatten(updates), FusedAdamWState(
+            count, unflatten(mu), unflatten(nu))
+
+    return optax.GradientTransformation(init, update)

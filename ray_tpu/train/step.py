@@ -123,10 +123,14 @@ def make_train_step(
     rules: LogicalRules = DEFAULT_RULES,
     *,
     donate_state: bool = True,
+    compute_grad_norm: bool = True,
 ):
     """Build the jitted SPMD train step: (state, batch) -> (state, metrics).
 
     loss_fn(params, batch) -> (scalar_loss, metrics_dict).
+    compute_grad_norm=False drops the grad_norm metric — its global_norm is
+    an extra full HBM pass over the gradient tree (~2 ms at 350M on v5e),
+    real money in a tight step when the caller doesn't log it.
     """
     scalar = NamedSharding(mesh, PartitionSpec())
 
@@ -136,8 +140,8 @@ def make_train_step(
         )
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
-        gnorm = optax.global_norm(grads)
-        metrics = dict(metrics, grad_norm=gnorm)
+        if compute_grad_norm:
+            metrics = dict(metrics, grad_norm=optax.global_norm(grads))
         return TrainState(state.step + 1, params, opt_state), metrics
 
     return jax.jit(

@@ -125,7 +125,8 @@ def test_remat_policies_agree(rng):
     tokens = jax.random.randint(rng, (2, 33), 0, 256)
     batch = {"tokens": tokens}
     grads = {}
-    for policy in ("dots", "dots_flash", "nothing"):
+    for policy in ("dots", "dots_flash", "dots_flash_qkv",
+                   "dots_flash_qkv_mlp", "nothing"):
         # use_flash=True: the flash kernel (interpret mode on CPU) must be
         # in the graph or the flash_out/flash_lse plumbing goes untested
         cfg = llama.LlamaConfig.tiny(

@@ -203,3 +203,59 @@ def test_cross_entropy_uniform(rng):
     loss, n = softmax_cross_entropy(logits, labels)
     np.testing.assert_allclose(float(loss), np.log(10), rtol=1e-6)
     assert int(n) == 28
+
+
+def test_flash_long_seq_multiblock_fwd(rng):
+    """S > _FULL_INNER_MAX forces the tiled online-softmax forward kernel
+    (log2-domain running max/corr) — unreachable at short S, where the
+    single-pass kernel runs instead."""
+    b, t, h, d = 1, 4096, 1, 32
+    k1, k2, k3 = jax.random.split(rng, 3)
+    q = jax.random.normal(k1, (b, t, h, d), jnp.float32)
+    k = jax.random.normal(k2, (b, t, h, d), jnp.float32)
+    v = jax.random.normal(k3, (b, t, h, d), jnp.float32)
+    want = attention_reference(q, k, v, causal=True)
+    got = flash_attention(q, k, v, causal=True, block_q=1024, block_k=1024,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=1e-4)
+
+    def f_ref(q, k, v):
+        return attention_reference(q, k, v, causal=True).sum()
+
+    def f_flash(q, k, v):
+        return flash_attention(q, k, v, causal=True, block_q=1024,
+                               block_k=1024, interpret=True).sum()
+
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_ref, g_flash):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=2e-4, rtol=1e-3)
+
+
+def test_flash_legacy_bwd_path_very_long_kv(rng):
+    """S large enough that the fused backward's dq-partial array is
+    ineligible (> _MAX_DQ_PARTIALS) — exercises the legacy two-kernel
+    backward, which otherwise has no reachable configuration."""
+    from ray_tpu.ops.flash_attention import _fused_blocks
+
+    b, t, s, h, d = 1, 256, 16384, 1, 32
+    assert _fused_blocks(t, s, 256, 1024) is None  # really the legacy path
+    k1, k2, k3 = jax.random.split(rng, 3)
+    q = jax.random.normal(k1, (b, t, h, d), jnp.float32)
+    k = jax.random.normal(k2, (b, s, h, d), jnp.float32)
+    v = jax.random.normal(k3, (b, s, h, d), jnp.float32)
+
+    def f_ref(q, k, v):
+        return attention_reference(q, k, v, causal=True).sum()
+
+    def f_flash(q, k, v):
+        return flash_attention(q, k, v, causal=True, block_q=256,
+                               block_k=1024, interpret=True).sum()
+
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_ref, g_flash):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=2e-4, rtol=1e-3)

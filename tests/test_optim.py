@@ -1,0 +1,100 @@
+"""fused_adamw: trajectory parity vs optax.adamw + low-precision moments.
+
+The fused optimizer exists for HBM efficiency (one pass per leaf vs
+optax's chain — see train/optim.py); these tests pin its MATH to optax's.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ray_tpu.train.optim import fused_adamw
+
+
+def _params():
+    k = jax.random.PRNGKey(0)
+    k1, k2 = jax.random.split(k)
+    return {
+        "w": jax.random.normal(k1, (16, 8)) * 0.1,
+        "inner": {"b": jax.random.normal(k2, (8,)) * 0.1},
+    }
+
+
+def _run(opt, params, grads, n=10):
+    state = opt.init(params)
+    upd = jax.jit(opt.update)
+    for _ in range(n):
+        updates, state = upd(grads, state, params)
+        params = optax.apply_updates(params, updates)
+    return params
+
+
+def test_matches_optax_adamw_f32():
+    params = _params()
+    grads = jax.tree_util.tree_map(lambda x: 0.05 * jnp.sin(x * 7), params)
+    p1 = _run(optax.adamw(3e-3, weight_decay=0.01), params, grads)
+    p2 = _run(fused_adamw(3e-3, weight_decay=0.01), params, grads)
+    for a, b in zip(jax.tree_util.tree_leaves(p1),
+                    jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("mu_dtype,nu_dtype", [
+    (jnp.bfloat16, None), (jnp.bfloat16, jnp.bfloat16)])
+def test_low_precision_moments_stay_close(mu_dtype, nu_dtype):
+    params = _params()
+    grads = jax.tree_util.tree_map(lambda x: 0.05 * jnp.cos(x * 3), params)
+    exact = _run(fused_adamw(3e-3, weight_decay=0.01), params, grads)
+    lowp = _run(fused_adamw(3e-3, weight_decay=0.01, mu_dtype=mu_dtype,
+                            nu_dtype=nu_dtype), params, grads)
+    for a, b in zip(jax.tree_util.tree_leaves(exact),
+                    jax.tree_util.tree_leaves(lowp)):
+        # moments in bf16 perturb the update by O(2^-8) relative
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=0.02, atol=1e-3)
+
+
+def test_schedule_and_weight_decay():
+    params = _params()
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+    sched = optax.linear_schedule(1e-2, 1e-3, 10)
+    p1 = _run(optax.adamw(sched, weight_decay=0.1), params, grads)
+    p2 = _run(fused_adamw(sched, weight_decay=0.1), params, grads)
+    for a, b in zip(jax.tree_util.tree_leaves(p1),
+                    jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_stochastic_round_unbiased():
+    from ray_tpu.train.optim import _stochastic_round_bf16
+
+    x = jnp.full((100_000,), 1.001953125e-3, jnp.float32)  # between ulps
+    means = []
+    for i in range(10):
+        key = jnp.uint32(i * 0x9E3779B9 % 2**32)
+        means.append(float(jnp.mean(
+            _stochastic_round_bf16(x, key).astype(jnp.float32))))
+    np.testing.assert_allclose(np.mean(means), float(x[0]), rtol=1e-4)
+
+
+def test_bf16_nu_ema_not_frozen():
+    """With b2=0.999 the per-step nu change is below bf16 ulp; a truncating
+    cast would freeze the EMA forever. Stochastic rounding must let it
+    decay at the true 0.999^n rate."""
+    opt = fused_adamw(1e-3, b2=0.999, nu_dtype=jnp.bfloat16)
+    params = {"w": jnp.ones((512,))}
+    state = opt.init(params)
+    upd = jax.jit(opt.update)
+    for _ in range(200):
+        _, state = upd({"w": jnp.full((512,), 1.0)}, state, params)
+    nu_big = float(jnp.mean(state.nu["w"].astype(jnp.float32)))
+    for _ in range(1000):
+        _, state = upd({"w": jnp.full((512,), 1e-3)}, state, params)
+    nu_small = float(jnp.mean(state.nu["w"].astype(jnp.float32)))
+    expected = nu_big * 0.999 ** 1000  # ~0.37x
+    assert nu_small < nu_big * 0.6, "nu EMA is stuck"
+    np.testing.assert_allclose(nu_small, expected, rtol=0.15)
