@@ -29,6 +29,9 @@ _DEFS: dict[str, Any] = {
     "spill_high_fraction": 0.8,          # spill primaries above this fill
     "spill_low_fraction": 0.5,           # ...until back under this
     "worker_register_timeout_s": 60.0,
+    # soft cap on non-actor worker processes per node; 0 = auto
+    # (max(4, 2*CPU)). See NodeAgent._pool_worker_cap.
+    "max_pool_workers_per_node": 0,
     # direct-task lease caching (direct_task_transport.h:110 analog)
     "worker_lease_ttl_s": 10.0,
     "worker_lease_enabled": True,

@@ -1,0 +1,197 @@
+"""Runtime microbenchmarks — the framework's `ray microbenchmark` analog.
+
+Reference: python/ray/_private/ray_perf.py:93 (benchmark list) +
+ray_microbenchmark_helpers.py:14 (timeit harness). Same workload families,
+sized for an in-process test cluster: task submit+get (1:1 sync, batched
+async, multi-client), actor calls (sync / async batch / async actors /
+n:n), put/get at 1 KB / 1 MB / 1 GB, wait over 1k refs, and a
+10k-queued-task drain.
+
+Run:  python -m ray_tpu._private.runtime_perf [--out RUNTIME_BENCH.json]
+Each result is one JSON line: {"name", "per_s", "unit"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+import ray_tpu
+
+
+def timeit(name: str, fn, multiplier: int = 1, *, windows: int = 3,
+           window_s: float = 1.0):
+    """Best-of-N-windows ops/sec (min wall time per op over windows)."""
+    fn()  # warmup / compile / worker spinup
+    # calibrate: how many calls fit one window
+    start = time.perf_counter()
+    count = 0
+    while time.perf_counter() - start < 0.3:
+        fn()
+        count += 1
+    per_window = max(1, int(count * window_s / 0.3))
+    best = 0.0
+    for _ in range(windows):
+        start = time.perf_counter()
+        for _ in range(per_window):
+            fn()
+        dt = time.perf_counter() - start
+        best = max(best, multiplier * per_window / dt)
+    return {"name": name, "per_s": round(best, 1), "unit": "ops/s"}
+
+
+@ray_tpu.remote(num_cpus=0)
+def _small_value():
+    return b"ok"
+
+
+@ray_tpu.remote(num_cpus=0)
+def _small_value_batch(n):
+    ray_tpu.get([_small_value.remote() for _ in range(n)], timeout=120)
+    return 0
+
+
+@ray_tpu.remote(num_cpus=0)
+def _noop(*_args):
+    return None
+
+
+@ray_tpu.remote(num_cpus=0)
+class _Actor:
+    def small_value(self):
+        return b"ok"
+
+    def small_value_arg(self, _x):
+        return b"ok"
+
+
+@ray_tpu.remote(num_cpus=0, max_concurrency=8)
+class _AsyncActor:
+    async def small_value(self):
+        return b"ok"
+
+
+def run_benchmarks(*, quick: bool = False) -> list[dict]:
+    results = []
+    windows = 1 if quick else 3
+
+    def bench(name, fn, multiplier=1):
+        r = timeit(name, fn, multiplier, windows=windows)
+        results.append(r)
+        print(json.dumps(r), flush=True)
+
+    # ---- put/get ----
+    kb = np.zeros(1024, dtype=np.uint8)
+    mb = np.zeros(1024 * 1024, dtype=np.uint8)
+
+    ref_small = ray_tpu.put(b"ok")
+    bench("single client get small", lambda: ray_tpu.get(ref_small))
+    bench("single client put small", lambda: ray_tpu.put(b"ok"))
+    bench("put 1KB", lambda: ray_tpu.put(kb))
+    bench("put 1MB", lambda: ray_tpu.put(mb))
+    ref_mb = ray_tpu.put(mb)
+    bench("get 1MB", lambda: ray_tpu.get(ref_mb))
+
+    gb = np.zeros(1024 * 1024 * 1024, dtype=np.uint8)
+
+    def put_get_gb():
+        r = ray_tpu.put(gb)
+        out = ray_tpu.get(r, timeout=120)
+        assert out.nbytes == gb.nbytes
+        del out
+        ray_tpu.free([r])
+
+    bench("put+get 1GB (GB/s)", put_get_gb, multiplier=1)
+
+    # ---- tasks ----
+    bench("single client tasks sync",
+          lambda: ray_tpu.get(_small_value.remote(), timeout=60))
+    bench("single client tasks async (batch 1000)",
+          lambda: ray_tpu.get(
+              [_small_value.remote() for _ in range(1000)], timeout=120),
+          multiplier=1000)
+    bench("multi client tasks async (4 clients x 250)",
+          lambda: ray_tpu.get(
+              [_small_value_batch.remote(250) for _ in range(4)],
+              timeout=120),
+          multiplier=1000)
+
+    # ---- wait ----
+    refs_1k = [ray_tpu.put(i) for i in range(1000)]
+    bench("wait on 1k refs",
+          lambda: ray_tpu.wait(refs_1k, num_returns=1000, timeout=60))
+
+    # ---- actors ----
+    a = _Actor.remote()
+    ray_tpu.get(a.small_value.remote(), timeout=60)
+    bench("1:1 actor calls sync",
+          lambda: ray_tpu.get(a.small_value.remote(), timeout=60))
+    bench("1:1 actor calls async (batch 1000)",
+          lambda: ray_tpu.get(
+              [a.small_value.remote() for _ in range(1000)], timeout=120),
+          multiplier=1000)
+    arg_ref = ray_tpu.put(0)
+    bench("1:1 actor calls with arg async (batch 1000)",
+          lambda: ray_tpu.get(
+              [a.small_value_arg.remote(arg_ref) for _ in range(1000)],
+              timeout=120),
+          multiplier=1000)
+
+    aa = _AsyncActor.remote()
+    ray_tpu.get(aa.small_value.remote(), timeout=60)
+    bench("1:1 async-actor calls async (batch 1000)",
+          lambda: ray_tpu.get(
+              [aa.small_value.remote() for _ in range(1000)], timeout=120),
+          multiplier=1000)
+
+    n_actors = 4
+    actors = [_Actor.remote() for _ in range(n_actors)]
+    ray_tpu.get([b.small_value.remote() for b in actors], timeout=60)
+    bench(f"1:n actor calls async (n={n_actors}, batch 250 each)",
+          lambda: ray_tpu.get(
+              [b.small_value.remote() for b in actors for _ in range(250)],
+              timeout=120),
+          multiplier=1000)
+
+    # ---- queued-task drain (reference 'tasks queued on a node') ----
+    def drain_10k():
+        refs = [_noop.remote() for _ in range(10_000)]
+        ray_tpu.get(refs, timeout=300)
+
+    t0 = time.perf_counter()
+    drain_10k()
+    dt = time.perf_counter() - t0
+    r = {"name": "10k queued task drain", "per_s": round(10_000 / dt, 1),
+         "unit": "tasks/s"}
+    results.append(r)
+    print(json.dumps(r), flush=True)
+
+    return results
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default=None, help="write results JSON here")
+    p.add_argument("--quick", action="store_true")
+    p.add_argument("--store-capacity", type=int,
+                   default=3 * 1024 * 1024 * 1024)  # fits the 1 GB put
+    args = p.parse_args(argv)
+
+    ray_tpu.init(num_cpus=8, object_store_memory=args.store_capacity)
+    try:
+        results = run_benchmarks(quick=args.quick)
+    finally:
+        ray_tpu.shutdown()
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"results": results,
+                       "ts": time.strftime("%Y-%m-%d")}, f, indent=2)
+    return results
+
+
+if __name__ == "__main__":
+    main()

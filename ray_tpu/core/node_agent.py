@@ -425,28 +425,97 @@ class NodeAgent:
         w.client = AsyncRpcClient(w.addr, w.port)
         await w.client.connect()
         w.ready.set()
+        self._signal_worker_free()
         return True
+
+    def _signal_worker_free(self):
+        """Wake _pop_worker waiters (a worker went idle / died / spawned)."""
+        ev = getattr(self, "_worker_free_ev", None)
+        if ev is not None:
+            ev.set()
+
+    def _pool_worker_cap(self) -> int:
+        """Soft cap on POOL (non-actor) worker processes per node —
+        reference worker_pool.h maximum_startup_concurrency analog. A
+        flood of zero-cpu tasks must queue for workers, not fork-storm
+        the host (observed: 1000 concurrent num_cpus=0 tasks spawning
+        375 processes). Actor workers are dedicated and exempt."""
+        cap = cfg.get("max_pool_workers_per_node")
+        if cap:
+            return int(cap)
+        return max(4, int(2 * self.resources_total.get("CPU", 2)))
+
+    _RESERVED = b"__spawn_reserved__"
 
     async def _pop_worker(self, job_id: bytes | None,
                           holds_tpu: bool = False,
-                          runtime_env: dict | None = None) -> WorkerHandle:
+                          runtime_env: dict | None = None, *,
+                          wait: bool = True) -> WorkerHandle | None:
         """Idle worker of the same job AND runtime env, else spawn
-        (worker_pool.h PopWorker; env mismatch forces a new process)."""
+        (worker_pool.h PopWorker; env mismatch forces a new process).
+        At the pool cap: evict an idle MISMATCHED worker to make room,
+        else wait for one to free (wait=False returns None instead — the
+        lease fast path must not camp on granted resources)."""
         want = _env_hash(runtime_env)
-        for w in self.workers.values():
-            if w.idle and w.ready.is_set() and w.job_id == job_id \
-                    and getattr(w, "env_hash", None) == want \
-                    and w.proc.poll() is None:
-                w.idle_since = time.monotonic()
+        deadline = time.monotonic() + cfg.get("worker_register_timeout_s")
+        if not hasattr(self, "_worker_free_ev"):
+            self._worker_free_ev = asyncio.Event()
+        while True:
+            for w in self.workers.values():
+                if w.idle and w.ready.is_set() and w.job_id == job_id \
+                        and getattr(w, "env_hash", None) == want \
+                        and w.proc.poll() is None:
+                    w.idle_since = time.monotonic()
+                    return w
+            n_pool = sum(1 for w in self.workers.values()
+                         if w.actor_id is None)
+            if n_pool >= self._pool_worker_cap():
+                # no matching idle worker and no room: evict the longest-
+                # idle MISMATCHED pool worker (job/env churn must not
+                # permanently starve new work — incl. idle TPU holders,
+                # whose cull exemption protects only their own job)
+                victims = [w for w in self.workers.values()
+                           if w.actor_id is None and w.idle
+                           and w.ready.is_set()]
+                if victims:
+                    self._kill_worker(min(victims,
+                                          key=lambda w: w.idle_since))
+                    n_pool -= 1
+            if n_pool < self._pool_worker_cap():
+                w = await self._spawn_worker(job_id, holds_tpu, runtime_env)
+                # reserve: rpc_register_executor fires the free event the
+                # moment `ready` is set, and an unreserved idle worker
+                # would be claimed by a waiter while we're still awaiting
+                w.busy_task = self._RESERVED
+                try:
+                    await asyncio.wait_for(
+                        w.ready.wait(),
+                        timeout=cfg.get("worker_register_timeout_s"),
+                    )
+                except asyncio.TimeoutError:
+                    # never registered (hung import/connect): reap it or
+                    # the dead handle pins a cap slot forever
+                    self._kill_worker(w)
+                    raise
                 return w
-        w = await self._spawn_worker(job_id, holds_tpu, runtime_env)
-        await asyncio.wait_for(
-            w.ready.wait(), timeout=cfg.get("worker_register_timeout_s")
-        )
-        return w
+            if not wait:
+                return None
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"no pool worker available within budget "
+                    f"(cap {self._pool_worker_cap()})")
+            # wait for a free signal, not a poll: hundreds of waiters
+            # polling starves the event loop
+            self._worker_free_ev.clear()
+            try:
+                await asyncio.wait_for(self._worker_free_ev.wait(),
+                                       timeout=0.2)
+            except asyncio.TimeoutError:
+                pass
 
     def _kill_worker(self, w: WorkerHandle):
         self.workers.pop(w.worker_id, None)
+        self._signal_worker_free()  # pool count dropped; waiters may spawn
         if w.client is not None:
             asyncio.ensure_future(w.client.close())
         if w.proc.poll() is None:
@@ -491,6 +560,7 @@ class NodeAgent:
 
     async def _on_worker_death(self, w: WorkerHandle, code: int):
         self.workers.pop(w.worker_id, None)
+        self._signal_worker_free()  # pool count dropped; waiters may spawn
         if w.actor_id is not None:
             # actor process died → control plane decides restart
             for r, v in (w.actor_resources or {}).items():
@@ -839,6 +909,13 @@ class NodeAgent:
         if not self.task_queue:
             return False
         progressed = False
+        # worker availability is a dispatch resource (reference
+        # LocalTaskManager waits on PopWorker): dispatch at most as many
+        # tasks as there are idle pool workers + spawn headroom this tick
+        room = self._pool_worker_cap()
+        for w in self.workers.values():
+            if w.actor_id is None and not (w.idle and w.ready.is_set()):
+                room -= 1
         for _ in range(len(self.task_queue)):
             spec = self.task_queue.popleft()
             pool = self._task_pool(spec)
@@ -892,6 +969,13 @@ class NodeAgent:
                         asyncio.ensure_future(self._ensure_local(d))
                 self.task_queue.append(spec)
                 continue
+            if room <= 0:
+                # every pool worker is busy and the pool is at cap: leave
+                # the task queued; _kick_dispatch fires when a worker
+                # frees.
+                self.task_queue.append(spec)
+                continue
+            room -= 1
             self._take(need, pool)
             spec["_granted"] = True
             progressed = True
@@ -931,6 +1015,7 @@ class NodeAgent:
         except (rpc.ConnectionLost, rpc.RpcError, OSError) as e:
             self.running.pop(spec["task_id"], None)
             w.busy_task = None
+            self._signal_worker_free()
             self._free_task_resources(spec)
             await self._notify_task_failed(spec, f"dispatch failed: {e}")
 
@@ -958,11 +1043,16 @@ class NodeAgent:
         # same resources
         self._take(need, self.resources_available)
         try:
+            # wait=False: the lease fast path must not camp on granted
+            # resources at the pool cap — returning None makes the owner
+            # fall back to queued submission
             w = await self._pop_worker(
                 p.get("job_id"), holds_tpu=need.get("TPU", 0) > 0,
-                runtime_env=p.get("runtime_env"),
+                runtime_env=p.get("runtime_env"), wait=False,
             )
         except (asyncio.TimeoutError, OSError):
+            w = None
+        if w is None:
             for r, v in need.items():
                 self._release(r, v)
             return None
@@ -1025,6 +1115,7 @@ class NodeAgent:
         if w is not None:
             w.busy_task = None
             w.idle_since = time.monotonic()
+            self._signal_worker_free()
         if lease.get("owner"):
             # agent-initiated revocation (TTL lapse / actor reclaim): tell
             # the owner so its cache doesn't push to an unleased worker
@@ -1079,6 +1170,7 @@ class NodeAgent:
             if w is not None:
                 w.busy_task = None
                 w.idle_since = time.monotonic()
+                self._signal_worker_free()
         self._kick_dispatch()
         return True
 

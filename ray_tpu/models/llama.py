@@ -48,12 +48,22 @@ class LlamaConfig:
     remat_policy: str = "dots"
     use_flash: bool | None = None  # None = auto (flash on TPU)
     tie_embeddings: bool = False
-    # Mixture-of-experts MLP (0 = dense). TPU-first dense-dispatch MoE:
-    # every expert computes on every token via batched einsum with the
-    # expert dim sharded over the ep mesh axis (all-to-all-free expert
-    # parallelism; the reference has no MoE at all, SURVEY §2.7).
+    # Mixture-of-experts MLP (0 = dense MLP). Two TPU-first impls:
+    # - "capacity" (default): GShard-style top-k token routing with a
+    #   per-row capacity buffer — dispatch/combine einsums whose expert
+    #   dim shards over the ep mesh axis, so GSPMD lowers the dispatch to
+    #   an all-to-all over ICI and each device runs ONLY its experts
+    #   (per-device expert FLOPs ~ top_k/E of dense).
+    # - "dense": every expert computes every token, gates mask the sum —
+    #   all-to-all-free, competitive at tiny E, and the parity oracle for
+    #   the capacity path. (The reference has no MoE at all, SURVEY §2.7.)
     n_experts: int = 0
     top_k: int = 2
+    moe_impl: str = "capacity"  # "capacity" | "dense"
+    # Expert buffer size multiplier: capacity = ceil(top_k*T/E * factor).
+    # Tokens routed past a full expert are dropped (their residual path
+    # still carries them) — GShard semantics.
+    capacity_factor: float = 1.25
     # GPipe microbatch count when the ambient mesh has a pp axis > 1
     # (parallel/pipeline.py). 0 = auto (4 microbatches per stage, capped at
     # the batch size). Ignored on pp=1 meshes.
@@ -218,13 +228,15 @@ def moe_gates(cfg: LlamaConfig, router, x):
     return probs
 
 
-def _moe_mlp(cfg: LlamaConfig, p, x):
+def _moe_mlp_dense(cfg: LlamaConfig, p, x):
     """Top-k dense-dispatch MoE (all experts compute, gates mask).
 
     Expert weights [E, d, f] are sharded over the ep axis; the weighted
     combine sums over E, which XLA lowers to a psum across ep — expert
-    parallelism with zero ragged communication. Appropriate up to moderate
-    E; token-dropping capacity routing is the scale-up path.
+    parallelism with zero ragged communication. Burns E/top_k x the MLP
+    FLOPs, so it only makes sense at tiny E; it doubles as the exact
+    parity oracle for the capacity path (capacity routing with no drops
+    computes the identical weighted sum).
     """
     cdt = cfg.compute_dtype
     gates = moe_gates(cfg, p["router"], x).astype(cdt)  # [B, T, E]
@@ -235,6 +247,72 @@ def _moe_mlp(cfg: LlamaConfig, p, x):
     )
     out = jnp.einsum("bted,bte->btd", y, gates)
     return shard_constraint(out, ("batch", "seq", "embed"))
+
+
+def _moe_mlp_capacity(cfg: LlamaConfig, p, x):
+    """GShard-style top-k capacity routing (design-new; no reference
+    counterpart — closest public pattern: GShard/Switch dispatch einsums).
+
+    Per batch row, each expert owns a fixed buffer of
+    capacity = ceil(top_k * T / E * capacity_factor) token slots. Slot
+    positions come from a cumsum over the row; tokens that land past a
+    full buffer are dropped (residual still carries them). The dispatch /
+    combine one-hots make the whole layer three dense einsums:
+
+        xe [B,E,C,D] = dispatch [B,T,E,C] . x [B,T,D]
+        ye [B,E,C,D] = expert_mlp(xe)          (E sharded over ep)
+        y  [B,T,D]   = combine  [B,T,E,C] . ye
+
+    Static shapes, no ragged comms: with B on dp and E on ep, GSPMD
+    lowers the dispatch/combine contractions to all-to-alls over ICI and
+    each device computes only its E/|ep| experts — per-device expert
+    FLOPs ~ top_k*capacity_factor/E of dense dispatch.
+    """
+    import math as _math
+
+    cdt = cfg.compute_dtype
+    b, t, d = x.shape
+    e, k = cfg.n_experts, cfg.top_k
+    capacity = min(t * k, int(_math.ceil(k * t / e * cfg.capacity_factor)))
+
+    gates = moe_gates(cfg, p["router"], x)  # [B, T, E] f32, top-k masked
+    topv, topi = jax.lax.top_k(gates, k)  # [B, T, k]
+
+    dispatch = jnp.zeros((b, t, e, capacity), cdt)
+    combine = jnp.zeros((b, t, e, capacity), jnp.float32)
+    counts = jnp.zeros((b, e), jnp.int32)
+    for j in range(k):
+        mask_j = jax.nn.one_hot(topi[..., j], e, dtype=jnp.int32)  # [B,T,E]
+        # slot index within each expert's buffer: tokens in row order,
+        # slot-major across the k choices (GShard ordering)
+        pos = jnp.cumsum(mask_j, axis=1) - mask_j + counts[:, None, :]
+        counts = counts + jnp.sum(mask_j, axis=1)
+        pos_tok = jnp.sum(pos * mask_j, axis=-1)  # [B, T]
+        keep = (pos_tok < capacity).astype(cdt)
+        oh_c = jax.nn.one_hot(pos_tok, capacity, dtype=cdt) * keep[..., None]
+        contrib = mask_j.astype(cdt)[..., None] * oh_c[..., None, :]
+        dispatch = dispatch + contrib
+        combine = combine + (contrib.astype(jnp.float32)
+                             * topv[..., j][..., None, None])
+
+    xe = jnp.einsum("btec,btd->becd", dispatch, x.astype(cdt))
+    xe = shard_constraint(xe, ("batch", "expert", None, "embed"))
+    gate = jnp.einsum("becd,edf->becf", xe, p["w_gate"].astype(cdt))
+    up = jnp.einsum("becd,edf->becf", xe, p["w_up"].astype(cdt))
+    ye = jnp.einsum(
+        "becf,efd->becd", jax.nn.silu(gate) * up, p["w_down"].astype(cdt)
+    )
+    y = jnp.einsum("btec,becd->btd", combine.astype(cdt), ye)
+    return shard_constraint(y, ("batch", "seq", "embed"))
+
+
+def _moe_mlp(cfg: LlamaConfig, p, x):
+    if cfg.moe_impl == "dense":
+        return _moe_mlp_dense(cfg, p, x)
+    if cfg.moe_impl == "capacity":
+        return _moe_mlp_capacity(cfg, p, x)
+    raise ValueError(
+        f"unknown moe_impl {cfg.moe_impl!r}; expected 'capacity' or 'dense'")
 
 
 def _attn_out_and_mlp(cfg: LlamaConfig, p, h, o):

@@ -1,0 +1,141 @@
+"""MoE capacity routing: parity vs the dense-dispatch oracle, token
+dropping, expert-parallel FLOPs reduction, and end-to-end training.
+
+VERDICT r2 item 4 'done' bar. Design-new (the reference has no MoE,
+SURVEY §2.7); the public pattern anchor is GShard/Switch dispatch einsums.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import llama
+
+
+def _cfg(**kw):
+    base = dict(
+        vocab_size=128, d_model=64, n_layers=1, n_heads=2, n_kv_heads=2,
+        d_ff=128, max_seq_len=32, n_experts=4, top_k=2, dtype="float32",
+        remat=False, use_flash=False,
+    )
+    base.update(kw)
+    return llama.LlamaConfig(**base)
+
+
+def _mlp_params(cfg, key):
+    p = llama.init_params(cfg, key)
+    layer0 = jax.tree_util.tree_map(lambda a: a[0], p["layers"])
+    return layer0
+
+
+def test_capacity_matches_dense_when_nothing_drops():
+    """With capacity >= T*top_k no token can drop, so capacity routing
+    computes EXACTLY the dense-dispatch weighted sum."""
+    cfg_d = _cfg(moe_impl="dense")
+    cfg_c = _cfg(moe_impl="capacity", capacity_factor=float(cfg_d.n_experts))
+    p = _mlp_params(cfg_d, jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 64), jnp.float32)
+    y_dense = llama._moe_mlp(cfg_d, p, x)
+    y_cap = llama._moe_mlp(cfg_c, p, x)
+    np.testing.assert_allclose(np.asarray(y_cap), np.asarray(y_dense),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_capacity_matches_dense_on_8dev_mesh():
+    """Same parity under a dp x ep mesh: the dispatch einsums must be
+    sharding-correct (E over ep, B over dp)."""
+    from ray_tpu.parallel import MeshConfig, build_mesh, use_mesh
+    from ray_tpu.parallel.sharding import logical_to_mesh_spec, DEFAULT_RULES
+    from jax.sharding import NamedSharding
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = build_mesh(MeshConfig(dp=2, ep=4), devs[:8])
+    cfg_d = _cfg(moe_impl="dense")
+    cfg_c = _cfg(moe_impl="capacity", capacity_factor=float(cfg_d.n_experts))
+    p = _mlp_params(cfg_d, jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 64), jnp.float32)
+    with use_mesh(mesh):
+        x_sh = jax.device_put(x, NamedSharding(mesh, logical_to_mesh_spec(
+            ("batch", "seq", "embed"), DEFAULT_RULES, mesh)))
+        y_dense = jax.jit(lambda p_, x_: llama._moe_mlp(cfg_d, p_, x_))(p, x_sh)
+        y_cap = jax.jit(lambda p_, x_: llama._moe_mlp(cfg_c, p_, x_))(p, x_sh)
+    np.testing.assert_allclose(np.asarray(y_cap), np.asarray(y_dense),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_tokens_drop_at_low_capacity():
+    """capacity_factor << 1 forces drops: dropped tokens contribute zero
+    (residual carries them), and outputs differ from dense."""
+    cfg_c = _cfg(moe_impl="capacity", capacity_factor=0.25)
+    cfg_d = _cfg(moe_impl="dense")
+    p = _mlp_params(cfg_d, jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 64), jnp.float32)
+    y_cap = llama._moe_mlp(cfg_c, p, x)
+    y_dense = llama._moe_mlp(cfg_d, p, x)
+    assert not np.allclose(np.asarray(y_cap), np.asarray(y_dense), atol=1e-3)
+    # every output row is finite (drops zero cleanly, no NaNs from the
+    # one-hot arithmetic)
+    assert np.isfinite(np.asarray(y_cap)).all()
+
+
+def test_expert_flops_scale_down():
+    """Per-step MLP FLOPs: capacity routing at E=4/top2/cf=1.0 must cost
+    ~top_k*cf/E = half the dense-dispatch expert FLOPs."""
+    cfg_d = _cfg(moe_impl="dense")
+    cfg_c = _cfg(moe_impl="capacity", capacity_factor=1.0)
+    p = _mlp_params(cfg_d, jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 64), jnp.float32)
+
+    def flops(cfg):
+        f = jax.jit(lambda p_, x_: llama._moe_mlp(cfg, p_, x_))
+        c = f.lower(p, x).compile().cost_analysis()
+        c = c[0] if isinstance(c, (list, tuple)) else c
+        return c["flops"]
+
+    fd, fc = flops(cfg_d), flops(cfg_c)
+    # dispatch/combine one-hot einsums add overhead, but the expert
+    # matmuls dominate; expect a clear win, not exactly 2x
+    assert fc < 0.75 * fd, f"capacity flops {fc} vs dense {fd}"
+
+
+def test_moe_tiny_trains():
+    """moe-tiny end-to-end: loss decreases with the capacity impl and
+    tracks the dense impl's trajectory."""
+    import optax
+
+    from ray_tpu.parallel import MeshConfig, build_mesh, use_mesh
+    from ray_tpu.train import (batch_sharding, init_train_state,
+                               make_train_step)
+
+    losses = {}
+    for impl in ("dense", "capacity"):
+        cfg = llama.llama2_size("moe-tiny")
+        cfg = llama.LlamaConfig(**{
+            **cfg.__dict__, "moe_impl": impl, "capacity_factor": 2.0,
+            "remat": False, "use_flash": False, "max_seq_len": 32,
+        })
+        mesh = build_mesh(MeshConfig(), jax.devices()[:1])
+        opt = optax.adamw(3e-3)
+        state, sh = init_train_state(
+            lambda k: llama.init_params(cfg, k),
+            llama.param_logical_axes(cfg), opt, mesh,
+            key=jax.random.PRNGKey(0))
+        step = make_train_step(
+            lambda p, b: llama.loss_fn(p, b, cfg), opt, mesh, sh)
+        toks = jax.random.randint(jax.random.PRNGKey(1), (4, 33), 0,
+                                  cfg.vocab_size, dtype=jnp.int32)
+        data = {"inputs": toks[:, :-1], "targets": toks[:, 1:]}
+        with use_mesh(mesh):
+            data = jax.device_put(data, batch_sharding(mesh))
+            ls = []
+            for _ in range(8):
+                state, m = step(state, data)
+                ls.append(float(m["loss"]))
+        losses[impl] = ls
+        assert ls[-1] < ls[0] * 0.9, f"{impl}: loss did not decrease {ls}"
+    # same init, generous capacity: trajectories should be close
+    np.testing.assert_allclose(losses["capacity"][-1], losses["dense"][-1],
+                               rtol=0.15)
