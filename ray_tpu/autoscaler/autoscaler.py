@@ -20,7 +20,8 @@ from typing import Any
 class NodeProvider:
     """Pluggable node lifecycle (reference node_provider.py)."""
 
-    def create_node(self, resources: dict) -> Any:
+    def create_node(self, resources: dict,
+                    node_type: str | None = None) -> Any:
         raise NotImplementedError
 
     def terminate_node(self, node) -> None:
@@ -28,6 +29,13 @@ class NodeProvider:
 
     def non_terminated_nodes(self) -> list:
         raise NotImplementedError
+
+    def node_types(self) -> dict[str, dict] | None:
+        """{name: {"resources": {...}, "max_workers": N}} — providers with
+        typed instance groups (e.g. TPU slices) declare them so the
+        demand scheduler can bin-pack; None = single homogeneous type
+        from AutoscalerConfig.worker_resources."""
+        return None
 
 
 class LocalNodeProvider(NodeProvider):
@@ -37,7 +45,7 @@ class LocalNodeProvider(NodeProvider):
     def __init__(self, cluster):
         self.cluster = cluster  # ray_tpu.cluster_utils.Cluster
 
-    def create_node(self, resources: dict):
+    def create_node(self, resources: dict, node_type: str | None = None):
         return self.cluster.add_node(resources=resources)
 
     def terminate_node(self, node) -> None:
@@ -61,6 +69,8 @@ class AutoscalerConfig:
 class Autoscaler:
     """The reconcile loop (StandardAutoscaler.update analog)."""
 
+    BOOT_GRACE_S = 120.0  # launched node gets this long to register
+
     def __init__(self, head_client, provider: NodeProvider,
                  config: AutoscalerConfig | None = None):
         """head_client: SyncRpcClient to the control plane."""
@@ -70,55 +80,149 @@ class Autoscaler:
         self._idle_since: dict[bytes, float] = {}
         self._queued_streak = 0
         self._launched: list = []  # nodes this autoscaler created
+        # launch-token -> first unseen time; tokens are per-launch serials
+        # (an id(node) key could be inherited by a new object at a reused
+        # address and instantly 'expire' a fresh boot)
+        self._launch_time: dict[int, float] = {}
+        self._launch_counter = 0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
     # -- one reconcile step (unit-testable without the thread) --
 
+    def _launch_seq_of(self, node) -> int:
+        if isinstance(node, dict):
+            if "_launch_seq" not in node:
+                self._launch_counter += 1
+                node["_launch_seq"] = self._launch_counter
+            return node["_launch_seq"]
+        seq = getattr(node, "_launch_seq", None)
+        if seq is None:
+            self._launch_counter += 1
+            seq = self._launch_counter
+            try:
+                node._launch_seq = seq
+            except Exception:  # noqa: BLE001 — unsettable: fall back
+                seq = id(node)
+        return seq
+
+    def _node_types(self) -> dict[str, dict]:
+        types = self.provider.node_types()
+        if types:
+            return types
+        return {"worker": {"resources": dict(self.config.worker_resources),
+                           "max_workers": self.config.max_workers}}
+
     def update(self) -> dict:
+        from ray_tpu.autoscaler.demand_scheduler import get_nodes_to_launch
+
         view = self.head.call("get_cluster_view", {})
         nodes = [n for n in view["nodes"] if n["alive"]]
         total_queued = sum(n.get("queued", 0) for n in nodes)
-        free_cpu = sum(
-            n["resources_available"].get("CPU", 0) for n in nodes
-        )
-        actions = {"launched": 0, "terminated": 0,
-                   "queued": total_queued, "free_cpu": free_cpu}
+        actions = {"launched": 0, "terminated": 0, "queued": total_queued}
+
+        by_id = {n["node_id"]: n for n in nodes}
+        # Link provider records to registered agents: cloud providers
+        # (gcp.py) can't know the agent's node_id at create time; the
+        # agent on the VM registers with label instance=<provider name>
+        # (RAY_TPU_NODE_LABELS) and we join on it here.
+        by_instance = {
+            n["labels"]["instance"]: n["node_id"]
+            for n in nodes if n.get("labels", {}).get("instance")
+        }
+        for node in self._launched:
+            if isinstance(node, dict) and node.get("node_id") is None:
+                nid = by_instance.get(node.get("name", ""))
+                if nid is not None:
+                    node["node_id"] = nid
+                    self._launch_time.pop(node.get("_launch_seq"), None)
+        # demand SHAPES from the head (queued tasks, pending actors,
+        # pending PGs) bin-packed against provider node types — the
+        # reference ResourceDemandScheduler flow
+        try:
+            demand = self.head.call("get_demand", {})
+        except Exception:  # noqa: BLE001 — older head: fall back to none
+            demand = {"task_demands": [], "actor_demands": [],
+                      "pg_demands": []}
+        demands = (list(demand.get("task_demands", []))
+                   + list(demand.get("actor_demands", [])))
+        pg_demands = list(demand.get("pg_demands", []))
+
+        # free capacity = live nodes' available resources, plus the FULL
+        # resources of instances still booting (a launched-but-unregistered
+        # node must absorb its share of demand or we'd double-launch)
+        free = [dict(n["resources_available"]) for n in nodes]
+        launched_by_type: dict[str, int] = {}
+        for node in self._launched:
+            ntype = getattr(node, "_autoscaler_type", None) or (
+                node.get("node_type") if isinstance(node, dict) else None
+            ) or "worker"
+            launched_by_type[ntype] = launched_by_type.get(ntype, 0) + 1
+            node_id = (node.get("node_id") if isinstance(node, dict)
+                       else getattr(node, "node_id", None))
+            if node_id not in by_id:
+                res = (node.get("resources")
+                       if isinstance(node, dict) else None)
+                free.append(dict(
+                    res or self.config.worker_resources))
 
         n_workers = len(self._launched)
-        by_id = {n["node_id"]: n for n in nodes}
-        # a previously launched node that hasn't registered yet counts as
-        # pending capacity: never stack launches on a booting node
-        pending_boot = any(
-            getattr(node, "node_id", None) not in by_id
-            for node in self._launched
-        )
-        # Scale up on persistent unsatisfied demand: tasks stay queued
-        # across consecutive polls (free CPU may exist but not fit the
-        # demand shape — the reference bin-packs demands per node type;
-        # persistence is the shape-agnostic signal).
-        if (total_queued > 0 and not pending_boot
-                and (free_cpu <= 0 or self._queued_streak >= 2)
-                and n_workers < self.config.max_workers):
-            node = self.provider.create_node(
-                self.config.worker_resources
+        to_launch = {}
+        if (demands or pg_demands) and n_workers < self.config.max_workers:
+            to_launch = get_nodes_to_launch(
+                demands, self._node_types(), free,
+                pg_demands=pg_demands,
+                launched_by_type=launched_by_type,
             )
-            self._launched.append(node)
+        if to_launch:
+            if self._queued_streak < 1:
+                # debounce: demand must persist across two polls (a task
+                # about to dispatch onto freeing capacity is not demand)
+                self._queued_streak += 1
+            else:
+                self._queued_streak = 0
+                for ntype, count in to_launch.items():
+                    spec = self._node_types()[ntype]
+                    for _ in range(count):
+                        if len(self._launched) >= self.config.max_workers:
+                            break
+                        node = self.provider.create_node(
+                            dict(spec["resources"]), node_type=ntype)
+                        if isinstance(node, dict):
+                            node.setdefault("node_type", ntype)
+                        else:
+                            try:
+                                node._autoscaler_type = ntype
+                            except Exception:  # noqa: BLE001
+                                pass
+                        self._launched.append(node)
+                        actions["launched"] += 1
+                if actions["launched"]:
+                    return actions
+        else:
             self._queued_streak = 0
-            actions["launched"] = 1
-            return actions
-        self._queued_streak = (
-            self._queued_streak + 1 if total_queued > 0 else 0
-        )
 
         # scale down: launched nodes fully idle past the timeout
         now = time.monotonic()
         for node in list(self._launched):
             if n_workers <= self.config.min_workers:
                 break
-            info = by_id.get(node.node_id)
+            node_id = (node.get("node_id") if isinstance(node, dict)
+                       else getattr(node, "node_id", None))
+            info = by_id.get(node_id)
             if info is None:
-                self._launched.remove(node)
+                # booting nodes haven't registered yet; a node that had
+                # its chance to register and vanished is TERMINATED (not
+                # just forgotten — forgetting a live cloud VM leaks it)
+                seq = self._launch_seq_of(node)
+                started = self._launch_time.setdefault(seq, now)
+                if now - started > self.BOOT_GRACE_S:
+                    try:
+                        self.provider.terminate_node(node)
+                    except Exception:  # noqa: BLE001
+                        pass
+                    self._launched.remove(node)
+                    self._launch_time.pop(seq, None)
                 continue
             idle = (
                 info.get("queued", 0) == 0
@@ -130,13 +234,13 @@ class Autoscaler:
                 >= info["resources_total"].get("CPU", 0)
             )
             if not idle:
-                self._idle_since.pop(node.node_id, None)
+                self._idle_since.pop(node_id, None)
                 continue
-            since = self._idle_since.setdefault(node.node_id, now)
+            since = self._idle_since.setdefault(node_id, now)
             if now - since >= self.config.idle_timeout_s:
                 self.provider.terminate_node(node)
                 self._launched.remove(node)
-                self._idle_since.pop(node.node_id, None)
+                self._idle_since.pop(node_id, None)
                 n_workers -= 1
                 actions["terminated"] += 1
         return actions

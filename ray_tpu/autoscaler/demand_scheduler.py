@@ -1,0 +1,150 @@
+"""Demand-shape bin-packing (reference
+python/ray/autoscaler/_private/resource_demand_scheduler.py, scaled).
+
+Given the cluster's unsatisfied demand shapes (task/actor resource dicts
++ pending placement groups) and the provider's node types, compute which
+node types to launch:
+
+- demands first try to pack onto EXISTING free capacity (plus capacity
+  already being launched), largest-first;
+- what doesn't fit binds to the cheapest node type that can hold it,
+  opening new instances as needed (first-fit-decreasing);
+- a STRICT_PACK placement group is one atomic demand (all bundles on one
+  node); STRICT_SPREAD opens one node per bundle; PACK/SPREAD degrade to
+  their bundles as independent demands;
+- "tpu-slice:<topology>" resources only fit node types declaring that
+  label, which is how a pending TPU-slice gang maps to exactly the right
+  accelerator node group (reference gcp/node.py:111 GCPNodeType.TPU).
+"""
+
+from __future__ import annotations
+
+
+def _fits(need: dict, cap: dict) -> bool:
+    return all(cap.get(r, 0.0) >= v for r, v in need.items() if v > 0)
+
+
+def _take(need: dict, cap: dict) -> None:
+    for r, v in need.items():
+        cap[r] = cap.get(r, 0.0) - v
+
+
+def _merge(bundles: list[dict]) -> dict:
+    out: dict = {}
+    for b in bundles:
+        for r, v in b.items():
+            out[r] = out.get(r, 0.0) + v
+    return out
+
+
+def _demand_size(d: dict) -> float:
+    # sort key: TPU/accelerator demands first (scarcest), then CPU size
+    return (d.get("TPU", 0.0) * 1e6
+            + sum(v for r, v in d.items() if r.startswith("tpu-slice")) * 1e9
+            + d.get("CPU", 0.0))
+
+
+def expand_pg_demands(pg_demands: list[dict]) -> list[dict]:
+    """Placement groups -> atomic resource demands per their strategy."""
+    out: list[dict] = []
+    for pg in pg_demands:
+        bundles = pg.get("bundles", [])
+        strategy = pg.get("strategy", "PACK")
+        if strategy == "STRICT_PACK":
+            out.append(_merge(bundles))  # all bundles on ONE node
+        else:
+            # STRICT_SPREAD handled by the caller opening fresh nodes per
+            # bundle; PACK/SPREAD bundles pack independently
+            out.extend(dict(b) for b in bundles)
+    return out
+
+
+def get_nodes_to_launch(
+    demands: list[dict],
+    node_types: dict[str, dict],
+    free_capacities: list[dict],
+    *,
+    pg_demands: list[dict] | None = None,
+    launched_by_type: dict[str, int] | None = None,
+) -> dict[str, int]:
+    """-> {node_type: count} to launch now.
+
+    `node_types`: {name: {"resources": {...}, "max_workers": N}}.
+    `free_capacities`: available resources of live nodes PLUS the full
+    resources of instances already launching (never double-launch).
+    """
+    launched_by_type = dict(launched_by_type or {})
+    free = [dict(c) for c in free_capacities]
+    to_launch: dict[str, int] = {}
+    open_nodes: list[tuple[str, dict]] = []  # (type, remaining capacity)
+
+    all_demands = list(demands)
+    strict_spread_bundles: list[dict] = []
+    for pg in pg_demands or []:
+        if pg.get("strategy") == "STRICT_SPREAD":
+            strict_spread_bundles.append(pg)
+        else:
+            all_demands.extend(expand_pg_demands([pg]))
+    all_demands.sort(key=_demand_size, reverse=True)
+
+    def room(ntype: str) -> bool:
+        spec = node_types[ntype]
+        n = launched_by_type.get(ntype, 0) + to_launch.get(ntype, 0)
+        return n < spec.get("max_workers", 1 << 30)
+
+    def _is_accel(res: dict) -> bool:
+        return res.get("TPU", 0) > 0 or any(
+            r.startswith("tpu-slice") for r in res)
+
+    def open_for(need: dict) -> bool:
+        # cheapest-first: fewest resources that still fit; accelerator
+        # node groups are reserved for accelerator demands (never burn a
+        # TPU slice on queued CPU work)
+        candidates = [
+            (sum(spec["resources"].values()), name)
+            for name, spec in node_types.items()
+            if _fits(need, spec["resources"]) and room(name)
+            and (_is_accel(need) or not _is_accel(spec["resources"]))
+        ]
+        if not candidates:
+            return False
+        _, name = min(candidates)
+        to_launch[name] = to_launch.get(name, 0) + 1
+        cap = dict(node_types[name]["resources"])
+        _take(need, cap)
+        open_nodes.append((name, cap))
+        return True
+
+    for need in all_demands:
+        placed = False
+        for cap in free:
+            if _fits(need, cap):
+                _take(need, cap)
+                placed = True
+                break
+        if placed:
+            continue
+        for _, cap in open_nodes:
+            if _fits(need, cap):
+                _take(need, cap)
+                placed = True
+                break
+        if not placed:
+            open_for(need)  # unfittable demands are silently skipped:
+            # nothing the provider offers can hold them
+
+    # STRICT_SPREAD: each bundle on a DISTINCT node — consume distinct
+    # free nodes first, then open one node per remaining bundle
+    for pg in strict_spread_bundles:
+        used: set[int] = set()
+        for b in pg.get("bundles", []):
+            placed = False
+            for i, cap in enumerate(free):
+                if i not in used and _fits(b, cap):
+                    _take(b, cap)
+                    used.add(i)
+                    placed = True
+                    break
+            if not placed:
+                open_for(dict(b))
+    return to_launch

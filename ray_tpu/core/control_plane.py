@@ -98,6 +98,7 @@ class NodeInfo:
         self.alive = True
         self.last_heartbeat = time.monotonic()
         self.queued = 0  # tasks waiting (autoscaler demand signal)
+        self.queued_shapes: list[dict] = []  # their resource shapes
         self.running = 0
         self.store_primaries = 0  # pinned primaries (scale-down gate)
         self.stats: dict = {}  # psutil node stats from the agent
@@ -359,6 +360,7 @@ class ControlPlane:
             return {"unknown": True}  # tell agent to re-register
         node.last_heartbeat = time.monotonic()
         node.queued = p.get("queued", 0)
+        node.queued_shapes = p.get("queued_shapes", [])
         node.running = p.get("running", 0)
         node.store_primaries = p.get("store_primaries", 0)
         if p.get("stats"):
@@ -368,6 +370,31 @@ class ControlPlane:
                 p["resources_available"], window_s=2.0
             )
         return {"ok": True}
+
+    async def rpc_get_demand(self, conn, p):
+        """Unsatisfied demand SHAPES for the autoscaler's bin-packing
+        (reference GcsMonitorServer feeding resource_demand_scheduler.py):
+        queued task resources per node, pending actor resources, and
+        pending placement-group bundle sets with their strategies."""
+        task_demands: list[dict] = []
+        for node in self.nodes.values():
+            if node.alive:
+                task_demands.extend(node.queued_shapes)
+        actor_demands = [
+            dict(a.get("resources") or {"CPU": 1.0})
+            for a in self.actors.values()
+            if a["state"] in (PENDING, RESTARTING)
+            and a.get("node_id") is None
+        ]
+        pg_demands = [
+            {"strategy": pg.get("strategy", "PACK"),
+             "bundles": [dict(b) for b in pg.get("bundles", [])]}
+            for pg in self.pgs.values()
+            if pg.get("state") == "PENDING"
+        ]
+        return {"task_demands": task_demands,
+                "actor_demands": actor_demands,
+                "pg_demands": pg_demands}
 
     async def rpc_get_cluster_view(self, conn, p):
         return {"nodes": [n.view() for n in self.nodes.values()]}

@@ -297,6 +297,13 @@ class NodeAgent:
                     # demand signal = WAITING work only (running tasks
                     # don't need more nodes); primaries gate scale-down
                     "queued": len(self.task_queue),
+                    # demand SHAPES so the autoscaler can bin-pack
+                    # against provider node types (reference
+                    # resource_demand_scheduler.py), capped per beat
+                    "queued_shapes": [
+                        spec.get("resources", {"CPU": 1.0})
+                        for spec in list(self.task_queue)[:50]
+                    ],
                     "running": len(self.running),
                     "store_primaries": len(self.primaries),
                     # reporter-agent analog (reporter_agent.py:266):
@@ -447,6 +454,11 @@ class NodeAgent:
 
     _RESERVED = b"__spawn_reserved__"
 
+    class PoolSaturated(TimeoutError):
+        """No pool worker freed within the wait budget — the node is
+        healthy but at its worker cap; the task should requeue, not
+        fail."""
+
     async def _pop_worker(self, job_id: bytes | None,
                           holds_tpu: bool = False,
                           runtime_env: dict | None = None, *,
@@ -501,7 +513,7 @@ class NodeAgent:
             if not wait:
                 return None
             if time.monotonic() > deadline:
-                raise TimeoutError(
+                raise self.PoolSaturated(
                     f"no pool worker available within budget "
                     f"(cap {self._pool_worker_cap()})")
             # wait for a free signal, not a poll: hundreds of waiters
@@ -911,8 +923,11 @@ class NodeAgent:
         progressed = False
         # worker availability is a dispatch resource (reference
         # LocalTaskManager waits on PopWorker): dispatch at most as many
-        # tasks as there are idle pool workers + spawn headroom this tick
-        room = self._pool_worker_cap()
+        # tasks as there are idle pool workers + spawn headroom this tick.
+        # Already-granted tasks still waiting in _pop_worker count against
+        # the room, or back-to-back ticks (no await between grants and
+        # worker spawns) would over-grant the whole queue.
+        room = self._pool_worker_cap() - getattr(self, "_pop_waiters", 0)
         for w in self.workers.values():
             if w.actor_id is None and not (w.idle and w.ready.is_set()):
                 room -= 1
@@ -994,16 +1009,27 @@ class NodeAgent:
         return dep in spec.get("inline_deps", ())
 
     async def _run_task(self, spec: dict):
+        self._pop_waiters = getattr(self, "_pop_waiters", 0) + 1
         try:
             w = await self._pop_worker(
                 spec.get("job_id"),
                 holds_tpu=spec.get("resources", {}).get("TPU", 0) > 0,
                 runtime_env=spec.get("runtime_env"),
             )
+        except self.PoolSaturated:
+            # node healthy, merely at its worker cap for the whole wait
+            # budget: requeue rather than fail the task
+            self._free_task_resources(spec)
+            spec.pop("_granted", None)
+            self.task_queue.append(spec)
+            self._kick_dispatch()
+            return
         except (asyncio.TimeoutError, OSError) as e:
             self._free_task_resources(spec)
             await self._notify_task_failed(spec, f"worker spawn failed: {e}")
             return
+        finally:
+            self._pop_waiters -= 1
         w.busy_task = spec["task_id"]
         self.running[spec["task_id"]] = spec
         spec["_worker_id"] = w.worker_id

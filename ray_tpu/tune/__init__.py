@@ -10,6 +10,7 @@ from ray_tpu.tune.tuner import (  # noqa: F401
     ASHAScheduler,
     Result,
     ResultGrid,
+    RunConfig,
     TuneConfig,
     Tuner,
     choice,
@@ -17,6 +18,11 @@ from ray_tpu.tune.tuner import (  # noqa: F401
     loguniform,
     report,
     uniform,
+)
+from ray_tpu.tune.search import (  # noqa: F401
+    BasicVariantGenerator,
+    Searcher,
+    TPESearcher,
 )
 from ray_tpu.tune.schedulers import (  # noqa: F401
     HyperBandScheduler,

@@ -1,0 +1,205 @@
+"""Search algorithms (reference python/ray/tune/search/): a Searcher
+interface, the default random/grid variant generator, and a TPE searcher.
+
+The reference wraps 13 external libraries (hyperopt, optuna, ...) behind
+`Searcher`; here the interface is the same shape (suggest /
+on_trial_complete / save / restore) with a native TPE implementation —
+the core of what those wrappers provide — so model-based search works
+with zero extra dependencies.
+"""
+
+from __future__ import annotations
+
+import math
+import pickle
+import random as _random
+from typing import Any
+
+from ray_tpu.tune.tuner import (_Sampler, _expand_grid, _sample_config,
+                                choice, loguniform, uniform)
+
+
+class Searcher:
+    """suggest(trial_id) -> config | None; observations flow back via
+    on_trial_complete (reference tune/search/searcher.py)."""
+
+    metric: str = "loss"
+    mode: str = "min"
+
+    def set_search_properties(self, metric: str, mode: str):
+        self.metric, self.mode = metric, mode
+
+    def suggest(self, trial_id: str) -> dict | None:  # pragma: no cover
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str,
+                          result: dict | None = None) -> None:
+        pass
+
+    # experiment-state integration
+    def save(self) -> bytes:
+        return pickle.dumps(self.__dict__)
+
+    def restore(self, blob: bytes) -> None:
+        self.__dict__.update(pickle.loads(blob))
+
+
+class BasicVariantGenerator(Searcher):
+    """Random/grid sampling as a Searcher (tune/search/basic_variant.py)."""
+
+    def __init__(self, param_space: dict, num_samples: int,
+                 seed: int | None = None):
+        self.param_space = param_space
+        self.num_samples = num_samples
+        self._rng = _random.Random(seed)
+        grid = _expand_grid(param_space)
+        self._configs = []
+        n = num_samples if num_samples > 1 or len(grid) == 1 else len(grid)
+        for i in range(max(n, len(grid)) if num_samples == 1 else n):
+            base = grid[i % len(grid)]
+            self._configs.append(_sample_config(base, self._rng))
+        self._next = 0
+
+    def suggest(self, trial_id: str) -> dict | None:
+        if self._next >= len(self._configs):
+            return None
+        cfg = self._configs[self._next]
+        self._next += 1
+        return cfg
+
+    @property
+    def total_trials(self) -> int:
+        return len(self._configs)
+
+
+class TPESearcher(Searcher):
+    """Tree-structured Parzen Estimator (Bergstra et al. 2011 — the
+    algorithm behind the reference's hyperopt wrapper).
+
+    Observations split at the gamma-quantile into good/bad sets; per
+    dimension, candidates drawn from a Parzen (kernel) estimate of the
+    GOOD set are scored by the density ratio l(x)/g(x) and the best
+    candidate wins. Continuous dims use normal kernels (log-domain for
+    loguniform); categorical dims use smoothed counts.
+    """
+
+    def __init__(self, *, metric: str | None = None, mode: str = "min",
+                 n_startup_trials: int = 5, gamma: float = 0.25,
+                 n_candidates: int = 64, seed: int | None = None):
+        if metric:
+            self.metric = metric
+        self.mode = mode
+        self.n_startup = n_startup_trials
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self._rng = _random.Random(seed)
+        self._space: dict | None = None
+        self._obs: list[tuple[dict, float]] = []  # (config, score: lower=better)
+        self._count = 0
+
+    def set_space(self, param_space: dict):
+        for k, v in param_space.items():
+            if not isinstance(v, (uniform, loguniform, choice)):
+                raise ValueError(
+                    f"TPESearcher supports uniform/loguniform/choice dims; "
+                    f"param {k!r} is {type(v).__name__}")
+        self._space = param_space
+
+    def suggest(self, trial_id: str) -> dict | None:
+        assert self._space is not None, "call set_space first"
+        self._count += 1
+        if len(self._obs) < self.n_startup:
+            return _sample_config(self._space, self._rng)
+        good, bad = self._split()
+        out = {}
+        for name, dim in self._space.items():
+            gv = [c[name] for c, _ in good]
+            bv = [c[name] for c, _ in bad]
+            out[name] = self._suggest_dim(dim, gv, bv)
+        return out
+
+    def on_trial_complete(self, trial_id: str,
+                          result: dict | None = None) -> None:
+        if not result or self.metric not in result:
+            return
+        val = float(result[self.metric])
+        score = val if self.mode == "min" else -val
+        cfg = result.get("config")
+        if cfg is not None:
+            self._obs.append((cfg, score))
+
+    # -- internals --
+
+    def _split(self):
+        obs = sorted(self._obs, key=lambda t: t[1])
+        # hyperopt's split: the good set grows ~ gamma*sqrt(n), keeping
+        # exploitation tight at small n (a linear fraction would blunt the
+        # model exactly when it matters most)
+        n_good = max(1, int(math.ceil(self.gamma * math.sqrt(len(obs)))))
+        return obs[:n_good], obs[n_good:]
+
+    def _suggest_dim(self, dim, good_vals, bad_vals):
+        if isinstance(dim, choice):
+            return self._suggest_categorical(dim, good_vals, bad_vals)
+        log = isinstance(dim, loguniform)
+        lo, hi = dim.low, dim.high
+        tf = math.log if log else (lambda v: v)
+        inv = math.exp if log else (lambda v: v)
+        lo_t, hi_t = tf(lo), tf(hi)
+        g = sorted(tf(v) for v in good_vals)
+        b = sorted(tf(v) for v in bad_vals)
+        width = hi_t - lo_t
+
+        def bandwidths(pts):
+            # hyperopt-style adaptive kernels: each point's sigma is its
+            # max gap to adjacent points (domain edges count), clipped —
+            # narrow where observations cluster, wide where sparse
+            if not pts:
+                return []
+            sigmas = []
+            for i, p in enumerate(pts):
+                left = p - (pts[i - 1] if i > 0 else lo_t)
+                right = (pts[i + 1] if i + 1 < len(pts) else hi_t) - p
+                sigmas.append(min(max(0.5 * max(left, right),
+                                      width * 0.01), width * 0.3))
+            return sigmas
+
+
+        sg, sb = bandwidths(g), bandwidths(b)
+
+        def density(x, pts, sigmas):
+            if not pts:
+                return 1.0 / width
+            total = 0.0
+            for p, s in zip(pts, sigmas):
+                total += math.exp(-0.5 * ((x - p) / s) ** 2) / s
+            return total / (len(pts) * math.sqrt(2 * math.pi)) + 1e-12
+
+        best_x, best_ratio = None, -1.0
+        for _ in range(self.n_candidates):
+            # sample from the good-set Parzen mixture (plus the prior)
+            if g and self._rng.random() > 1.0 / (len(g) + 1):
+                i = self._rng.randrange(len(g))
+                x = self._rng.gauss(g[i], sg[i])
+                x = min(max(x, lo_t), hi_t)
+            else:
+                x = self._rng.uniform(lo_t, hi_t)
+            ratio = density(x, g, sg) / density(x, b, sb)
+            if ratio > best_ratio:
+                best_ratio, best_x = ratio, x
+        return inv(best_x)
+
+    def _suggest_categorical(self, dim, good_vals, bad_vals):
+        opts = list(dim.options)
+
+        def weights(vals):
+            w = {o: 1.0 for o in opts}  # +1 smoothing
+            for v in vals:
+                w[v] = w.get(v, 1.0) + 1.0
+            total = sum(w.values())
+            return {o: w[o] / total for o in opts}
+
+        wg, wb = weights(good_vals), weights(bad_vals)
+        return max(opts, key=lambda o: wg[o] / wb[o])
+
+

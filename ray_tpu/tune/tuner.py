@@ -245,6 +245,22 @@ class TuneConfig:
     max_concurrent_trials: int = 4
     scheduler: ASHAScheduler | None = None
     seed: int | None = None
+    search_alg: Any | None = None  # tune.search.Searcher
+
+
+@dataclass
+class RunConfig:
+    """Where the experiment persists (reference air.RunConfig subset).
+
+    With storage_path set, fit() snapshots trial/search/scheduler state
+    to <storage_path>/<name>/experiment_state.pkl after every trial
+    event, and Tuner.restore(path, trainable) resumes a killed study:
+    finished trials keep their results, unfinished ones restart from
+    their last checkpoints (reference tune/execution/experiment_state.py
+    + Tuner.restore)."""
+
+    name: str = "tune_experiment"
+    storage_path: str | None = None
 
 
 class Tuner:
@@ -252,23 +268,79 @@ class Tuner:
 
     def __init__(self, trainable: Callable[[dict], Any], *,
                  param_space: dict | None = None,
-                 tune_config: TuneConfig | None = None):
+                 tune_config: TuneConfig | None = None,
+                 run_config: RunConfig | None = None):
         self.trainable = trainable
         self.param_space = param_space or {}
         self.cfg = tune_config or TuneConfig()
+        self.run_config = run_config
+        self._restored: dict | None = None
+
+    # -- experiment persistence --
+
+    @property
+    def _exp_dir(self) -> str | None:
+        import os
+
+        if self.run_config is None or self.run_config.storage_path is None:
+            return None
+        return os.path.join(self.run_config.storage_path,
+                            self.run_config.name)
+
+    @classmethod
+    def restore(cls, path: str,
+                trainable: Callable[[dict], Any]) -> "Tuner":
+        """Resume a study from its experiment dir (the trainable is passed
+        fresh, like the reference — code isn't part of the snapshot)."""
+        import os
+        import pickle
+
+        with open(os.path.join(path, "experiment_state.pkl"), "rb") as f:
+            st = pickle.load(f)
+        t = cls(trainable, param_space=st["param_space"],
+                tune_config=st["tune_config"],
+                run_config=RunConfig(
+                    name=os.path.basename(path),
+                    storage_path=os.path.dirname(path)))
+        t._restored = st
+        return t
+
+    def _persist(self, trials: dict, searcher) -> None:
+        import os
+        import pickle
+        import tempfile
+
+        exp = self._exp_dir
+        if exp is None:
+            return
+        os.makedirs(exp, exist_ok=True)
+        state = {
+            "param_space": self.param_space,
+            "tune_config": self.cfg,
+            "trials": trials,
+            "searcher": searcher.save() if searcher is not None else None,
+        }
+        fd, tmp = tempfile.mkstemp(dir=exp, suffix=".tmp")
+        with os.fdopen(fd, "wb") as f:
+            pickle.dump(state, f)
+        os.replace(tmp, os.path.join(exp, "experiment_state.pkl"))
+
+    def _build_searcher(self):
+        from ray_tpu.tune.search import BasicVariantGenerator, Searcher
+
+        search = self.cfg.search_alg
+        if search is None:
+            search = BasicVariantGenerator(
+                self.param_space, self.cfg.num_samples, self.cfg.seed)
+        else:
+            search.set_search_properties(self.cfg.metric, self.cfg.mode)
+            if hasattr(search, "set_space"):
+                search.set_space(self.param_space)
+        total = getattr(search, "total_trials", self.cfg.num_samples)
+        return search, total
 
     def fit(self) -> ResultGrid:
         from ray_tpu._private import serialization
-
-        rng = _random.Random(self.cfg.seed)
-        grid_bases = _expand_grid(self.param_space)
-        configs: list[dict] = []
-        for i in range(self.cfg.num_samples):
-            base = grid_bases[i % len(grid_bases)]
-            configs.append(_sample_config(base, rng))
-        # grid search with num_samples=1 still runs the whole grid
-        if len(grid_bases) > 1 and self.cfg.num_samples == 1:
-            configs = [_sample_config(b, rng) for b in grid_bases]
 
         fn_blob = serialization.pack_callable(self.trainable)
         sched = self.cfg.scheduler
@@ -276,9 +348,49 @@ class Tuner:
             sched.metric = self.cfg.metric
             sched.mode = self.cfg.mode
 
-        pending = list(enumerate(configs))
-        running: dict[int, dict] = {}  # idx -> {actor, iter, last, ckpt}
+        search, total = self._build_searcher()
+
+        # trial book: idx -> {config, status, iteration, last, ckpt_path,
+        # error}; the unit of persistence AND of restore
+        trials: dict[int, dict] = {}
         results: dict[int, Result] = {}
+        pending: list[tuple[int, dict, Any, int]] = []
+        if self._restored is not None:
+            if self._restored.get("searcher") is not None:
+                search.restore(self._restored["searcher"])
+            trials = self._restored["trials"]
+            for idx, tr in sorted(trials.items()):
+                if tr["status"] == "done":
+                    results[idx] = Result(
+                        config=tr["config"], metrics=tr.get("last"),
+                        checkpoint=_ckpt_from_path(tr.get("ckpt_path")),
+                        error=tr.get("error"),
+                        trial_id=f"trial_{idx:04d}",
+                    )
+                else:  # pending or running at the time of death
+                    pending.append((
+                        idx, tr["config"],
+                        _ckpt_from_path(tr.get("ckpt_path")),
+                        tr.get("iteration", 0),
+                    ))
+        next_idx = max(trials) + 1 if trials else 0
+        n_started = len(trials)
+
+        running: dict[int, dict] = {}  # idx -> {actor, iter, last, ckpt}
+
+        def _next_pending():
+            nonlocal next_idx, n_started
+            if pending:
+                return pending.pop(0)
+            if n_started >= total:
+                return None
+            config = search.suggest(f"trial_{next_idx:04d}")
+            if config is None:
+                return None
+            idx = next_idx
+            next_idx += 1
+            n_started += 1
+            return (idx, config, None, 0)
 
         def _launch(idx, config, resume_checkpoint=None, iteration=0):
             actor = _TrialActor.remote()
@@ -289,8 +401,12 @@ class Tuner:
             running[idx] = {"actor": actor, "config": config,
                             "iteration": iteration, "last": None,
                             "ckpt": resume_checkpoint}
+            trials[idx] = {"config": config, "status": "running",
+                           "iteration": iteration, "last": None,
+                           "ckpt_path": _ckpt_path(resume_checkpoint)}
+            self._persist(trials, search)
 
-        def _finish(idx, error=None):
+        def _finish(idx, error=None, aborted=False):
             st = running.pop(idx)
             try:
                 ray_tpu.kill(st["actor"])
@@ -301,20 +417,52 @@ class Tuner:
                 checkpoint=st["ckpt"], error=error,
                 trial_id=f"trial_{idx:04d}",
             )
+            if aborted:
+                # interrupted, not finished: the PERSISTED status stays
+                # "running" so Tuner.restore resumes it from its last
+                # checkpoint (only this process's returned grid sees the
+                # abort error)
+                trials[idx] = {"config": st["config"], "status": "running",
+                               "iteration": st["iteration"],
+                               "last": st["last"],
+                               "ckpt_path": _ckpt_path(st["ckpt"])}
+            else:
+                trials[idx] = {"config": st["config"], "status": "done",
+                               "iteration": st["iteration"],
+                               "last": st["last"], "error": error,
+                               "ckpt_path": _ckpt_path(st["ckpt"])}
+                if error is None and st["last"] is not None:
+                    search.on_trial_complete(
+                        f"trial_{idx:04d}",
+                        {**st["last"], "config": st["config"]})
+            self._persist(trials, search)
+
+        def _on_report(idx, st):
+            trials[idx] = {"config": st["config"], "status": "running",
+                           "iteration": st["iteration"], "last": st["last"],
+                           "ckpt_path": _ckpt_path(st["ckpt"])}
+            self._persist(trials, search)
 
         try:
-            self._drive(pending, running, results, sched, _launch, _finish)
+            self._drive(_next_pending, running, results, sched,
+                        _launch, _finish, _on_report)
         finally:
             for idx in list(running):
-                _finish(idx, error="tuner aborted")
+                _finish(idx, error="tuner aborted", aborted=True)
         ordered = [results[i] for i in sorted(results)]
         return ResultGrid(ordered, self.cfg.metric, self.cfg.mode)
 
-    def _drive(self, pending, running, results, sched, _launch, _finish):
-        while pending or running:
-            while pending and len(running) < self.cfg.max_concurrent_trials:
-                idx, config = pending.pop(0)
-                _launch(idx, config)
+    def _drive(self, next_pending, running, results, sched, _launch,
+               _finish, on_report):
+        while True:
+            while len(running) < self.cfg.max_concurrent_trials:
+                nxt = next_pending()
+                if nxt is None:
+                    break
+                idx, config, ckpt, it = nxt
+                _launch(idx, config, resume_checkpoint=ckpt, iteration=it)
+            if not running:
+                return
             # poll all running trials for one report round
             polls = {
                 idx: st["actor"].next_report.remote(2.0)
@@ -339,6 +487,7 @@ class Tuner:
                     st["last"]["training_iteration"] = st["iteration"]
                     if res.get("checkpoint") is not None:
                         st["ckpt"] = res["checkpoint"]
+                    on_report(idx, st)
                     metric_val = res["metrics"].get(self.cfg.metric)
                     if sched is not None and metric_val is not None:
                         decision = sched.on_result(
@@ -368,3 +517,15 @@ class Tuner:
                                 _launch(idx, new_cfg,
                                         resume_checkpoint=donor["ckpt"],
                                         iteration=it)
+
+
+def _ckpt_path(ckpt) -> str | None:
+    return getattr(ckpt, "path", None)
+
+
+def _ckpt_from_path(path: str | None):
+    if path is None:
+        return None
+    from ray_tpu.train.checkpoint import Checkpoint
+
+    return Checkpoint(path)

@@ -1,0 +1,97 @@
+"""Shard-local checkpoint restore (VERDICT r2 item 7).
+
+restore_state must read only the bytes covering the restoring process's
+addressable shards (jax.make_array_from_single_device_arrays path), not
+assemble full arrays host-side.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ray_tpu.parallel import MeshConfig, build_mesh
+from ray_tpu.train.checkpoint import (_ShardReader, _load_device_shard,
+                                      restore_state, save_state)
+
+
+def _mesh8():
+    return build_mesh(MeshConfig(dp=8), jax.devices()[:8])
+
+
+def test_save_restore_roundtrip_sharded(tmp_path):
+    mesh = _mesh8()
+    sh = NamedSharding(mesh, PartitionSpec("dp", None))
+    big = jax.device_put(
+        jnp.arange(8 * 64 * 32, dtype=jnp.float32).reshape(8 * 64, 32), sh)
+    state = {"w": big, "step": 7, "scalar": jax.device_put(
+        jnp.float32(3.5), NamedSharding(mesh, PartitionSpec()))}
+    save_state(state, str(tmp_path / "ck"), process_index=0)
+
+    stats = {}
+    out = restore_state(str(tmp_path / "ck"), mesh=mesh, stats=stats)
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.asarray(big))
+    assert out["step"] == 7
+    assert float(out["scalar"]) == 3.5
+    # single process addresses all 8 devices -> reads the whole array once
+    # (replicated scalar read once, not 8x — the distinct-index cache)
+    expected = big.nbytes + np.float32(0).nbytes
+    assert stats["bytes_read"] == expected
+
+
+def test_per_process_read_fraction(tmp_path):
+    """Simulate process k of a multi-host mesh: loading ONE device's shard
+    must touch ~1/8 of the leaf's bytes."""
+    mesh = _mesh8()
+    sh = NamedSharding(mesh, PartitionSpec("dp", None))
+    big = jax.device_put(
+        jnp.arange(8 * 64 * 32, dtype=jnp.float32).reshape(8 * 64, 32), sh)
+    save_state({"w": big}, str(tmp_path / "ck"), process_index=0)
+
+    reader = _ShardReader(str(tmp_path / "ck"))
+    imap = sh.addressable_devices_indices_map(big.shape)
+    one_index = next(iter(imap.values()))
+    shard = _load_device_shard(reader, 0, big.shape, np.float32, one_index)
+    assert shard.shape == (64, 32)
+    assert reader.bytes_read == big.nbytes // 8  # exactly one shard file read
+    reader.close()
+
+
+def test_restore_onto_reshaped_mesh(tmp_path):
+    """Saved on dp=8, restored as dp=4 x tp=2 along the other axis: the
+    general overlap-assembly path must produce identical values."""
+    mesh8 = _mesh8()
+    sh8 = NamedSharding(mesh8, PartitionSpec("dp", None))
+    big = jax.device_put(
+        jnp.arange(8 * 16 * 64, dtype=jnp.float32).reshape(8 * 16, 64), sh8)
+    save_state({"w": big}, str(tmp_path / "ck"), process_index=0)
+
+    mesh42 = build_mesh(MeshConfig(dp=4, tp=2), jax.devices()[:8])
+    sh42 = NamedSharding(mesh42, PartitionSpec("dp", "tp"))
+    out = restore_state(str(tmp_path / "ck"), mesh=mesh42,
+                        shardings={"w": sh42})
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.asarray(big))
+    assert out["w"].sharding == sh42
+
+
+def test_multi_writer_files_cover(tmp_path):
+    """Shards written by several 'processes' (separate files) are all
+    indexed; restore stitches across files."""
+    mesh = _mesh8()
+    sh = NamedSharding(mesh, PartitionSpec("dp"))
+    v = jax.device_put(jnp.arange(64, dtype=jnp.int32), sh)
+    # fake a 2-process save: write half the shards under p0, half p1
+    import os
+
+    path = str(tmp_path / "ck")
+    save_state({"v": v}, path, process_index=0)
+    # split the single file into two to model multi-writer layout
+    z = np.load(os.path.join(path, "shards_p0.npz"))
+    keys = list(z.files)
+    half = len(keys) // 2
+    np.savez(os.path.join(path, "shards_p0.npz"),
+             **{k: z[k] for k in keys[:half]})
+    np.savez(os.path.join(path, "shards_p1.npz"),
+             **{k: z[k] for k in keys[half:]})
+    out = restore_state(path, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(out["v"]), np.arange(64))
