@@ -16,6 +16,7 @@ from ray_tpu.collective.collective import (  # noqa: F401
     allreduce,
     barrier,
     broadcast,
+    CollectiveActorMixin,
     create_collective_group,
     destroy_collective_group,
     get_rank,

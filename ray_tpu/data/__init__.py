@@ -11,6 +11,7 @@ read task per file (data/datasource.py).
 """
 
 from ray_tpu.data.dataset import (  # noqa: F401
+    ActorPoolStrategy,
     DataIterator,
     Dataset,
     GroupedDataset,

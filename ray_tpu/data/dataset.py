@@ -49,6 +49,147 @@ def _read_range(start: int, stop: int, block_size: int):
         yield np.arange(lo, min(lo + block_size, stop), dtype=np.int64)
 
 
+@ray_tpu.remote(num_cpus=1)
+def _source_and_map_fused(source_blob, fn_blobs):
+    """Run a lazy SOURCE (zero-arg callable) + the fused stage chain in
+    one task: the raw source block never lands in the object store
+    separately — the unit of true streaming execution."""
+    from ray_tpu._private import serialization
+
+    block = serialization.unpack_payload(source_blob)()
+    for blob in fn_blobs:
+        block = serialization.unpack_payload(blob)(block)
+    return block
+
+
+class ActorPoolStrategy:
+    """compute= argument for map_batches: run the stage on a fixed pool
+    of actors instead of one task per block (reference
+    execution/operators/actor_pool_map_operator.py). The map fn may be a
+    CLASS: each pool actor constructs one instance (expensive per-actor
+    init — model load, connection setup — happens size times, not once
+    per block)."""
+
+    def __init__(self, size: int = 2):
+        self.size = size
+
+
+@ray_tpu.remote(num_cpus=1)
+class _MapActor:
+    """One actor of an ActorPoolStrategy pool."""
+
+    def __init__(self, fn_blob):
+        from ray_tpu._private import serialization
+
+        fn = serialization.unpack_payload(fn_blob)
+        # callable class -> per-actor instance (stateful init)
+        self.fn = fn() if isinstance(fn, type) else fn
+
+    def apply(self, block):
+        return self.fn(block)
+
+
+def _block_nbytes(block) -> int:
+    """Best-effort block size for the streaming byte budget."""
+    size = getattr(block, "nbytes", None)
+    if size is not None:
+        return int(size)
+    mem = getattr(block, "memory_usage", None)  # pandas DataFrame/Series
+    if callable(mem):
+        try:
+            usage = mem(deep=True)
+            return int(getattr(usage, "sum", lambda: usage)())
+        except Exception:  # noqa: BLE001
+            pass
+    if hasattr(block, "__len__"):
+        return len(block) * 64
+    return 64
+
+
+def _prefetched(refs: list, depth: int) -> Iterator[Any]:
+    """Background-thread get pipeline: up to `depth` blocks ahead. The
+    consumer abandoning the iterator (early break / gc) stops the fetch
+    thread — it must not keep pulling the rest of the dataset or block
+    forever on the full queue."""
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+    _END = object()
+
+    def _put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.25)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _fetch():
+        try:
+            for ref in refs:
+                if stop.is_set():
+                    return
+                if not _put(ray_tpu.get(ref, timeout=300)):
+                    return
+        except BaseException as e:  # noqa: BLE001 — surface to consumer
+            _put(e)
+        finally:
+            _put(_END)
+
+    t = threading.Thread(target=_fetch, daemon=True,
+                         name="data-prefetch")
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+
+
+def _actor_pool_map(fn_blob, size: int, refs: list,
+                    timeout_s: float = 600.0) -> list:
+    """Run one stage over all blocks on a pool of `size` map actors,
+    preserving order (reference ActorPoolMapOperator)."""
+    import time as _time
+
+    actors = [_MapActor.remote(fn_blob) for _ in builtins.range(size)]
+    try:
+        out: list = [None] * len(refs)
+        # round-robin assignment with bounded per-actor pipelining; the
+        # runtime's per-actor ordered queues keep each actor sequential
+        for i, r in enumerate(refs):
+            out[i] = actors[i % size].apply.remote(r)
+        # all results must exist BEFORE the pool tears down: killing an
+        # actor with queued work would leave never-resolving refs in the
+        # dataset cache. Progress-based deadline: stall, not total time.
+        pending = list(out)
+        last_progress = _time.monotonic()
+        while pending:
+            ready, pending = ray_tpu.wait(
+                pending, num_returns=len(pending), timeout=10.0)
+            if ready:
+                last_progress = _time.monotonic()
+            elif _time.monotonic() - last_progress > timeout_s:
+                raise TimeoutError(
+                    f"actor-pool map stalled: {len(pending)} blocks made "
+                    f"no progress in {timeout_s}s")
+        return out
+    finally:
+        for a in actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:  # noqa: BLE001
+                pass
+
+
 class Dataset:
     """An ordered collection of block refs (reference dataset.py:176).
 
@@ -59,53 +200,115 @@ class Dataset:
     fusion, plan.py:82 + can_fuse:67). Branched pipelines therefore share
     whatever an ancestor already computed — a stage never runs twice."""
 
-    def __init__(self, block_refs: list, *, _parent=None, _fn=None,
-                 _inflight=DEFAULT_INFLIGHT):
+    def __init__(self, block_refs: list | None = None, *, _parent=None,
+                 _fn=None, _inflight=DEFAULT_INFLIGHT,
+                 _source_blobs: list | None = None):
         if _parent is not None:
             self._parent: "Dataset | None" = _parent
-            self._fn = _fn
+            self._fn = _fn  # ("task", blob) | ("actors", blob, size)
             self._cached: list | None = None
+            self._source_blobs = None
         else:
             self._parent = None
             self._fn = None
-            self._cached = list(block_refs)
+            # lazy SOURCE root: block descriptors (pickled zero-arg
+            # callables) that only run when consumed — what lets a
+            # streaming read avoid materializing every input at once
+            self._source_blobs = _source_blobs
+            self._cached = (None if _source_blobs is not None
+                            else list(block_refs or []))
         self._inflight = _inflight
+
+    def _chain(self):
+        """(root, stage list) of un-materialized stages above the nearest
+        cached ancestor."""
+        stages: list = []
+        node: Dataset = self
+        while node._cached is None and node._parent is not None:
+            stages.append(node._fn)
+            node = node._parent
+        stages.reverse()
+        return node, stages
+
+    @staticmethod
+    def _run_stages(root, stages, inflight) -> list:
+        """Execute (root -> stages) with bounded in-flight submission;
+        actor stages split the chain and run on their pools."""
+        # group consecutive task stages into fused segments; actor stages
+        # are fusion barriers with their own pools
+        fused: list = []
+        for st in stages:
+            if st[0] == "task":
+                if fused and isinstance(fused[-1], list):
+                    fused[-1].append(st[1])
+                else:
+                    fused.append([st[1]])
+            else:
+                fused.append(st)
+
+        # stage 0: produce refs from the root (sources fuse into the
+        # first task segment)
+        first_task_blobs = (
+            fused.pop(0) if fused and isinstance(fused[0], list) else [])
+        refs: list = []
+        in_flight: list = []
+        if root._source_blobs is not None:
+            for src in root._source_blobs:
+                if len(in_flight) >= inflight:
+                    _, in_flight = ray_tpu.wait(
+                        in_flight, num_returns=1, timeout=300)
+                r = _source_and_map_fused.remote(src, first_task_blobs)
+                in_flight.append(r)
+                refs.append(r)
+        elif first_task_blobs:
+            for block_ref in root._cached:
+                if len(in_flight) >= inflight:
+                    _, in_flight = ray_tpu.wait(
+                        in_flight, num_returns=1, timeout=300)
+                r = _map_block_fused.remote(first_task_blobs, block_ref)
+                in_flight.append(r)
+                refs.append(r)
+        else:
+            refs = list(root._cached)
+
+        # remaining segments
+        for seg in fused:
+            if isinstance(seg, list):  # fused task segment
+                nxt, in_flight = [], []
+                for r in refs:
+                    if len(in_flight) >= inflight:
+                        _, in_flight = ray_tpu.wait(
+                            in_flight, num_returns=1, timeout=300)
+                    o = _map_block_fused.remote(seg, r)
+                    in_flight.append(o)
+                    nxt.append(o)
+                refs = nxt
+            else:  # actor pool segment
+                _, blob, size = seg
+                refs = _actor_pool_map(blob, size, refs)
+        return refs
 
     @property
     def _blocks(self) -> list:
         """Materialized block refs; fuses + executes pending stages once."""
         if self._cached is None:
-            # collect un-materialized stages up to the nearest cached
-            # ancestor (intermediates stay lazy — that's the fusion)
-            blobs: list = []
-            node: Dataset = self
-            while node._cached is None:
-                blobs.append(node._fn)
-                node = node._parent
-            blobs.reverse()
-            out: list = []
-            in_flight: list = []
-            for block_ref in node._cached:
-                if len(in_flight) >= self._inflight:
-                    _, in_flight = ray_tpu.wait(
-                        in_flight, num_returns=1, timeout=300
-                    )
-                ref = _map_block_fused.remote(blobs, block_ref)
-                in_flight.append(ref)
-                out.append(ref)
-            self._cached = out
+            root, stages = self._chain()
+            self._cached = self._run_stages(root, stages, self._inflight)
         return self._cached
 
     def _root(self) -> "Dataset":
         node = self
-        while node._cached is None:
+        while node._cached is None and node._parent is not None:
             node = node._parent
         return node
 
     # -- metadata --
 
     def num_blocks(self) -> int:
-        return len(self._root()._cached)
+        root = self._root()
+        if root._cached is not None:
+            return len(root._cached)
+        return len(root._source_blobs)
 
     def count(self) -> int:
         return sum(
@@ -114,23 +317,32 @@ class Dataset:
         )
 
     def __repr__(self):
-        return f"Dataset(num_blocks={len(self._blocks)})"
+        # num_blocks, not _blocks: repr of a lazy pipeline must never
+        # execute it (a debug print could fill the object store)
+        lazy = self._cached is None
+        return (f"Dataset(num_blocks={self.num_blocks()}"
+                + (", lazy)" if lazy else ")"))
 
     # -- transforms --
 
     def map_batches(self, fn: Callable[[Any], Any], *,
-                    max_in_flight: int = DEFAULT_INFLIGHT) -> "Dataset":
-        """Apply fn to every block via remote tasks — lazily.
+                    max_in_flight: int = DEFAULT_INFLIGHT,
+                    compute: "ActorPoolStrategy | None" = None) -> "Dataset":
+        """Apply fn to every block — lazily.
 
-        Chained map_batches/filter calls fuse into one task per block at
-        execution time (TaskPoolMapOperator + stage fusion analog); the
-        in-flight window is the backpressure budget of
-        streaming_executor.py:210."""
+        Default compute: one task per block; chained task stages fuse
+        into one task per block at execution time (TaskPoolMapOperator +
+        stage fusion analog) with the in-flight window as backpressure.
+        compute=ActorPoolStrategy(size=N): the stage runs on a pool of N
+        actors (fn may be a callable CLASS — constructed once per actor
+        for expensive stateful init; reference ActorPoolMapOperator)."""
         from ray_tpu._private import serialization
 
         fn_blob = serialization.pack_callable(fn)
+        stage = (("actors", fn_blob, compute.size) if compute is not None
+                 else ("task", fn_blob))
         return Dataset(
-            [], _parent=self, _fn=fn_blob, _inflight=max_in_flight
+            [], _parent=self, _fn=stage, _inflight=max_in_flight
         )
 
     def filter(self, pred: Callable[[Any], bool], **kw) -> "Dataset":
@@ -152,11 +364,89 @@ class Dataset:
 
     # -- consumption --
 
-    def iter_batches(self) -> Iterator[Any]:
+    def iter_batches(self, *, prefetch_batches: int = 0) -> Iterator[Any]:
         """Yield blocks in order. The Dataset keeps its block refs (it is
-        re-iterable); to stream-and-release, use streaming_split."""
-        for ref in list(self._blocks):
-            yield ray_tpu.get(ref, timeout=300)
+        re-iterable); to stream-and-release, use streaming_iter_batches.
+
+        prefetch_batches > 0: a background thread gets ahead of the
+        consumer by up to that many blocks (reference
+        iter_batches(prefetch_batches=...) consumer pipelining), so
+        compute overlaps the fetch instead of serial blocking gets."""
+        refs = list(self._blocks)
+        if prefetch_batches <= 0:
+            for ref in refs:
+                yield ray_tpu.get(ref, timeout=300)
+            return
+        yield from _prefetched(refs, prefetch_batches)
+
+    def streaming_iter_batches(self, *, byte_budget: int | None = None,
+                               max_in_flight: int | None = None,
+                               free_blocks: bool = True) -> Iterator[Any]:
+        """TRUE streaming consumption: execute the pipeline while
+        iterating, bounding the object store footprint, and free each
+        output block once yielded (reference StreamingExecutor's
+        memory-budget admission, streaming_executor_state.py).
+
+        - byte_budget: cap on estimated bytes of in-flight outputs (a
+          moving average of observed block sizes gates submission).
+        - Lazy sources (read_csv/... / range(lazy=True)) fuse into the
+          map tasks, so raw inputs never separately occupy the store —
+          a pipeline over 4x the store capacity runs in bounded space.
+        - The dataset does NOT cache the outputs (one-shot iterator).
+        """
+        import collections
+
+        root, stages = self._chain()
+        if any(st[0] == "actors" for st in stages):
+            raise ValueError(
+                "streaming_iter_batches supports task stages only; "
+                "materialize actor-pool stages first")
+        blobs = [st[1] for st in stages]
+        if root._source_blobs is not None:
+            units = [("src", s) for s in root._source_blobs]
+        else:
+            units = [("ref", r) for r in (root._cached or [])]
+        max_in_flight = max_in_flight or self._inflight
+
+        in_flight: collections.deque = collections.deque()  # (ref, owned)
+        avg_bytes = [0.0, 0]  # (total, count)
+
+        def consume_one():
+            ref, owned = in_flight.popleft()
+            block = ray_tpu.get(ref, timeout=300)
+            avg_bytes[0] += _block_nbytes(block)
+            avg_bytes[1] += 1
+            return ref, owned, block
+
+        def over_budget() -> bool:
+            if len(in_flight) >= max_in_flight:
+                return True
+            if byte_budget is None or avg_bytes[1] == 0:
+                return False
+            est = avg_bytes[0] / avg_bytes[1]
+            return est * (len(in_flight) + 1) > byte_budget
+
+        for kind, unit in units:
+            while in_flight and over_budget():
+                ref, owned, block = consume_one()
+                yield block
+                if free_blocks and owned:  # never free USER-owned roots
+                    del block
+                    ray_tpu.free([ref])
+            if kind == "src":
+                in_flight.append(
+                    (_source_and_map_fused.remote(unit, blobs), True))
+            elif blobs:
+                in_flight.append(
+                    (_map_block_fused.remote(blobs, unit), True))
+            else:
+                in_flight.append((unit, False))
+        while in_flight:
+            ref, owned, block = consume_one()
+            yield block
+            if free_blocks and owned:
+                del block
+                ray_tpu.free([ref])
 
     def iter_rows(self) -> Iterator[Any]:
         for block in self.iter_batches():

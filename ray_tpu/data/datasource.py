@@ -39,65 +39,77 @@ def _expand(paths) -> list[str]:
     return out
 
 
-@ray_tpu.remote(num_cpus=1)
-def _read_csv_file(path: str, kw: dict):
-    import pandas as pd
-
-    return pd.read_csv(path, **kw)
-
-
-@ray_tpu.remote(num_cpus=1)
-def _read_json_file(path: str, kw: dict):
-    import pandas as pd
-
-    return pd.read_json(path, lines=kw.pop("lines", True), **kw)
-
-
-@ray_tpu.remote(num_cpus=1)
-def _read_parquet_file(path: str, kw: dict):
-    import pandas as pd
-
-    return pd.read_parquet(path, **kw)
-
-
-@ray_tpu.remote(num_cpus=1)
-def _read_text_file(path: str, encoding: str):
-    with open(path, encoding=encoding) as f:
-        return [line.rstrip("\n") for line in f]
-
-
-@ray_tpu.remote(num_cpus=1)
-def _read_numpy_file(path: str):
-    import numpy as np
-
-    return np.load(path, allow_pickle=False)
-
-
-def _mk(refs) -> "Dataset":
+def _mk_lazy(fns) -> "Dataset":
+    """LAZY source dataset: each file read is a descriptor that only runs
+    when the dataset is consumed — under streaming_iter_batches the read
+    fuses into the map task, so a pipeline over data far larger than the
+    object store runs in bounded space."""
+    from ray_tpu._private import serialization
     from ray_tpu.data.dataset import Dataset
 
-    return Dataset(list(refs))
+    return Dataset(
+        _source_blobs=[serialization.pack_callable(f) for f in fns])
+
+
+def _csv_reader(path, kw):
+    def _read():
+        import pandas as pd
+
+        return pd.read_csv(path, **kw)
+    return _read
+
+
+def _json_reader(path, kw):
+    def _read():
+        import pandas as pd
+
+        k = dict(kw)
+        return pd.read_json(path, lines=k.pop("lines", True), **k)
+    return _read
+
+
+def _parquet_reader(path, kw):
+    def _read():
+        import pandas as pd
+
+        return pd.read_parquet(path, **kw)
+    return _read
+
+
+def _text_reader(path, encoding):
+    def _read():
+        with open(path, encoding=encoding) as f:
+            return [line.rstrip("\n") for line in f]
+    return _read
+
+
+def _numpy_reader(path):
+    def _read():
+        import numpy as np
+
+        return np.load(path, allow_pickle=False)
+    return _read
 
 
 def read_csv(paths, **kw) -> "Dataset":
-    return _mk(_read_csv_file.remote(p, kw) for p in _expand(paths))
+    return _mk_lazy(_csv_reader(p, kw) for p in _expand(paths))
 
 
 def read_json(paths, **kw) -> "Dataset":
     """JSONL by default (lines=True); pass lines=False for array files."""
-    return _mk(_read_json_file.remote(p, kw) for p in _expand(paths))
+    return _mk_lazy(_json_reader(p, kw) for p in _expand(paths))
 
 
 def read_parquet(paths, **kw) -> "Dataset":
-    return _mk(_read_parquet_file.remote(p, kw) for p in _expand(paths))
+    return _mk_lazy(_parquet_reader(p, kw) for p in _expand(paths))
 
 
 def read_text(paths, *, encoding: str = "utf-8") -> "Dataset":
-    return _mk(_read_text_file.remote(p, encoding) for p in _expand(paths))
+    return _mk_lazy(_text_reader(p, encoding) for p in _expand(paths))
 
 
 def read_numpy(paths) -> "Dataset":
-    return _mk(_read_numpy_file.remote(p) for p in _expand(paths))
+    return _mk_lazy(_numpy_reader(p) for p in _expand(paths))
 
 
 # ---------------- sinks ----------------

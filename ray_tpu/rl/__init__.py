@@ -11,6 +11,7 @@ the learner group is sharded — instead of torch DDP.
 from ray_tpu.rl.ppo import PPO, PPOConfig  # noqa: F401
 from ray_tpu.rl.env_runner import EnvRunner  # noqa: F401
 from ray_tpu.rl.learner import Learner  # noqa: F401
+from ray_tpu.rl.learner_group import LearnerGroup  # noqa: F401
 from ray_tpu.rl.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rl.bc import BC, BCConfig  # noqa: F401
 from ray_tpu.rl.replay import ReplayBuffer  # noqa: F401

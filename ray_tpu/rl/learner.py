@@ -36,6 +36,14 @@ def compute_gae(rewards, values, dones, last_value, *, gamma=0.99,
     return adv, returns
 
 
+def normalize_advantages(batch: dict) -> dict:
+    """Batch-level advantage normalization (once, before any sharding)."""
+    adv = np.asarray(batch["advantages"], np.float32)
+    out = dict(batch)
+    out["advantages"] = (adv - adv.mean()) / (adv.std() + 1e-8)
+    return out
+
+
 class Learner:
     """Owns params + optimizer state; update() is jitted once."""
 
@@ -49,56 +57,91 @@ class Learner:
         self.clip = clip
         self.vf_coeff = vf_coeff
         self.entropy_coeff = entropy_coeff
+        self._grad = jax.jit(self._grad_fn)
         self._update = jax.jit(self._update_fn)
 
-    def _update_fn(self, params, opt_state, batch):
-        def loss_fn(p):
-            logits, value = models.forward(p, batch["obs"])
-            logp_all = jax.nn.log_softmax(logits)
-            logp = jnp.take_along_axis(
-                logp_all, batch["actions"][:, None], axis=1
-            )[:, 0]
-            ratio = jnp.exp(logp - batch["logp"])
-            adv = batch["advantages"]
-            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
-            pg = -jnp.minimum(
-                ratio * adv,
-                jnp.clip(ratio, 1 - self.clip, 1 + self.clip) * adv,
-            ).mean()
-            vf = jnp.mean((value - batch["returns"]) ** 2)
-            entropy = -jnp.mean(
-                jnp.sum(jnp.exp(logp_all) * logp_all, axis=1)
-            )
-            total = pg + self.vf_coeff * vf - self.entropy_coeff * entropy
-            return total, {"policy_loss": pg, "vf_loss": vf,
-                           "entropy": entropy}
+    def _loss(self, params, batch):
+        logits, value = models.forward(params, batch["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][:, None], axis=1
+        )[:, 0]
+        ratio = jnp.exp(logp - batch["logp"])
+        # advantages arrive batch-normalized (normalize_advantages at the
+        # update/driver level): in-loss per-minibatch normalization would
+        # make a sharded LearnerGroup's mean-of-shard-gradients diverge
+        # from the full-batch gradient
+        adv = batch["advantages"]
+        pg = -jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1 - self.clip, 1 + self.clip) * adv,
+        ).mean()
+        vf = jnp.mean((value - batch["returns"]) ** 2)
+        entropy = -jnp.mean(
+            jnp.sum(jnp.exp(logp_all) * logp_all, axis=1)
+        )
+        total = pg + self.vf_coeff * vf - self.entropy_coeff * entropy
+        return total, {"policy_loss": pg, "vf_loss": vf,
+                       "entropy": entropy}
 
+    def _grad_fn(self, params, batch):
         (loss, metrics), grads = jax.value_and_grad(
-            loss_fn, has_aux=True
-        )(params)
+            self._loss, has_aux=True
+        )(params, batch)
+        metrics["total_loss"] = loss
+        return grads, metrics
+
+    def grad_fn(self, params, batch):
+        """Jitted (grads, metrics) — the LearnerGroup's per-shard step."""
+        return self._grad(params, batch)
+
+    def _update_fn(self, params, opt_state, batch):
+        grads, metrics = self._grad_fn(params, batch)
         updates, opt_state = self.opt.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
-        metrics["total_loss"] = loss
         return params, opt_state, metrics
 
     def update(self, batch: dict, *, minibatches: int = 4,
-               epochs: int = 4) -> dict:
-        n = len(batch["obs"])
-        idx = np.arange(n)
-        metrics = {}
-        rng = np.random.RandomState(0)
-        for _ in range(epochs):
-            rng.shuffle(idx)
-            for mb in np.array_split(idx, minibatches):
-                sub = {
-                    k: jnp.asarray(np.asarray(batch[k])[mb])
-                    for k in ("obs", "actions", "logp", "advantages",
-                              "returns")
-                }
-                self.params, self.opt_state, metrics = self._update(
-                    self.params, self.opt_state, sub
-                )
-        return {k: float(v) for k, v in metrics.items()}
+               epochs: int = 4, shuffle_seed: int = 0) -> dict:
+        batch = normalize_advantages(batch)
+        return run_sgd(self, batch, minibatches=minibatches,
+                       epochs=epochs, shuffle_seed=shuffle_seed)
 
     def get_weights(self):
         return jax.device_get(self.params)
+
+
+def run_sgd(learner: Learner, batch: dict, *, minibatches: int,
+            epochs: int, shuffle_seed: int, grad_hook=None) -> dict:
+    """THE epoch/shuffle/minibatch/apply loop — shared by the
+    single-process Learner and each LearnerGroup replica so their
+    semantics cannot drift (same shuffle RNG, same slicing, same
+    optimizer application; advantage normalization is the CALLER's job,
+    once, before any sharding).
+
+    grad_hook(grads, n_rows) -> grads runs between the gradient and the
+    optimizer step — the LearnerGroup's allreduce seam."""
+    n = len(batch["obs"])
+    idx = np.arange(n)
+    metrics = {}
+    rng = np.random.RandomState(shuffle_seed)
+    for _ in range(epochs):
+        rng.shuffle(idx)
+        for mb in np.array_split(idx, minibatches):
+            sub = {
+                k: jnp.asarray(np.asarray(batch[k])[mb])
+                for k in ("obs", "actions", "logp", "advantages",
+                          "returns")
+            }
+            if grad_hook is None:
+                learner.params, learner.opt_state, metrics = (
+                    learner._update(learner.params, learner.opt_state,
+                                    sub))
+            else:
+                grads, metrics = learner.grad_fn(learner.params, sub)
+                grads = grad_hook(grads, len(mb))
+                updates, learner.opt_state = learner.opt.update(
+                    grads, learner.opt_state, learner.params)
+                learner.params = optax.apply_updates(
+                    learner.params, updates)
+    return {k: float(v) for k, v in metrics.items()}

@@ -35,6 +35,9 @@ class PPOConfig:
     entropy_coeff: float = 0.01
     sgd_minibatches: int = 4
     sgd_epochs: int = 4
+    # >1: distributed LearnerGroup actors with per-minibatch gradient
+    # allreduce (reference learner_group.py:225 _distributed_update)
+    num_learners: int = 1
 
     def build(self) -> "PPO":
         return PPO(self)
@@ -44,10 +47,19 @@ class PPO:
     def __init__(self, config: PPOConfig):
         assert config.env_creator is not None, "set PPOConfig.env_creator"
         self.config = config
-        self.learner = Learner(
-            config.obs_dim, config.n_actions, lr=config.lr,
-            clip=config.clip, entropy_coeff=config.entropy_coeff,
-        )
+        if config.num_learners > 1:
+            from ray_tpu.rl.learner_group import LearnerGroup
+
+            self.learner = LearnerGroup(
+                config.obs_dim, config.n_actions,
+                num_learners=config.num_learners, lr=config.lr,
+                clip=config.clip, entropy_coeff=config.entropy_coeff,
+            )
+        else:
+            self.learner = Learner(
+                config.obs_dim, config.n_actions, lr=config.lr,
+                clip=config.clip, entropy_coeff=config.entropy_coeff,
+            )
         blob = serialization.pack_callable(config.env_creator)
         self.runners = [
             EnvRunner.remote(blob, config.obs_dim, config.n_actions,
@@ -100,3 +112,5 @@ class PPO:
                 ray_tpu.kill(r)
             except Exception:  # noqa: BLE001
                 pass
+        if hasattr(self.learner, "shutdown"):
+            self.learner.shutdown()

@@ -1,0 +1,124 @@
+"""Data streaming for real (VERDICT r2 item 8): consumer-side prefetch,
+byte-budgeted streaming through a multi-stage pipeline over data larger
+than the object store, and ActorPoolMapOperator with per-actor init."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rdata
+from ray_tpu.cluster_utils import Cluster
+
+STORE_CAP = 64 * 1024 * 1024  # 64 MB store
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(head_resources={"CPU": 8, "memory": 8 * 2**30},
+                store_capacity=STORE_CAP)
+    c.connect()
+    yield c
+    c.shutdown()
+
+
+def test_prefetch_iter_batches(cluster):
+    ds = rdata.from_items(list(range(1000)), parallelism=8).map_batches(
+        lambda b: [x * 2 for x in b])
+    plain = [row for b in ds.iter_batches() for row in b]
+    pre = [row for b in ds.iter_batches(prefetch_batches=3) for row in b]
+    assert pre == plain == [x * 2 for x in range(1000)]
+
+
+def test_stream_4x_store_capacity_bounded(cluster):
+    """3-stage pipeline over ~4x the object store capacity: lazy numpy
+    sources fuse into the map tasks and outputs are freed after
+    consumption, so store occupancy stays BOUNDED (asserted on the live
+    store) while every row flows through."""
+    import tempfile
+
+    # 16 files x 16 MB = 256 MB through a 64 MB store
+    n_files, rows = 16, 2 * 1024 * 1024  # 2M float64 = 16 MB per file
+    d = tempfile.mkdtemp(prefix="ds_stream_")
+    for i in range(n_files):
+        np.save(os.path.join(d, f"f_{i:02d}.npy"),
+                np.full(rows, float(i), np.float64))
+
+    ds = (rdata.read_numpy(os.path.join(d, "*.npy"))
+          .map_batches(lambda a: a + 1.0)
+          .map_batches(lambda a: a * 2.0))
+
+    store = cluster.head_agent.store
+    peak = 0
+    total_rows = 0
+    checks = []
+    for block in ds.streaming_iter_batches(
+            byte_budget=STORE_CAP // 2, max_in_flight=3):
+        total_rows += len(block)
+        checks.append(float(block[0]))
+        peak = max(peak, store.used_bytes())
+        del block
+    assert total_rows == n_files * rows
+    assert sorted(checks) == [(i + 1.0) * 2.0 for i in range(n_files)]
+    # bounded occupancy: never anywhere near the 256 MB that flowed
+    assert peak <= STORE_CAP, f"peak store occupancy {peak}"
+    # and after the stream the outputs are freed
+    import time
+
+    deadline = time.time() + 20
+    while time.time() < deadline and store.used_bytes() > STORE_CAP // 4:
+        time.sleep(0.2)
+    assert store.used_bytes() <= STORE_CAP // 4
+
+    for f in os.listdir(d):
+        os.unlink(os.path.join(d, f))
+    os.rmdir(d)
+
+
+class _Doubler:
+    """Callable class for actor compute: counts its constructions."""
+
+    def __init__(self):
+        import os as _os
+
+        self.pid = _os.getpid()
+        self.calls = 0
+
+    def __call__(self, block):
+        self.calls += 1
+        return [(x * 2, self.pid) for x in block]
+
+
+def test_actor_pool_map_with_per_actor_init(cluster):
+    ds = rdata.from_items(list(range(120)), parallelism=12)
+    out = ds.map_batches(
+        _Doubler, compute=rdata.ActorPoolStrategy(size=3))
+    rows = [r for b in out.iter_batches() for r in b]
+    vals = sorted(v for v, _ in rows)
+    assert vals == sorted(x * 2 for x in range(120))
+    # exactly `size` distinct actor processes served the 12 blocks
+    pids = {pid for _, pid in rows}
+    assert len(pids) == 3
+
+
+def test_actor_pool_composes_with_task_stages(cluster):
+    ds = (rdata.from_items(list(range(60)), parallelism=6)
+          .map_batches(lambda b: [x + 1 for x in b])
+          .map_batches(_Doubler, compute=rdata.ActorPoolStrategy(size=2))
+          .map_batches(lambda b: [v for v, _ in b]))
+    rows = sorted(r for b in ds.iter_batches() for r in b)
+    assert rows == sorted((x + 1) * 2 for x in range(60))
+
+
+def test_lazy_read_still_supports_eager_consumers(cluster, tmp_path):
+    """Lazy sources materialize transparently for non-streaming ops."""
+    import pandas as pd
+
+    f = tmp_path / "t.csv"
+    pd.DataFrame({"a": [1, 2, 3], "b": [4.0, 5.0, 6.0]}).to_csv(
+        f, index=False)
+    ds = rdata.read_csv(str(f))
+    assert ds.count() == 3
+    rows = list(ds.iter_rows())
+    assert len(rows) == 3
