@@ -187,6 +187,7 @@ class ControlPlane:
         self._install_routes()
         self._bg: list[asyncio.Task] = []
         self._stopping = False
+        self._flush_fut: asyncio.Future | None = None
         # GCS fault tolerance (reference gcs_table_storage.h:252 +
         # redis_store_client.h:28, scaled to a file-backed store): durable
         # tables are snapshotted; a restarted head reloads them, agents
@@ -255,11 +256,44 @@ class ControlPlane:
         while True:
             await asyncio.sleep(0.5)
             if self._dirty:
-                self._dirty = False
                 try:
                     self._write_snapshot()
+                    self._dirty = False
                 except Exception:  # noqa: BLE001
                     logger.exception("snapshot write failed")
+
+    async def flush_durable(self):
+        """Group-commit write-through for control-table mutations
+        (reference: per-write Redis tables, redis_store_client.h:28; here
+        coalesced into one snapshot write per ~20 ms window). An RPC that
+        awaits this before replying guarantees its acked state survives a
+        head CRASH, not just a graceful restart — the periodic loop alone
+        leaves acked-then-lost windows of up to its interval.
+
+        High-rate data-plane state (the object directory) deliberately
+        does NOT write through: agents re-announce primaries on
+        reconnect, so locations rebuild without durability."""
+        if not self.persist_path:
+            return
+        if self._flush_fut is None:
+            loop = asyncio.get_running_loop()
+            self._flush_fut = fut = loop.create_future()
+
+            async def _do():
+                await asyncio.sleep(0.02)  # coalesce concurrent acks
+                self._flush_fut = None
+                try:
+                    self._write_snapshot()
+                    # only a SUCCESSFUL write clears dirty: coalesced
+                    # mark_dirty-only mutations must stay retryable by
+                    # the periodic loop if the disk write fails
+                    self._dirty = False
+                    fut.set_result(None)
+                except Exception as e:  # noqa: BLE001
+                    fut.set_exception(e)
+
+            asyncio.ensure_future(_do())
+        await asyncio.shield(self._flush_fut)
 
     # ---------------- lifecycle ----------------
 
@@ -318,6 +352,12 @@ class ControlPlane:
         ok = self.kv.put(p["ns"], p["key"], p["value"],
                          p.get("overwrite", True))
         self.mark_dirty()
+        if p.get("durable", True):
+            # acked KV writes survive a crash; durable=False lets a
+            # multi-key writer mark intermediate keys dirty and pay ONE
+            # group-commit on its final (durable) key — the coalesced
+            # snapshot covers the whole group
+            await self.flush_durable()
         return ok
 
     async def rpc_kv_get(self, conn, p):
@@ -326,6 +366,7 @@ class ControlPlane:
     async def rpc_kv_del(self, conn, p):
         ok = self.kv.delete(p["ns"], p["key"])
         self.mark_dirty()
+        await self.flush_durable()
         return ok
 
     async def rpc_kv_keys(self, conn, p):
@@ -500,6 +541,8 @@ class ControlPlane:
         self.actors[aid] = actor
         await self._schedule_actor(actor)
         self.mark_dirty()
+        # acked actor registrations (esp. named/detached) survive a crash
+        await self.flush_durable()
         return {"actor_id": aid, "existing": False}
 
     async def _schedule_actor(self, actor: dict):
@@ -662,6 +705,8 @@ class ControlPlane:
     async def rpc_kill_actor(self, conn, p):
         await self._kill_actor(p["actor_id"], p.get("no_restart", True),
                                p.get("reason", "ray_tpu.kill"))
+        # an acked kill must not resurrect after a head crash
+        await self.flush_durable()
         return True
 
     async def _kill_actor(self, aid: bytes, no_restart: bool, reason: str):

@@ -163,6 +163,12 @@ class NodeAgent:
             tempfile.gettempdir(),
             f"ray_tpu_spill_{self.session_id}_{self.node_id.hex()[:8]}",
         )
+        # session log dir (reference session_latest/logs): per-worker
+        # stdout/err files served via rpc_list_logs / rpc_read_log
+        self.log_dir = os.path.join(
+            tempfile.gettempdir(),
+            f"ray_tpu_logs_{self.session_id}_{self.node_id.hex()[:8]}",
+        )
         self._spilling = False
         self._bg: list[asyncio.Task] = []
         # Native (C++) hybrid placement core; None falls back to the pure-
@@ -398,12 +404,21 @@ class NodeAgent:
         loop = asyncio.get_running_loop()
 
         def _read(stream, kind):
-            for line in iter(stream.readline, b""):
-                text = line.decode(errors="replace").rstrip()
-                if text:
-                    loop.call_soon_threadsafe(
-                        self._publish_log, w.worker_id, kind, text
-                    )
+            # per-process log file under the session log dir (reference
+            # session_latest/logs/worker-*.out|err + log_monitor.py): the
+            # live pubsub stream stays, the file is what survives a
+            # driver disconnect and what /api/logs serves
+            path = os.path.join(
+                self.log_dir, f"worker-{w.worker_id.hex()[:12]}.{kind}")
+            os.makedirs(self.log_dir, exist_ok=True)
+            with open(path, "ab", buffering=0) as logf:
+                for line in iter(stream.readline, b""):
+                    logf.write(line)
+                    text = line.decode(errors="replace").rstrip()
+                    if text:
+                        loop.call_soon_threadsafe(
+                            self._publish_log, w.worker_id, kind, text
+                        )
             stream.close()
 
         for stream, kind in ((w.proc.stdout, "out"), (w.proc.stderr, "err")):
@@ -422,6 +437,42 @@ class NodeAgent:
             })
         except Exception:
             pass
+
+    async def rpc_list_logs(self, conn, p):
+        """Log files on this node (reference dashboard log_manager)."""
+        try:
+            files = sorted(os.listdir(self.log_dir))
+        except FileNotFoundError:
+            return []
+        out = []
+        for fn in files:
+            try:
+                out.append({
+                    "file": fn,
+                    "bytes": os.path.getsize(
+                        os.path.join(self.log_dir, fn)),
+                })
+            except OSError:
+                continue
+        return out
+
+    async def rpc_read_log(self, conn, p):
+        """Tail (or range-read) one log file. The name is confined to the
+        session log dir — no path traversal."""
+        fn = os.path.basename(p["file"])
+        path = os.path.join(self.log_dir, fn)
+        if not os.path.exists(path):
+            return None
+        tail = int(p.get("tail_bytes", 64 * 1024))
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            start = int(p["offset"]) if "offset" in p else max(
+                0, size - tail)
+            f.seek(start)
+            data = f.read(min(tail, 4 * 1024 * 1024))
+        return {"file": fn, "offset": start, "size": size,
+                "data": data.decode(errors="replace")}
 
     async def rpc_register_executor(self, conn, p):
         """A spawned worker process reports its direct-RPC address."""

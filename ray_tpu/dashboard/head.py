@@ -11,6 +11,7 @@ modules/reporter (node stats + stack dumps). Endpoints:
   GET /api/objects   object directory sample
   GET /api/cluster   summary (alive nodes, resource totals)
   GET /api/stacks    thread stacks of every worker (py-spy analog)
+  GET /api/logs      per-node log files; ?node_id=&file= tails one
   GET /metrics       Prometheus text format (cluster + user metrics)
 
 Runs inside the driver (or any process with cluster access) on a
@@ -123,6 +124,17 @@ class DashboardHead:
             "tasks_running": sum(n.get("running", 0) for n in alive),
         }
 
+    def _agent_call(self, node: dict, method: str, payload: dict):
+        from ray_tpu._private import rpc as _rpc
+        from ray_tpu._private.api import _get_worker
+
+        cli = _rpc.SyncRpcClient(node["addr"], node["port"],
+                                 _get_worker().io)
+        try:
+            return cli.call(method, payload, timeout=10.0)
+        finally:
+            cli.close()
+
     def _api(self, path: str, query: dict):
         head = self._head()
         if path == "/api/nodes":
@@ -139,6 +151,31 @@ class DashboardHead:
                              {"limit": int(query.get("limit", 1000))})
         if path == "/api/cluster":
             return self._cluster_summary()
+        if path == "/api/logs":
+            # list log files per node; ?node_id=<hex>&file=<name> fetches
+            # a tail (&tail_bytes=N) — reference dashboard/modules/log
+            node_hex = query.get("node_id")
+            fname = query.get("file")
+            nodes = [n for n in head.call("get_cluster_view", {})["nodes"]
+                     if n["alive"]]
+            if node_hex and fname:
+                n = next((n for n in nodes
+                          if n["node_id"].hex() == node_hex), None)
+                if n is None:
+                    return {"error": f"no alive node {node_hex}"}
+                return self._agent_call(n, "read_log", {
+                    "file": fname,
+                    "tail_bytes": int(query.get("tail_bytes", 65536)),
+                })
+            out = []
+            for n in nodes:
+                try:
+                    files = self._agent_call(n, "list_logs", {})
+                except Exception as e:  # noqa: BLE001
+                    files = {"error": str(e)}
+                out.append({"node_id": n["node_id"].hex(),
+                            "files": files})
+            return out
         if path == "/api/stacks":
             nodes = head.call("get_cluster_view", {})["nodes"]
             out = []
@@ -146,14 +183,7 @@ class DashboardHead:
                 if not n["alive"]:
                     continue
                 try:
-                    from ray_tpu._private import rpc as _rpc
-                    from ray_tpu._private.api import _get_worker
-
-                    cli = _rpc.SyncRpcClient(
-                        n["addr"], n["port"], _get_worker().io
-                    )
-                    out.append(cli.call("dump_stacks", {}, timeout=10.0))
-                    cli.close()
+                    out.append(self._agent_call(n, "dump_stacks", {}))
                 except Exception as e:  # noqa: BLE001
                     out.append({"node_id": n["node_id"],
                                 "error": str(e)})

@@ -145,3 +145,50 @@ def test_dashboard_stacks(cluster):
     text = json.dumps(dumps)
     assert "parked" in text or "time.sleep" in text
     ray_tpu.get(ref, timeout=30)
+
+
+def test_dashboard_logs(cluster):
+    """Per-worker log files + /api/logs listing and tailing (reference
+    log_monitor + dashboard/modules/log)."""
+    from ray_tpu.dashboard import start_dashboard
+
+    @ray_tpu.remote
+    def chatty():
+        print("hello-from-worker-stdout")
+        import sys
+
+        print("warn-on-stderr", file=sys.stderr)
+        return 1
+
+    assert ray_tpu.get(chatty.remote(), timeout=60) == 1
+    addr = start_dashboard()
+
+    # listing: at least one node exposes worker-*.out files
+    deadline = time.monotonic() + 20
+    listing = []
+    while time.monotonic() < deadline:
+        status, body = _get(addr, "/api/logs")
+        assert status == 200
+        listing = json.loads(body)
+        files = [f for n in listing for f in n["files"]
+                 if isinstance(f, dict)]
+        if any(f["file"].endswith(".out") and f["bytes"] > 0
+               for f in files):
+            break
+        time.sleep(0.3)
+    node = next(n for n in listing
+                if any(isinstance(f, dict) and f["file"].endswith(".out")
+                       and f["bytes"] > 0 for f in n["files"]))
+    # find the file containing our line (several pool workers may exist)
+    found = False
+    for f in node["files"]:
+        if not f["file"].endswith(".out"):
+            continue
+        status, body = _get(
+            addr, f"/api/logs?node_id={node['node_id']}&file={f['file']}")
+        assert status == 200
+        tail = json.loads(body)
+        if "hello-from-worker-stdout" in tail["data"]:
+            found = True
+            break
+    assert found, "worker stdout line not served via /api/logs"

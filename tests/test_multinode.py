@@ -76,8 +76,6 @@ def test_placement_group_pack(cluster3):
 
 
 def test_node_death_actor_restarts_elsewhere(cluster3):
-    victim = cluster3.agents[-1]
-
     # 1-CPU actors on 2-CPU nodes: after a node dies, the survivors still
     # have spare capacity so the restart is actually placeable.
     @ray_tpu.remote(num_cpus=1)
@@ -89,13 +87,17 @@ def test_node_death_actor_restarts_elsewhere(cluster3):
 
     actors = [Pinned.options(max_restarts=3).remote() for _ in range(3)]
     homes = ray_tpu.get([a.node.remote() for a in actors], timeout=120)
-    target_hex = victim.node_id.hex()
-    victims = [a for a, h in zip(actors, homes) if h == target_hex]
-    if not victims:
-        pytest.skip("no actor landed on victim node")
+    # DETERMINISTIC victim choice: kill whichever NON-HEAD node actually
+    # hosts an actor (the old fixed-victim version silently skipped on a
+    # lucky placement — a chaos assertion that can vanish isn't one)
+    head_hex = cluster3.head_agent.node_id.hex()
+    by_home = {h: a for a, h in zip(actors, homes) if h != head_hex}
+    assert by_home, f"all actors landed on the head node: {homes}"
+    target_hex, a = next(iter(by_home.items()))
+    victim = next(ag for ag in cluster3.agents
+                  if ag.node_id.hex() == target_hex)
     # chaos: kill the node (reference NodeKillerActor analog)
     cluster3.remove_node(victim)
-    a = victims[0]
     deadline = time.time() + 60
     new_home = None
     while time.time() < deadline:
