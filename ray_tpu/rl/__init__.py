@@ -15,3 +15,10 @@ from ray_tpu.rl.learner_group import LearnerGroup  # noqa: F401
 from ray_tpu.rl.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rl.bc import BC, BCConfig  # noqa: F401
 from ray_tpu.rl.replay import ReplayBuffer  # noqa: F401
+from ray_tpu.rl.impala import IMPALA, IMPALAConfig  # noqa: F401
+from ray_tpu.rl.vector_env import VectorEnvRunner  # noqa: F401
+from ray_tpu.rl.multi_agent import (  # noqa: F401
+    MultiAgentEnv,
+    SharedPolicyWrapper,
+)
+from ray_tpu.rl.vtrace import vtrace  # noqa: F401

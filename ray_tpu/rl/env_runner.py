@@ -78,3 +78,40 @@ def _episode_return_mean(rewards, dones) -> float:
             returns.append(cur)
             cur = 0.0
     return float(np.mean(returns)) if returns else float(cur)
+
+
+def softmax_sample(rng, logits: np.ndarray):
+    """Sample actions + log-probs from policy logits ([A] or [N, A]) —
+    the ONE numerically-guarded implementation shared by every runner."""
+    logits = np.asarray(logits, np.float64)
+    p = np.exp(logits - logits.max(axis=-1, keepdims=True))
+    p /= p.sum(axis=-1, keepdims=True)
+    if logits.ndim == 1:
+        a = int(rng.choice(len(p), p=p))
+        return a, float(np.log(p[a] + 1e-12))
+    actions = np.array([rng.choice(p.shape[-1], p=row) for row in p])
+    logp = np.log(p[np.arange(len(actions)), actions] + 1e-12)
+    return actions, logp.astype(np.float32)
+
+
+class EpisodeReturns:
+    """Per-env episode-return bookkeeping with the EnvRunner semantics:
+    the mean over recently finished episodes, falling back to the mean
+    PARTIAL return when none finished yet (never a fake 0.0 sentinel)."""
+
+    def __init__(self, num_envs: int, window: int = 20):
+        import collections
+
+        self.partial = np.zeros(num_envs, np.float64)
+        self.done = collections.deque(maxlen=window)
+
+    def step(self, env_idx: int, reward: float, done: bool):
+        self.partial[env_idx] += reward
+        if done:
+            self.done.append(self.partial[env_idx])
+            self.partial[env_idx] = 0.0
+
+    def mean(self) -> float:
+        if self.done:
+            return float(np.mean(self.done))
+        return float(np.mean(self.partial))

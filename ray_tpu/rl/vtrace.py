@@ -1,0 +1,62 @@
+"""V-trace off-policy correction (Espeholt et al. 2018 — the math behind
+reference rllib/algorithms/impala; implemented TPU-first as a reverse
+lax.scan rather than a python loop).
+
+Given behavior-policy log-probs mu and target-policy log-probs pi over a
+trajectory, compute value targets vs and policy-gradient advantages with
+clipped importance weights:
+
+    rho_t  = min(rho_bar, exp(pi_t - mu_t))
+    c_t    = lambda * min(c_bar, exp(pi_t - mu_t))
+    delta_t = rho_t * (r_t + gamma_t * V_{t+1} - V_t)
+    vs_t   = V_t + delta_t + gamma_t * c_t * (vs_{t+1} - V_{t+1})
+    adv_t  = rho_t * (r_t + gamma_t * vs_{t+1} - V_t)
+
+gamma_t = gamma * (1 - done_t): episode boundaries cut the recursion.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def vtrace(behavior_logp, target_logp, rewards, values, bootstrap_value,
+           dones, *, gamma: float = 0.99, lam: float = 1.0,
+           rho_bar: float = 1.0, c_bar: float = 1.0):
+    """All inputs [T] (single trajectory) or [T, B]; returns (vs, adv).
+
+    Differentiation is stopped through the targets (standard IMPALA:
+    vs/adv are treated as constants by the losses)."""
+    behavior_logp = jnp.asarray(behavior_logp, jnp.float32)
+    target_logp = jnp.asarray(target_logp, jnp.float32)
+    rewards = jnp.asarray(rewards, jnp.float32)
+    values = jnp.asarray(values, jnp.float32)
+    dones = jnp.asarray(dones)
+
+    log_rho = target_logp - behavior_logp
+    rho = jnp.minimum(rho_bar, jnp.exp(log_rho))
+    c = lam * jnp.minimum(c_bar, jnp.exp(log_rho))
+    discount = gamma * (1.0 - dones.astype(jnp.float32))
+
+    next_values = jnp.concatenate(
+        [values[1:], jnp.asarray(bootstrap_value, jnp.float32)[None]]
+    )
+    deltas = rho * (rewards + discount * next_values - values)
+
+    def _step(carry, inp):
+        delta_t, disc_t, c_t, next_v = inp
+        # carry = vs_{t+1} - V_{t+1}
+        err = delta_t + disc_t * c_t * carry
+        return err, err
+
+    _, errs = jax.lax.scan(
+        _step, jnp.zeros_like(deltas[-1]),
+        (deltas, discount, c, next_values), reverse=True,
+    )
+    vs = values + errs
+    next_vs = jnp.concatenate(
+        [vs[1:], jnp.asarray(bootstrap_value, jnp.float32)[None]]
+    )
+    adv = rho * (rewards + discount * next_vs - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(adv)
