@@ -15,6 +15,7 @@ from ray_tpu.data.dataset import (  # noqa: F401
     DataIterator,
     Dataset,
     GroupedDataset,
+    from_arrow,
     from_items,
     from_numpy,
     range as range_,  # `range` shadows the builtin; both names exported

@@ -1,8 +1,9 @@
 """Block row-view helpers.
 
-Blocks come in three shapes (reference block.py's Arrow/pandas/simple
-split): list-of-rows, numpy arrays (rows along axis 0), and pandas
-DataFrames (from the file datasources). Row-oriented ops (sort, groupby,
+Blocks come in four shapes (reference block.py's Arrow/pandas/simple
+split): list-of-rows, numpy arrays (rows along axis 0), pandas
+DataFrames, and pyarrow Tables (zero-copy columnar — the reference's
+default substrate, arrow_block.py). Row-oriented ops (sort, groupby,
 limit, aggregates) go through these helpers so every block type yields
 *rows* — iterating a DataFrame directly would yield column labels.
 """
@@ -12,8 +13,25 @@ from __future__ import annotations
 from ray_tpu.utils.hashing import stable_hash  # noqa: F401 — re-export
 
 
+_ARROW_TYPE = None
+
+
+def _arrow_table_type():
+    global _ARROW_TYPE
+    if _ARROW_TYPE is None:  # memoized: a failed import is NOT cached by
+        try:                 # python, and this runs per block
+            import pyarrow as pa
+
+            _ARROW_TYPE = pa.Table
+        except ImportError:  # pragma: no cover
+            _ARROW_TYPE = ()
+    return _ARROW_TYPE
+
+
 def block_rows(block) -> list:
-    """Rows of a block: dicts for DataFrames, items otherwise."""
+    """Rows of a block: dicts for DataFrames/Tables, items otherwise."""
+    if isinstance(block, _arrow_table_type()):
+        return block.to_pylist()
     try:
         import pandas as pd
 
@@ -28,6 +46,10 @@ def build_like(proto, rows: list):
     """Rebuild a block of `proto`'s type from a row list."""
     import numpy as np
 
+    if isinstance(proto, _arrow_table_type()):
+        import pyarrow as pa
+
+        return pa.Table.from_pylist(rows, schema=proto.schema)
     try:
         import pandas as pd
 

@@ -354,11 +354,16 @@ class Dataset:
 
         def _filter_block(block):
             from ray_tpu._private import serialization as S
+            from ray_tpu.data.block import block_rows, build_like
 
             p = S.unpack_payload(pred_blob)
             if isinstance(block, np.ndarray):
                 return block[[bool(p(row)) for row in block]]
-            return [row for row in block if p(row)]
+            if isinstance(block, list):
+                return [row for row in block if p(row)]
+            # tabular blocks (DataFrame / arrow Table): row views, same type out
+            return build_like(
+                block, [r for r in block_rows(block) if p(r)])
 
         return self.map_batches(_filter_block, **kw)
 
@@ -681,6 +686,19 @@ def from_items(items: Iterable[Any],
         for i in builtins.range(0, len(items), chunk)
     ]
     return Dataset(blocks)
+
+
+def from_arrow(table, parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
+    """Dataset over pyarrow Table blocks (zero-copy row slices)."""
+    n = len(table)
+    if n == 0:
+        return Dataset([])
+    k = min(parallelism, n)
+    chunk = (n + k - 1) // k
+    return Dataset([
+        ray_tpu.put(table.slice(i, chunk))
+        for i in builtins.range(0, n, chunk)
+    ])
 
 
 def from_numpy(arr: np.ndarray,

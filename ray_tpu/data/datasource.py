@@ -76,6 +76,14 @@ def _parquet_reader(path, kw):
     return _read
 
 
+def _parquet_arrow_reader(path, kw):
+    def _read():
+        import pyarrow.parquet as pq
+
+        return pq.read_table(path, **kw)
+    return _read
+
+
 def _text_reader(path, encoding):
     def _read():
         with open(path, encoding=encoding) as f:
@@ -100,8 +108,11 @@ def read_json(paths, **kw) -> "Dataset":
     return _mk_lazy(_json_reader(p, kw) for p in _expand(paths))
 
 
-def read_parquet(paths, **kw) -> "Dataset":
-    return _mk_lazy(_parquet_reader(p, kw) for p in _expand(paths))
+def read_parquet(paths, *, use_arrow: bool = False, **kw) -> "Dataset":
+    """use_arrow=True: blocks are zero-copy pyarrow Tables (the
+    reference's default block substrate, arrow_block.py)."""
+    reader = _parquet_arrow_reader if use_arrow else _parquet_reader
+    return _mk_lazy(reader(p, kw) for p in _expand(paths))
 
 
 def read_text(paths, *, encoding: str = "utf-8") -> "Dataset":

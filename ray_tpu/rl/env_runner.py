@@ -42,16 +42,13 @@ class EnvRunner:
         obs = self._obs
         for _ in range(n_steps):
             logits, value = self._fwd(self.params, jnp.asarray(obs[None]))
-            logits = np.asarray(logits[0], np.float64)
-            p = np.exp(logits - logits.max())
-            p /= p.sum()
-            a = int(self.rng.choice(len(p), p=p))
+            a, lp = softmax_sample(self.rng, np.asarray(logits[0]))
             nxt, r, done, _ = self.env.step(a)
             obs_l.append(obs)
             act_l.append(a)
             rew_l.append(float(r))
             done_l.append(bool(done))
-            logp_l.append(float(np.log(p[a] + 1e-12)))
+            logp_l.append(lp)
             val_l.append(float(value[0]))
             obs = (np.asarray(self.env.reset(), np.float32) if done
                    else np.asarray(nxt, np.float32))

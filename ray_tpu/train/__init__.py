@@ -30,6 +30,10 @@ from ray_tpu.train.trainer import (  # noqa: F401
     ScalingConfig,
 )
 from ray_tpu.train import session  # noqa: F401
+from ray_tpu.train.gbdt import (  # noqa: F401,E402
+    GBDTPredictor,
+    GBDTTrainer,
+)
 from ray_tpu.train.sklearn import (  # noqa: F401,E402
     BatchPredictor,
     Predictor,

@@ -19,6 +19,7 @@ from ray_tpu.tune.tuner import (  # noqa: F401
     report,
     uniform,
 )
+from ray_tpu.tune.trainable import Trainable  # noqa: F401
 from ray_tpu.tune.search import (  # noqa: F401
     BasicVariantGenerator,
     Searcher,

@@ -1,0 +1,79 @@
+"""Class Trainable API (reference tune/trainable/trainable.py:106).
+
+Subclass and implement:
+
+    class MyTrainable(tune.Trainable):
+        def setup(self, config): ...
+        def step(self) -> dict: ...               # one training iteration
+        def save_checkpoint(self, checkpoint_dir) -> None: ...
+        def load_checkpoint(self, checkpoint_dir) -> None: ...
+
+Pass the CLASS to Tuner; the driver loop calls step() until a scheduler
+stops the trial (or step() returns {"done": True}), checkpointing every
+`checkpoint_frequency` iterations so ASHA/PBT cloning and
+Tuner.restore() work exactly like with function trainables.
+"""
+
+from __future__ import annotations
+
+import tempfile
+
+
+class Trainable:
+    checkpoint_frequency: int = 1  # save every N steps (0 = never)
+
+    def setup(self, config: dict) -> None:  # pragma: no cover — hook
+        pass
+
+    def step(self) -> dict:  # pragma: no cover — interface
+        raise NotImplementedError
+
+    def save_checkpoint(self, checkpoint_dir: str) -> None:
+        pass  # pragma: no cover — optional hook
+
+    def load_checkpoint(self, checkpoint_dir: str) -> None:
+        pass  # pragma: no cover — optional hook
+
+    def cleanup(self) -> None:
+        pass  # pragma: no cover — optional hook
+
+
+def wrap_trainable_class(cls) -> "callable":
+    """Class Trainable -> function trainable driving the step loop.
+
+    The class is packed BY VALUE here: the wrapper function itself lives
+    in a ray_tpu module (pickled by reference), so a closure over the
+    raw class from a driver-only module would not import on workers."""
+    from ray_tpu._private import serialization
+
+    cls_blob = serialization.pack_callable(cls)
+
+    def _fn(config: dict):
+        from ray_tpu._private import serialization as S
+        from ray_tpu.train.checkpoint import Checkpoint
+        from ray_tpu.tune import get_checkpoint, report
+
+        t = S.unpack_payload(cls_blob)()
+        t.setup(config)
+        ck = get_checkpoint()
+        if ck is not None:
+            t.load_checkpoint(ck.path)
+        i = 0
+        try:
+            while True:
+                i += 1
+                metrics = t.step()
+                ckpt = None
+                freq = getattr(t, "checkpoint_frequency", 1)
+                if freq and i % freq == 0:
+                    d = tempfile.mkdtemp(prefix="ray_tpu_trainable_")
+                    t.save_checkpoint(d)
+                    ckpt = Checkpoint(d)
+                report(dict(metrics), checkpoint=ckpt)
+                if metrics.get("done"):
+                    return
+        finally:
+            t.cleanup()
+
+    _fn.__name__ = f"trainable_{cls.__name__}"
+    return _fn

@@ -342,7 +342,12 @@ class Tuner:
     def fit(self) -> ResultGrid:
         from ray_tpu._private import serialization
 
-        fn_blob = serialization.pack_callable(self.trainable)
+        trainable = self.trainable
+        from ray_tpu.tune.trainable import Trainable, wrap_trainable_class
+
+        if isinstance(trainable, type) and issubclass(trainable, Trainable):
+            trainable = wrap_trainable_class(trainable)
+        fn_blob = serialization.pack_callable(trainable)
         sched = self.cfg.scheduler
         if sched is not None and sched.metric is None:
             sched.metric = self.cfg.metric

@@ -1,0 +1,152 @@
+"""GBDT trainer + class Trainable API + Arrow blocks (VERDICT Missing #7
++ 2.10 Trainable row + 2.11 Arrow block row)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rdata
+from ray_tpu import tune
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(head_resources={"CPU": 6, "memory": 4 * 2**30})
+    c.connect()
+    yield c
+    c.shutdown()
+
+
+def _toy_frame(n=300, seed=0):
+    import pandas as pd
+
+    rng = np.random.RandomState(seed)
+    x0 = rng.randn(n)
+    x1 = rng.randn(n)
+    y = 3.0 * x0 - 2.0 * x1 + rng.randn(n) * 0.1
+    return pd.DataFrame({"x0": x0, "x1": x1, "y": y})
+
+
+def test_gbdt_trainer_with_early_stopping(cluster):
+    from ray_tpu.train.gbdt import GBDTPredictor, GBDTTrainer
+
+    df = _toy_frame(400)
+    train = rdata.from_items(df.iloc[:300].to_dict("records"))
+    valid = rdata.from_items(df.iloc[300:].to_dict("records"))
+
+    result = GBDTTrainer(
+        datasets={"train": train, "valid": valid}, label_column="y",
+        params={"learning_rate": 0.2, "max_depth": 3},
+        num_boost_round=80, rounds_per_report=10,
+        early_stopping_rounds=30, mode="regression",
+    ).fit()
+    assert result.metrics["valid_score"] > 0.9  # R^2 on an easy linear fn
+    assert len(result.metrics["history"]) >= 2  # per-round reports exist
+    assert result.metrics["best_iteration"] > 0
+
+    # predictor round-trips through the directory checkpoint
+    pred = GBDTPredictor.from_checkpoint(result.checkpoint)
+    X = df.iloc[300:][["x0", "x1"]].to_numpy()
+    out = pred.predict(X)
+    assert np.corrcoef(out, df.iloc[300:]["y"])[0, 1] > 0.95
+
+
+def test_gbdt_classification(cluster):
+    from ray_tpu.train.gbdt import GBDTTrainer
+
+    rng = np.random.RandomState(1)
+    rows = [{"a": float(a), "b": float(b), "label": int(a + b > 0)}
+            for a, b in rng.randn(200, 2)]
+    result = GBDTTrainer(
+        datasets={"train": rdata.from_items(rows)}, label_column="label",
+        num_boost_round=30, mode="classification",
+    ).fit()
+    assert result.metrics["train_score"] > 0.9
+
+
+def test_batch_predictor_over_dataset_with_gbdt(cluster):
+    """Generic BatchPredictor path with the GBDT predictor over Dataset
+    blocks (the 'generic Predictor/BatchPredictor' half of Missing #7)."""
+    from ray_tpu.train.gbdt import GBDTPredictor, GBDTTrainer
+
+    df = _toy_frame(200, seed=3)
+    train = rdata.from_items(df.to_dict("records"))
+    result = GBDTTrainer(datasets={"train": train}, label_column="y",
+                         num_boost_round=40).fit()
+    ckpt = result.checkpoint
+
+    features = rdata.from_numpy(df[["x0", "x1"]].to_numpy(), parallelism=4)
+    pred_ds = features.map_batches(
+        lambda b, _c=ckpt: GBDTPredictor.from_checkpoint(_c).predict(b))
+    preds = np.concatenate(list(pred_ds.iter_batches()))
+    assert preds.shape == (200,)
+    assert np.corrcoef(preds, df["y"])[0, 1] > 0.95
+
+
+class _Quadratic(tune.Trainable):
+    checkpoint_frequency = 1
+
+    def setup(self, config):
+        self.x = config["x"]
+        self.i = 0
+
+    def step(self):
+        self.i += 1
+        return {"loss": (self.x - 0.5) ** 2 + 1.0 / self.i, "iter": self.i}
+
+    def save_checkpoint(self, d):
+        import json
+        import os
+
+        with open(os.path.join(d, "state.json"), "w") as f:
+            json.dump({"i": self.i}, f)
+
+    def load_checkpoint(self, d):
+        import json
+        import os
+
+        with open(os.path.join(d, "state.json")) as f:
+            self.i = json.load(f)["i"]
+
+
+def test_class_trainable_with_scheduler(cluster):
+    """Class Trainable API: ASHA drives step()/checkpointing like a
+    function trainable (reference trainable/trainable.py:106)."""
+    tuner = tune.Tuner(
+        _Quadratic,
+        param_space={"x": tune.grid_search([0.0, 0.5, 1.5])},
+        tune_config=tune.TuneConfig(
+            metric="loss", mode="min", max_concurrent_trials=3,
+            scheduler=tune.ASHAScheduler(max_t=8, grace_period=2),
+        ),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 3
+    best = grid.get_best_result()
+    assert best.config["x"] == 0.5
+    # every trial produced checkpoints through the class hooks
+    assert any(r.checkpoint is not None for r in grid)
+
+
+def test_arrow_blocks(cluster, tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    table = pa.table({"a": list(range(100)), "b": [i * 0.5 for i in range(100)]})
+    ds = rdata.from_arrow(table, parallelism=4)
+    assert ds.count() == 100
+    rows = list(ds.iter_rows())
+    assert rows[3] == {"a": 3, "b": 1.5}
+
+    # arrow blocks flow through map/filter/sort like any other block type
+    out = (ds.filter(lambda r: r["a"] % 2 == 0)
+           .map_batches(lambda t: t))
+    assert out.count() == 50
+
+    # arrow-native parquet read
+    pq.write_table(table, tmp_path / "t.parquet")
+    ds2 = rdata.read_parquet(str(tmp_path / "t.parquet"), use_arrow=True)
+    blocks = list(ds2.iter_batches())
+    assert isinstance(blocks[0], pa.Table)
+    assert sum(len(b) for b in blocks) == 100
