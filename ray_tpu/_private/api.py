@@ -265,6 +265,13 @@ def init(address: str | None = None, *, num_cpus: float | None = None,
     with _state_lock:
         if _worker is not None:
             return {"address": "existing"}
+        if address is not None and address.startswith("ray://"):
+            # remote (agent-less) driver: full CoreWorker protocol over
+            # TCP, plasma data plane via agent RPCs (_private/client.py)
+            from ray_tpu._private.client import connect as _client_connect
+
+            _worker = _client_connect(address, namespace=namespace)
+            return {"address": address, "mode": "client"}
         if address is None:
             res = dict(resources or {})
             if num_cpus is not None:

@@ -1,0 +1,88 @@
+"""ray:// remote drivers (reference util/client/ARCHITECTURE.md, scaled).
+
+A driver on a host with NO node agent connects with
+`ray_tpu.init(address="ray://HEAD_HOST:PORT")`. Control-plane RPCs
+already travel TCP; the only true co-location dependency is the
+shared-memory object store. RemoteDriverWorker keeps the ENTIRE
+CoreWorker protocol (ownership, refcounts, lease caching, result
+pushes — all TCP) and swaps just the plasma data plane for agent RPCs:
+
+    put  -> agent store_put   (create+seal+announce on the agent's node)
+    get  -> agent store_get   (serialized parts back over the wire)
+
+so a remote driver sees the same API at the cost of network data
+movement — exactly the reference's ray-client trade. The head picks the
+attach node (most free store capacity could be a future refinement;
+first alive node today).
+"""
+
+from __future__ import annotations
+
+from ray_tpu._private import rpc
+from ray_tpu._private import serialization
+from ray_tpu._private.worker import CoreWorker
+
+
+class RemoteDriverWorker(CoreWorker):
+    """CoreWorker for an agent-less host: plasma rides agent RPCs."""
+
+    MAX_REMOTE_OBJECT = 512 * 1024 * 1024  # single-frame RPC transfer cap
+
+    def _put_plasma(self, oid: bytes, payload):
+        meta, bufs = payload
+        table, total = serialization.pack_part_table(meta, bufs)
+        if total > self.MAX_REMOTE_OBJECT:
+            raise ValueError(
+                f"remote (ray://) put of {total} bytes exceeds the "
+                f"{self.MAX_REMOTE_OBJECT}-byte single-transfer cap")
+        body = b"".join([bytes(meta)] + [bytes(b) for b in bufs])
+        ok = self.agent.call("store_put", {
+            "object_id": oid, "meta_table": table, "data": body,
+            "owner": self.owner_address,
+        }, timeout=300)
+        if not ok:
+            raise RuntimeError("remote store_put failed (store full?)")
+
+    def _read_plasma(self, oid: bytes):
+        r = self.agent.call("store_get", {"object_id": oid}, timeout=300)
+        if r is None:
+            return None
+        parts = serialization.unpack_parts(r["meta_table"], r["data"])
+        return serialization.loads_oob(parts[0], parts[1:])
+
+
+def connect(address: str, *, namespace: str = "default",
+            job_id: bytes | None = None) -> RemoteDriverWorker:
+    """Dial a cluster head by `ray://host:port` and build the remote
+    driver against the first alive node's agent."""
+    from ray_tpu._private.ids import JobID
+    from ray_tpu._private.rpc import EventLoopThread
+
+    hostport = address[len("ray://"):]
+    host, _, port_s = hostport.rpartition(":")
+    head_port = int(port_s)
+
+    io = EventLoopThread("ray_tpu-client-probe")
+    probe = rpc.SyncRpcClient(host, head_port, io)
+    try:
+        view = probe.call("get_cluster_view", {})
+    finally:
+        probe.close()
+        io.stop()
+    nodes = [n for n in view["nodes"] if n["alive"]]
+    if not nodes:
+        raise RuntimeError(f"cluster at {address} has no alive nodes")
+    node = nodes[0]
+
+    w = RemoteDriverWorker(
+        head_addr=host, head_port=head_port,
+        agent_addr=node["addr"], agent_port=node["port"],
+        store_name=None, node_id=node["node_id"],
+        job_id=job_id or JobID.from_random().binary(), is_driver=True,
+    )
+    w.namespace = namespace
+    w.register_job({
+        "job_id": w.job_id,
+        "driver_addr": [w.addr, w.port],
+    })
+    return w

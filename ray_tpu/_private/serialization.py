@@ -105,3 +105,26 @@ def pack_callable(fn) -> list:
     finally:
         if by_value:
             cloudpickle.unregister_pickle_by_value(mod)
+
+
+# -- plasma object layout (shared by local CoreWorker and the ray://
+# remote data plane): [<I n][n x <Q sizes] table in the object metadata,
+# concatenated parts (meta + oob buffers) in the object body --
+
+def pack_part_table(meta: bytes, bufs) -> tuple[bytes, int]:
+    import struct
+
+    sizes = [len(meta)] + [len(b) for b in bufs]
+    return struct.pack(f"<I{len(sizes)}Q", len(sizes), *sizes), sum(sizes)
+
+
+def unpack_parts(table: bytes, data) -> list:
+    import struct
+
+    (n,) = struct.unpack_from("<I", table, 0)
+    sizes = struct.unpack_from(f"<{n}Q", table, 4)
+    parts, off = [], 0
+    for s in sizes:
+        parts.append(data[off:off + s])
+        off += s
+    return parts

@@ -122,7 +122,11 @@ class CoreWorker:
         self.head = rpc.SyncRpcClient(head_addr, head_port, self.io,
                                       reconnect=True)
         self.agent = rpc.SyncRpcClient(agent_addr, agent_port, self.io)
-        self.store = ObjectStoreClient.attach(store_name)
+        # store_name=None: remote (ray://) driver with no co-located shm
+        # store — RemoteDriverWorker overrides the plasma paths with agent
+        # RPCs instead
+        self.store = (ObjectStoreClient.attach(store_name)
+                      if store_name is not None else None)
         self.memory: dict[bytes, _ResultEntry] = {}
         self._mem_lock = threading.Lock()
         self.task_counter = _Counter()
@@ -239,7 +243,8 @@ class CoreWorker:
         except Exception:
             pass
         self.io.stop()
-        self.store.close()
+        if self.store is not None:
+            self.store.close()
 
     # ------------- owner-side RPC (results pushed to us) -------------
 
@@ -582,13 +587,10 @@ class CoreWorker:
 
     def _put_plasma(self, oid: bytes, payload):
         meta, bufs = payload
-        # layout: [4-byte meta len][meta][buffers...]; buffer table in object
-        # metadata so deserialize can slice zero-copy.
-        import struct
-
-        sizes = [len(meta)] + [len(b) for b in bufs]
-        table = struct.pack(f"<I{len(sizes)}Q", len(sizes), *sizes)
-        total = sum(sizes)
+        # layout: size table in the object metadata, concatenated parts in
+        # the body, so deserialize can slice zero-copy (shared with the
+        # ray:// remote data plane — serialization.pack_part_table).
+        table, total = serialization.pack_part_table(meta, bufs)
         # Under pressure, block briefly for eviction + async GC to free
         # space (reference create_request_queue.cc admission behavior).
         deadline = time.monotonic() + _config.get("put_pressure_retry_s")
@@ -620,15 +622,7 @@ class CoreWorker:
         buf = self.store.get(oid)
         if buf is None:
             return None
-        import struct
-
-        (n,) = struct.unpack_from("<I", buf.metadata, 0)
-        sizes = struct.unpack_from(f"<{n}Q", buf.metadata, 4)
-        parts = []
-        off = 0
-        for s in sizes:
-            parts.append(buf.data[off:off + s])
-            off += s
+        parts = serialization.unpack_parts(buf.metadata, buf.data)
         value = serialization.loads_oob(parts[0], parts[1:])
         # Zero-copy: numpy arrays in `value` view the store segment directly.
         # The ObjectBuffer's refcount pin must outlive every such array, so
@@ -881,7 +875,8 @@ class CoreWorker:
             return None
         inline = spec.get("inline_values", {})
         for d in spec.get("deps", []):
-            if d not in inline and not self.store.contains(d):
+            if d not in inline and (
+                    self.store is None or not self.store.contains(d)):
                 return None  # remote dep: the agent's dep staging handles it
         return tuple(sorted(spec.get("resources", {}).items()))
 

@@ -438,6 +438,59 @@ class NodeAgent:
         except Exception:
             pass
 
+    async def rpc_store_put(self, conn, p):
+        """ray:// remote-driver put: land the object in THIS node's store
+        as a pinned primary, exactly like a local seal (client.py)."""
+        from ray_tpu.core.object_store import StoreFullError
+
+        oid = p["object_id"]
+        data = p["data"]
+        table = p["meta_table"]
+        if self.store.contains(oid):
+            return True
+        # same pressure behavior as a LOCAL put (worker._put_plasma):
+        # evict + wait for async GC/spill within the retry budget — a
+        # remote driver must not fail where a local one would succeed
+        deadline = time.monotonic() + cfg.get("put_pressure_retry_s")
+        while True:
+            try:
+                wbuf = self.store.create_object(oid, len(data), len(table))
+                break
+            except StoreFullError:
+                self.store.evict(len(data))
+                try:
+                    wbuf = self.store.create_object(
+                        oid, len(data), len(table))
+                    break
+                except StoreFullError:
+                    if time.monotonic() > deadline:
+                        return False
+                    await asyncio.sleep(0.05)
+        wbuf.data[:] = data
+        wbuf.meta[:] = table
+        wbuf.seal()
+        await self.rpc_object_sealed(conn, {
+            "object_id": oid, "owner": p.get("owner"), "size": len(data),
+        })
+        return True
+
+    async def rpc_store_get(self, conn, p):
+        """ray:// remote-driver get: serve (pulling first if remote) the
+        object's raw parts over the wire."""
+        oid = p["object_id"]
+        if not self.store.contains(oid):
+            ok = await self._ensure_local(oid)
+            if not ok and not self.store.contains(oid):
+                return None
+        buf = self.store.get(oid)
+        if buf is None:
+            return None
+        try:
+            return {"meta_table": bytes(buf.metadata),
+                    "data": bytes(buf.data)}
+        finally:
+            buf.release()
+
     async def rpc_list_logs(self, conn, p):
         """Log files on this node (reference dashboard log_manager)."""
         try:
@@ -1223,6 +1276,32 @@ class NodeAgent:
             except (rpc.ConnectionLost, rpc.RpcError,
                     asyncio.TimeoutError):
                 pass
+        return {"node_id": self.node_id, "workers": out}
+
+    async def rpc_profile_workers(self, conn, p):
+        """Sample-profile every worker on this node CONCURRENTLY for
+        duration_s (reporter_agent.py:355 CpuProfiling analog)."""
+        duration = float(p.get("duration_s", 2.0))
+        calls = []
+        targets = []
+        for w in list(self.workers.values()):
+            if w.client is None or w.client.closed:
+                continue
+            targets.append(w)
+            calls.append(w.client.call(
+                "profile",
+                {"duration_s": duration,
+                 "interval_s": p.get("interval_s", 0.01)},
+                timeout=duration + 15.0,
+            ))
+        results = await asyncio.gather(*calls, return_exceptions=True)
+        out = []
+        for w, r in zip(targets, results):
+            if isinstance(r, dict):
+                out.append(r)
+            else:  # a failed profile must be visible, not a missing row
+                out.append({"worker_id": w.worker_id, "samples": {},
+                            "error": repr(r)})
         return {"node_id": self.node_id, "workers": out}
 
     async def rpc_task_done(self, conn, p):

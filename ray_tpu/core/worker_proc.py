@@ -151,6 +151,38 @@ class Executor(CoreWorker):
                 out[t.name] = "".join(tb.format_stack(f))
         return {"worker_id": self.worker_id, "stacks": out}
 
+    async def rpc_profile(self, conn, p):
+        """On-demand statistical CPU profile (reference
+        reporter_agent.py:355 CpuProfiling via py-spy): sample every
+        thread's stack at `interval_s` for `duration_s`, count collapsed
+        frame signatures — flamegraph-ready 'stack;stack;... count'
+        lines with zero dependencies."""
+        import traceback as tb
+
+        import asyncio
+
+        duration = min(float(p.get("duration_s", 2.0)), 30.0)
+        interval = max(float(p.get("interval_s", 0.01)), 0.001)
+        counts: dict[str, int] = {}
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + duration
+        while loop.time() < deadline:
+            frames = sys._current_frames()
+            for t in threading.enumerate():
+                f = frames.get(t.ident)
+                if f is None or t is threading.current_thread():
+                    continue
+                sig = ";".join(
+                    f"{fr.name} ({fr.filename.rsplit('/', 1)[-1]}"
+                    f":{fr.lineno})"
+                    for fr in reversed(tb.extract_stack(f))
+                )
+                key = f"{t.name};{sig}"
+                counts[key] = counts.get(key, 0) + 1
+            await asyncio.sleep(interval)
+        return {"worker_id": self.worker_id, "samples": counts,
+                "duration_s": duration, "interval_s": interval}
+
     async def rpc_exit(self, conn, p):
         os._exit(0)
 

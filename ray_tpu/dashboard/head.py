@@ -124,14 +124,15 @@ class DashboardHead:
             "tasks_running": sum(n.get("running", 0) for n in alive),
         }
 
-    def _agent_call(self, node: dict, method: str, payload: dict):
+    def _agent_call(self, node: dict, method: str, payload: dict,
+                    timeout: float = 10.0):
         from ray_tpu._private import rpc as _rpc
         from ray_tpu._private.api import _get_worker
 
         cli = _rpc.SyncRpcClient(node["addr"], node["port"],
                                  _get_worker().io)
         try:
-            return cli.call(method, payload, timeout=10.0)
+            return cli.call(method, payload, timeout=timeout)
         finally:
             cli.close()
 
@@ -176,6 +177,29 @@ class DashboardHead:
                 out.append({"node_id": n["node_id"].hex(),
                             "files": files})
             return out
+        if path == "/api/profile":
+            # ?duration=N seconds of statistical sampling across every
+            # worker on every node; collapsed-stack counts per worker
+            duration = min(float(query.get("duration", 2.0)), 30.0)
+            nodes = [n for n in head.call("get_cluster_view", {})["nodes"]
+                     if n["alive"]]
+
+            # fan out CONCURRENTLY so every node's sample window covers
+            # the same wall-clock period (a sequential sweep would take
+            # N_nodes x duration and never observe the cluster at once)
+            def _one(n):
+                try:
+                    return self._agent_call(
+                        n, "profile_workers", {"duration_s": duration},
+                        timeout=duration + 20.0)
+                except Exception as e:  # noqa: BLE001
+                    return {"node_id": n["node_id"].hex(),
+                            "error": str(e)}
+
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=min(16, len(nodes))) as ex:
+                return list(ex.map(_one, nodes))
         if path == "/api/stacks":
             nodes = head.call("get_cluster_view", {})["nodes"]
             out = []

@@ -192,3 +192,41 @@ def test_dashboard_logs(cluster):
             found = True
             break
     assert found, "worker stdout line not served via /api/logs"
+
+
+def test_dashboard_profile(cluster):
+    """On-demand statistical CPU profiling across workers (reference
+    reporter_agent CpuProfiling / py-spy analog)."""
+    from ray_tpu.dashboard import start_dashboard
+
+    @ray_tpu.remote
+    def started():
+        return True
+
+    @ray_tpu.remote
+    def burn():
+        import time as t
+
+        end = t.time() + 8.0
+        x = 0
+        while t.time() < end:
+            x += 1
+        return x
+
+    # readiness: a task completing means a worker exists and the queue
+    # has drained to `burn` — the sample window then overlaps it
+    ray_tpu.get(started.remote(), timeout=60)
+    ref = burn.remote()
+    time.sleep(0.5)  # let burn dispatch
+    addr = start_dashboard()
+    status, body = _get(addr, "/api/profile?duration=1.5")
+    assert status == 200
+    nodes = json.loads(body)
+    samples = {}
+    for n in nodes:
+        for w in n.get("workers", []):
+            samples.update(w.get("samples", {}))
+    assert samples, "no profile samples collected"
+    # the busy loop shows up in some collapsed stack
+    assert any("burn" in k for k in samples), list(samples)[:3]
+    assert ray_tpu.get(ref, timeout=60) > 0

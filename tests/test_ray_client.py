@@ -1,0 +1,87 @@
+"""ray:// remote drivers (VERDICT Missing #8): a driver with NO
+co-located node agent / shm store drives the full API over TCP."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import api as _api
+from ray_tpu._private.client import RemoteDriverWorker, connect
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(head_resources={"CPU": 4, "memory": 4 * 2**30})
+    c.connect()
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture
+def client(cluster):
+    """A second, agent-less driver session against the same cluster."""
+    w = connect(f"ray://127.0.0.1:{cluster.head_port}")
+    assert isinstance(w, RemoteDriverWorker)
+    assert w.store is None  # the whole point: no shm attachment
+    prev = _api._worker
+    _api._set_global_worker(w)
+    yield w
+    _api._set_global_worker(prev)
+    w.shutdown()
+
+
+def test_client_put_get_roundtrip(client):
+    big = np.arange(500_000)  # plasma-sized: rides the RPC data plane
+    ref = ray_tpu.put(big)
+    out = ray_tpu.get(ref, timeout=60)
+    np.testing.assert_array_equal(out, big)
+    small = ray_tpu.put({"k": 1})
+    assert ray_tpu.get(small, timeout=30) == {"k": 1}
+
+
+def test_client_tasks_and_actors(client):
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    assert ray_tpu.get([double.remote(i) for i in range(8)],
+                       timeout=60) == [0, 2, 4, 6, 8, 10, 12, 14]
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def add(self, k):
+            self.n += k
+            return self.n
+
+    c = Counter.remote()
+    assert ray_tpu.get(c.add.remote(5), timeout=60) == 5
+    assert ray_tpu.get(c.add.remote(2), timeout=60) == 7
+    ray_tpu.kill(c)
+
+
+def test_client_plasma_task_results(client):
+    """Plasma-sized TASK RESULTS flow back to the agent's store and the
+    client reads them over the wire."""
+    @ray_tpu.remote
+    def make(n):
+        return np.ones(n, dtype=np.float64)
+
+    out = ray_tpu.get(make.remote(300_000), timeout=60)
+    assert out.shape == (300_000,)
+    assert float(out.sum()) == 300_000.0
+
+
+def test_client_wait_and_state(client):
+    @ray_tpu.remote
+    def one():
+        return 1
+
+    refs = [one.remote() for _ in range(6)]
+    ready, pending = ray_tpu.wait(refs, num_returns=6, timeout=60)
+    assert len(ready) == 6 and not pending
+    # control-plane state API works through the same TCP head client
+    assert any(n["alive"] for n in ray_tpu.nodes())
