@@ -271,6 +271,10 @@ def init(address: str | None = None, *, num_cpus: float | None = None,
             from ray_tpu._private.client import connect as _client_connect
 
             _worker = _client_connect(address, namespace=namespace)
+            if log_to_driver:
+                _worker.head.on_push("logs", _print_worker_log)
+                _worker.head.call("subscribe", {"channel": "logs"})
+            atexit.register(shutdown)
             return {"address": address, "mode": "client"}
         if address is None:
             res = dict(resources or {})
@@ -823,7 +827,10 @@ def timeline(filename: str | None = None) -> list:
     for ev in events:
         trace.append({
             "name": ev.get("name", "task"),
-            "cat": "task",
+            # user spans (util/profiling.py profile()) land in their own
+            # category so Perfetto can filter them
+            "cat": ("user_span" if ev.get("state") == "PROFILE"
+                    else "task"),
             "ph": "X",  # complete event
             "ts": ev["start_s"] * 1e6,
             "dur": max(0.0, (ev["end_s"] - ev["start_s"]) * 1e6),

@@ -1,0 +1,159 @@
+"""runtime_env packaging: URI-addressed working_dir / py_modules with a
+per-node extraction cache and reference-counted GC.
+
+Reference: python/ray/_private/runtime_env/ (packaging.py upload +
+working_dir.py plugin + URI cache). Scaled flow:
+
+  driver:  local dir -> zip -> blake2b hash -> KV upload (once per
+           cluster, key "pkgs/<hash>") -> env entry becomes
+           "pkg://<hash>" — so a remote (or multi-node) cluster no
+           longer assumes the driver's paths exist everywhere.
+  agent:   "pkg://" URIs download + extract ONCE per node into the
+           session package cache; workers using the env hold a refcount;
+           when the last user exits, the URI becomes GC-able and the
+           cache evicts oldest-idle entries beyond a cap.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import shutil
+import time
+import zipfile
+
+PKG_NS = "pkgs"
+PKG_SCHEME = "pkg://"
+MAX_PKG_BYTES = 100 * 1024 * 1024
+# unused extracted URIs kept around for reuse before GC (reference
+# RAY_RUNTIME_ENV_<...>_CACHE_SIZE analog, count-based)
+IDLE_CACHE_KEEP = 4
+
+
+def _zip_dir(path: str) -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        for root, dirs, files in os.walk(path):
+            dirs.sort()  # deterministic traversal -> stable digest
+            for fn in sorted(files):
+                full = os.path.join(root, fn)
+                z.write(full, os.path.relpath(full, path))
+    data = buf.getvalue()
+    if len(data) > MAX_PKG_BYTES:
+        raise ValueError(
+            f"runtime_env package {path!r} is {len(data)} bytes zipped; "
+            f"cap is {MAX_PKG_BYTES}")
+    return data
+
+
+def dir_fingerprint(runtime_env: dict) -> tuple:
+    """Cheap stat-based content fingerprint of every local dir in the
+    env — the memoization key component that makes edited working_dirs
+    re-package instead of silently shipping stale zips."""
+    entries = []
+    for path in [runtime_env.get("working_dir"),
+                 *(runtime_env.get("py_modules") or [])]:
+        if not (isinstance(path, str) and os.path.isdir(path)):
+            continue
+        for root, dirs, files in os.walk(path):
+            dirs.sort()
+            for fn in sorted(files):
+                full = os.path.join(root, fn)
+                try:
+                    st = os.stat(full)
+                except OSError:
+                    continue
+                entries.append((os.path.relpath(full, path),
+                                st.st_size, st.st_mtime_ns))
+    return tuple(entries)
+
+
+def uris_present(packaged_env: dict, head) -> bool:
+    """Are the env's pkg:// blobs still in the cluster KV? (They are
+    uploaded durable=False, so a head CRASH can drop them — detect and
+    re-upload rather than failing worker spawns.)"""
+    uris = [packaged_env.get("working_dir"),
+            *(packaged_env.get("py_modules") or [])]
+    for u in uris:
+        if isinstance(u, str) and u.startswith(PKG_SCHEME):
+            if head.call("kv_get", {
+                    "ns": PKG_NS,
+                    "key": u[len(PKG_SCHEME):].encode()}) is None:
+                return False
+    return True
+
+
+def package_local_dirs(runtime_env: dict, head) -> dict:
+    """Driver side: replace local-dir working_dir / py_modules entries
+    with pkg:// URIs, uploading each zip to the head KV once."""
+    out = dict(runtime_env)
+
+    def _to_uri(path: str) -> str:
+        if path.startswith(PKG_SCHEME) or not os.path.isdir(path):
+            return path  # already a URI, or a non-dir entry (left as-is)
+        data = _zip_dir(path)
+        digest = hashlib.blake2b(data, digest_size=16).hexdigest()
+        key = digest.encode()
+        if head.call("kv_get", {"ns": PKG_NS, "key": key}) is None:
+            head.call("kv_put", {"ns": PKG_NS, "key": key, "value": data,
+                                 "durable": False})
+        return PKG_SCHEME + digest
+
+    wd = out.get("working_dir")
+    if wd:
+        out["working_dir"] = _to_uri(wd)
+    mods = out.get("py_modules")
+    if mods:
+        out["py_modules"] = [_to_uri(m) for m in mods]
+    return out
+
+
+class PackageCache:
+    """Per-node URI -> extracted-dir cache with worker refcounts
+    (reference working_dir plugin's URI cache + GC)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self._refs: dict[str, int] = {}  # uri -> active workers
+        self._idle_since: dict[str, float] = {}
+
+    def _dir_for(self, uri: str) -> str:
+        return os.path.join(self.root, uri[len(PKG_SCHEME):])
+
+    def dir_if_present(self, uri: str) -> str | None:
+        dest = self._dir_for(uri)
+        return dest if os.path.isdir(dest) else None
+
+    def extract(self, uri: str, data: bytes) -> str:
+        """Extract a downloaded package zip into the cache (idempotent)."""
+        dest = self._dir_for(uri)
+        if os.path.isdir(dest):
+            return dest
+        tmp = dest + ".tmp"
+        shutil.rmtree(tmp, ignore_errors=True)
+        with zipfile.ZipFile(io.BytesIO(data)) as z:
+            z.extractall(tmp)
+        os.replace(tmp, dest)
+        return dest
+
+    def acquire(self, uri: str):
+        self._refs[uri] = self._refs.get(uri, 0) + 1
+        self._idle_since.pop(uri, None)
+
+    def release(self, uri: str):
+        n = self._refs.get(uri, 0) - 1
+        if n <= 0:
+            self._refs.pop(uri, None)
+            self._idle_since[uri] = time.monotonic()
+            self._gc()
+        else:
+            self._refs[uri] = n
+
+    def _gc(self):
+        """Evict oldest-idle extracted URIs beyond the keep cap."""
+        idle = sorted(self._idle_since.items(), key=lambda kv: kv[1])
+        while len(idle) > IDLE_CACHE_KEEP:
+            uri, _ = idle.pop(0)
+            self._idle_since.pop(uri, None)
+            shutil.rmtree(self._dir_for(uri), ignore_errors=True)

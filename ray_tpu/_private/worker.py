@@ -181,6 +181,7 @@ class CoreWorker:
         # references, reference_count.h:115)
         self._task_pins: dict[bytes, list[bytes]] = {}
         self._job_payload: dict | None = None
+        self._packaged_envs: dict[str, dict] = {}
 
     def _resync_head(self):
         try:
@@ -812,6 +813,27 @@ class CoreWorker:
             except (rpc.ConnectionLost, rpc.RpcError):
                 pass
 
+    def _prepare_runtime_env(self, runtime_env: dict) -> dict:
+        """Package local working_dir / py_modules dirs into cluster-wide
+        pkg:// URIs (reference runtime_env packaging.py). Memoized on a
+        stat FINGERPRINT of the dirs (edited content re-packages — a
+        path-only key would ship stale code forever), and the blobs'
+        KV presence is revalidated so a head restart (packages are
+        durable=False) triggers a re-upload instead of spawn failures."""
+        import json as _json
+
+        from ray_tpu._private import runtime_env as _re
+
+        key = _json.dumps(runtime_env, sort_keys=True, default=str)
+        fp = _re.dir_fingerprint(runtime_env)
+        cached = self._packaged_envs.get(key)
+        if (cached is not None and cached[0] == fp
+                and _re.uris_present(cached[1], self.head)):
+            return cached[1]
+        packaged = _re.package_local_dirs(runtime_env, self.head)
+        self._packaged_envs[key] = (fp, packaged)
+        return packaged
+
     # ------------- task submission -------------
 
     def submit_task(self, func, args: tuple, kwargs: dict, *,
@@ -848,7 +870,7 @@ class CoreWorker:
         if scheduling_strategy is not None:
             spec["scheduling_strategy"] = scheduling_strategy
         if runtime_env:
-            spec["runtime_env"] = runtime_env
+            spec["runtime_env"] = self._prepare_runtime_env(runtime_env)
         n_ret = 1 if num_returns == "dynamic" else num_returns
         return_ids = [
             ObjectID.for_task_return(TaskID(task_id), i).binary()
@@ -1074,7 +1096,8 @@ class CoreWorker:
             "pg_id": pg_id, "bundle_index": bundle_index,
             "max_concurrency": max_concurrency,
             "get_if_exists": get_if_exists,
-            "runtime_env": runtime_env,
+            "runtime_env": (self._prepare_runtime_env(runtime_env)
+                            if runtime_env else None),
             "concurrency_groups": concurrency_groups or {},
             "method_groups": method_groups or {},
         })

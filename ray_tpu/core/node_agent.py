@@ -169,6 +169,14 @@ class NodeAgent:
             tempfile.gettempdir(),
             f"ray_tpu_logs_{self.session_id}_{self.node_id.hex()[:8]}",
         )
+        # runtime_env package cache (pkg:// URIs -> extracted dirs with
+        # worker refcounts + GC; _private/runtime_env.py)
+        from ray_tpu._private.runtime_env import PackageCache
+
+        self.pkg_cache = PackageCache(os.path.join(
+            tempfile.gettempdir(),
+            f"ray_tpu_pkgs_{self.session_id}_{self.node_id.hex()[:8]}",
+        ))
         self._spilling = False
         self._bg: list[asyncio.Task] = []
         # Native (C++) hybrid placement core; None falls back to the pure-
@@ -367,11 +375,35 @@ class NodeAgent:
         # hash, so an env mismatch forces a fresh process (worker_pool.h
         # runtime-env-keyed pools).
         cwd = None
+        pkg_uris: list[str] = []
         if runtime_env:
+            from ray_tpu._private.runtime_env import PKG_NS, PKG_SCHEME
+
             env.update({str(k): str(v) for k, v in
                         (runtime_env.get("env_vars") or {}).items()})
-            cwd = runtime_env.get("working_dir")
-            mods = list(runtime_env.get("py_modules") or [])
+
+            async def _resolve(entry):
+                if isinstance(entry, str) and entry.startswith(PKG_SCHEME):
+                    path = self.pkg_cache.dir_if_present(entry)
+                    if path is None:
+                        data = await self.head.call("kv_get", {
+                            "ns": PKG_NS,
+                            "key": entry[len(PKG_SCHEME):].encode(),
+                        })
+                        if data is None:
+                            raise FileNotFoundError(
+                                f"package {entry} not in cluster KV")
+                        path = self.pkg_cache.extract(entry, data)
+                    # acquire NOW, before any later await: a concurrent
+                    # release could otherwise GC this dir mid-spawn
+                    self.pkg_cache.acquire(entry)
+                    pkg_uris.append(entry)
+                    return path
+                return entry
+
+            cwd = await _resolve(runtime_env.get("working_dir"))
+            mods = [await _resolve(m)
+                    for m in (runtime_env.get("py_modules") or [])]
             if cwd:
                 # the worker runs `python -m ray_tpu...` from the new cwd:
                 # keep the framework importable alongside the working_dir
@@ -395,6 +427,7 @@ class NodeAgent:
         handle.job_id = job_id
         handle.holds_tpu = holds_tpu
         handle.env_hash = _env_hash(runtime_env)
+        handle.pkg_uris = pkg_uris  # acquired in _resolve
         self.workers[worker_id] = handle
         asyncio.ensure_future(self._drain_worker_logs(handle))
         return handle
@@ -632,6 +665,10 @@ class NodeAgent:
     def _kill_worker(self, w: WorkerHandle):
         self.workers.pop(w.worker_id, None)
         self._signal_worker_free()  # pool count dropped; waiters may spawn
+        # pop: kill + death-reap can BOTH run for one handle (e.g. the
+        # OOM path); the refcount must release exactly once
+        for uri in w.__dict__.pop("pkg_uris", ()):
+            self.pkg_cache.release(uri)
         if w.client is not None:
             asyncio.ensure_future(w.client.close())
         if w.proc.poll() is None:
@@ -677,6 +714,8 @@ class NodeAgent:
     async def _on_worker_death(self, w: WorkerHandle, code: int):
         self.workers.pop(w.worker_id, None)
         self._signal_worker_free()  # pool count dropped; waiters may spawn
+        for uri in w.__dict__.pop("pkg_uris", ()):
+            self.pkg_cache.release(uri)
         if w.actor_id is not None:
             # actor process died → control plane decides restart
             for r, v in (w.actor_resources or {}).items():

@@ -196,6 +196,8 @@ class DashboardHead:
                     return {"node_id": n["node_id"].hex(),
                             "error": str(e)}
 
+            if not nodes:
+                return []
             from concurrent.futures import ThreadPoolExecutor
 
             with ThreadPoolExecutor(max_workers=min(16, len(nodes))) as ex:
@@ -245,13 +247,19 @@ class DashboardHead:
                 line = await reader.readline()
                 if not line or line in (b"\r\n", b"\n"):
                     break
-                _method, target, _ = line.decode().split(" ", 2)
-                while True:  # drain headers
+                method, target, _ = line.decode().split(" ", 2)
+                clen = 0
+                while True:  # headers (Content-Length matters for PUT)
                     h = await reader.readline()
                     if h in (b"\r\n", b"\n", b""):
                         break
+                    name, _, val = h.decode().partition(":")
+                    if name.strip().lower() == "content-length":
+                        clen = int(val.strip() or 0)
+                body = await reader.readexactly(clen) if clen else b""
                 status, ctype, payload = await asyncio.get_running_loop() \
-                    .run_in_executor(None, self._dispatch, target)
+                    .run_in_executor(None, self._dispatch, target,
+                                     method, body)
                 writer.write(
                     f"HTTP/1.1 {status}\r\nContent-Type: {ctype}\r\n"
                     f"Content-Length: {len(payload)}\r\n"
@@ -266,7 +274,8 @@ class DashboardHead:
             except Exception:  # noqa: BLE001
                 pass
 
-    def _dispatch(self, target: str):
+    def _dispatch(self, target: str, method: str = "GET",
+                  body: bytes = b""):
         parts = urlsplit(target)
         query = {
             k: v for k, v in
@@ -277,6 +286,20 @@ class DashboardHead:
                 rows = self._head().call("get_metrics", {})
                 text = _to_prometheus(rows, self._cluster_summary())
                 return "200 OK", "text/plain; version=0.0.4", text.encode()
+            if parts.path == "/api/serve/applications":
+                # declarative serve over REST (reference
+                # dashboard/modules/serve/serve_head.py): GET = status,
+                # PUT = apply a config document
+                from ray_tpu.serve import schema as serve_schema
+
+                if method == "PUT":
+                    cfg = json.loads(body.decode() or "{}")
+                    names = serve_schema.apply(cfg)
+                    return ("200 OK", "application/json",
+                            json.dumps({"deployed": names}).encode())
+                return ("200 OK", "application/json",
+                        json.dumps(serve_schema.status(),
+                                   default=_jsonable).encode())
             data = self._api(parts.path, query)
             if data is None:
                 return ("404 Not Found", "application/json",

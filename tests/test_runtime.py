@@ -388,3 +388,37 @@ def test_runtime_env_actor(cluster):
     a = EnvActor.remote()
     assert ray_tpu.get(a.flag.remote(), timeout=60) == "on"
     ray_tpu.kill(a)
+
+
+def test_user_profile_spans(cluster):
+    """util.profiling.profile spans from inside tasks land in the event
+    store and render as 'user_span' rows in timeline() (reference
+    ProfileEvent / ray.util.tracing analog)."""
+
+    @ray_tpu.remote
+    def annotated():
+        import time as t
+
+        from ray_tpu.util.profiling import profile
+
+        with profile("phase_one", extra={"k": 1}):
+            t.sleep(0.05)
+        with profile("phase_two"):
+            t.sleep(0.02)
+        return 1
+
+    assert ray_tpu.get(annotated.remote(), timeout=60) == 1
+    deadline = time.time() + 15
+    span_names = set()
+    while time.time() < deadline:
+        events = ray_tpu.list_tasks()
+        span_names = {e["name"] for e in events
+                      if e.get("state") == "PROFILE"}
+        if {"phase_one", "phase_two"} <= span_names:
+            break
+        time.sleep(0.2)
+    assert {"phase_one", "phase_two"} <= span_names
+    trace = ray_tpu.timeline()
+    user = [t for t in trace if t["cat"] == "user_span"]
+    assert any(t["name"] == "phase_one" and t["dur"] >= 40_000
+               for t in user)  # >= 40ms in trace microseconds

@@ -1,0 +1,110 @@
+"""runtime_env URI packaging + per-node cache + GC (VERDICT Missing #10:
+the working_dir/py_modules depth beyond raw same-host paths)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(head_resources={"CPU": 4, "memory": 4 * 2**30})
+    c.connect()
+    yield c
+    c.shutdown()
+
+
+def test_working_dir_packaged_as_uri(cluster, tmp_path):
+    """A local working_dir ships as a pkg:// URI through the cluster KV
+    and extracts into the node package cache — the worker's cwd is the
+    CACHE COPY, not the driver's path."""
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "data.txt").write_text("hello-from-package")
+    (proj / "helper_mod_xyz.py").write_text(
+        "VALUE = 'imported-from-package'\n")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(proj)})
+    def read_both():
+        import os
+
+        import helper_mod_xyz  # importable: cwd/PYTHONPATH include pkg
+
+        with open("data.txt") as f:
+            return f.read(), helper_mod_xyz.VALUE, os.getcwd()
+
+    data, val, cwd = ray_tpu.get(read_both.remote(), timeout=120)
+    assert data == "hello-from-package"
+    assert val == "imported-from-package"
+    assert str(proj) not in cwd  # ran from the extracted cache copy
+    assert "ray_tpu_pkgs_" in cwd
+
+    # the URI is cached + refcounted on the agent
+    cache = cluster.head_agent.pkg_cache
+    assert cache._refs or cache._idle_since  # known to the cache
+
+
+def test_same_dir_uploads_once(cluster, tmp_path):
+    proj = tmp_path / "proj2"
+    proj.mkdir()
+    (proj / "x.txt").write_text("v1")
+
+    from ray_tpu._private.runtime_env import PKG_SCHEME, package_local_dirs
+
+    w = cluster._driver
+    env1 = package_local_dirs({"working_dir": str(proj)}, w.head)
+    env2 = package_local_dirs({"working_dir": str(proj)}, w.head)
+    assert env1["working_dir"].startswith(PKG_SCHEME)
+    assert env1 == env2  # content-addressed: identical URI, one upload
+
+    (proj / "x.txt").write_text("v2")
+    env3 = package_local_dirs({"working_dir": str(proj)}, w.head)
+    assert env3["working_dir"] != env1["working_dir"]  # content changed
+
+
+def test_cache_gc_evicts_idle_uris(tmp_path):
+    from ray_tpu._private import runtime_env as re_mod
+    from ray_tpu._private.runtime_env import PKG_SCHEME, PackageCache
+
+    cache = PackageCache(str(tmp_path / "cache"))
+    import io
+    import zipfile
+
+    def mkzip():
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w") as z:
+            z.writestr("f.txt", "x")
+        return buf.getvalue()
+
+    uris = [f"{PKG_SCHEME}uri{i}" for i in range(re_mod.IDLE_CACHE_KEEP + 3)]
+    for u in uris:
+        cache.extract(u, mkzip())
+        cache.acquire(u)
+    for u in uris:
+        cache.release(u)
+    # only the keep-cap newest-idle extractions survive
+    surviving = [u for u in uris if cache.dir_if_present(u)]
+    assert len(surviving) == re_mod.IDLE_CACHE_KEEP
+    assert surviving == uris[-re_mod.IDLE_CACHE_KEEP:]
+
+
+def test_edited_working_dir_repackages(cluster, tmp_path):
+    """Editing files under a memoized working_dir ships the NEW content
+    on the next submission (stat-fingerprint memo key)."""
+    import time as _t
+
+    proj = tmp_path / "editable"
+    proj.mkdir()
+    (proj / "v.txt").write_text("first")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(proj)})
+    def read():
+        return open("v.txt").read()
+
+    assert ray_tpu.get(read.remote(), timeout=120) == "first"
+    _t.sleep(0.01)  # ensure mtime_ns moves
+    (proj / "v.txt").write_text("second")
+    assert ray_tpu.get(read.remote(), timeout=120) == "second"

@@ -260,3 +260,52 @@ def test_config_file_deploy(cluster, tmp_path):
             serve_schema.apply({"applications": [{"name": "x"}]})
     finally:
         sys.path.remove(str(tmp_path))
+
+
+def test_serve_rest_api(cluster, tmp_path):
+    """Declarative serve over the dashboard REST endpoint (reference
+    dashboard/modules/serve): PUT /api/serve/applications applies a
+    config document; GET returns running deployments."""
+    import http.client
+    import json
+    import sys
+    import textwrap
+
+    from ray_tpu.dashboard import start_dashboard
+
+    mod = tmp_path / "rest_service_mod.py"
+    mod.write_text(textwrap.dedent("""
+        from ray_tpu import serve
+
+        @serve.deployment(num_replicas=1)
+        class Adder:
+            def __call__(self, x):
+                return x + 100
+    """))
+    sys.path.insert(0, str(tmp_path))
+    try:
+        host, port = start_dashboard()
+        conn = http.client.HTTPConnection(host, port, timeout=120)
+        body = json.dumps({"applications": [{
+            "name": "adder",
+            "import_path": "rest_service_mod:Adder",
+            "route_prefix": "/adder",
+        }]})
+        conn.request("PUT", "/api/serve/applications", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        out = json.loads(resp.read())
+        assert resp.status == 200, out
+        assert out["deployed"] == ["adder"]
+
+        conn.request("GET", "/api/serve/applications")
+        resp = conn.getresponse()
+        status = json.loads(resp.read())
+        assert resp.status == 200
+        assert status["adder"]["num_replicas"] == 1
+        conn.close()
+
+        h = serve.get_handle("adder")
+        assert ray_tpu.get(h.remote(1), timeout=60) == 101
+    finally:
+        sys.path.remove(str(tmp_path))
