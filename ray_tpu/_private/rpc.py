@@ -23,6 +23,7 @@ import asyncio
 import logging
 import struct
 import threading
+import time
 import traceback
 from typing import Any, Awaitable, Callable
 
@@ -114,6 +115,18 @@ class RpcServer:
         self.conns: set[ServerConn] = set()
         self._server: asyncio.base_events.Server | None = None
         self.on_disconnect: Callable[[ServerConn], Awaitable[None]] | None = None
+        # per-route op stats (reference asio event-stats instrumentation,
+        # event_stats.h): count / error count / cumulative handler time
+        self.op_stats: dict[str, list] = {}  # method -> [n, errs, total_s]
+
+    def stats_snapshot(self) -> list[dict]:
+        return [
+            {"method": m, "count": s[0], "errors": s[1],
+             "total_s": round(s[2], 6),
+             "mean_ms": round(1e3 * s[2] / s[0], 3) if s[0] else 0.0}
+            for m, s in sorted(self.op_stats.items(),
+                               key=lambda kv: -kv[1][2])
+        ]
 
     def route(self, name: str):
         def deco(fn):
@@ -180,6 +193,7 @@ class RpcServer:
 
     async def _dispatch(self, conn, reqid, method, payload):
         handler = self.handlers.get(method)
+        t0 = time.monotonic()
         try:
             if handler is None:
                 raise RpcError(f"no such method: {method}")
@@ -190,6 +204,13 @@ class RpcServer:
                 logger.exception("handler %s failed", method)
             result = f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
             ok = False
+        # unknown client-supplied method names share ONE bucket, or a
+        # misbehaving peer could grow op_stats without bound
+        stat_key = method if handler is not None else "<unknown>"
+        st = self.op_stats.setdefault(stat_key, [0, 0, 0.0])
+        st[0] += 1
+        st[1] += 0 if ok else 1
+        st[2] += time.monotonic() - t0
         if reqid is not None:
             try:
                 _write_frame(conn.writer, [RESPONSE, reqid, ok, result])

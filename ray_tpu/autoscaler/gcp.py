@@ -95,6 +95,30 @@ class GCPTPUNodeProvider(NodeProvider):
     def non_terminated_nodes(self) -> list:
         return list(self._nodes.values())
 
+    def list_remote_nodes(self) -> list[dict]:
+        """Query GCP for live ray-tpu instances (the `down` path's source
+        of truth — in-memory tracking dies with the process). Under a
+        capture/dry-run exec_fn (which returns no CompletedProcess) the
+        listing is unavailable and [] is returned after recording the
+        command."""
+        import json as _json
+
+        cmd = [
+            "gcloud", "compute", "tpus", "tpu-vm", "list",
+            f"--project={self.project}", f"--zone={self.zone}",
+            "--filter=name~^ray-tpu-", "--format=json",
+        ]
+        result = self._exec(cmd)
+        stdout = getattr(result, "stdout", None)
+        if not stdout:
+            return []
+        out = []
+        for inst in _json.loads(stdout):
+            name = inst.get("name", "").rsplit("/", 1)[-1]
+            out.append({"name": name, "node_type": None,
+                        "resources": {}, "node_id": None})
+        return out
+
     # -- default executor --
 
     @staticmethod

@@ -172,6 +172,12 @@ class ControlPlane:
         import collections
 
         self.task_events: collections.deque = collections.deque(maxlen=50_000)
+        # structured cluster events + durable worker failure records
+        # (reference dashboard/modules/event + GcsWorkerManager tables)
+        self.cluster_events: collections.deque = collections.deque(
+            maxlen=10_000)
+        self.worker_failures: collections.deque = collections.deque(
+            maxlen=5_000)
         # per-reporter metric series (rpc_record_metrics)
         self.metrics: dict[bytes, dict] = {}
         self._metrics_last_seen: dict[bytes, float] = {}
@@ -221,6 +227,7 @@ class ControlPlane:
             (ns, name): aid for ns, name, aid in snap["named_actors"]
         }
         self.pgs = {p["pg_id"]: p for p in snap["pgs"]}
+        self.worker_failures.extend(snap.get("worker_failures", []))
         # Actors caught mid-placement by the crash: clear their node so the
         # health loop reschedules them (their old placement never happened
         # or died with the head's in-flight RPC).
@@ -246,6 +253,7 @@ class ControlPlane:
                 for (ns, name), aid in self.named_actors.items()
             ],
             "pgs": list(self.pgs.values()),
+            "worker_failures": list(self.worker_failures),
         }
         tmp = self.persist_path + ".tmp"
         with open(tmp, "wb") as f:
@@ -392,6 +400,9 @@ class ControlPlane:
         conn.state["node_id"] = p["node_id"]
         logger.info("node %s registered (%s)", p["node_id"].hex()[:8],
                     p["resources"])
+        self.record_event("NODE_ADDED",
+                          f"node {p['node_id'].hex()[:8]} registered",
+                          node_id=p["node_id"])
         self.pub.publish("node_added", info.view())
         return {"nodes": [n.view() for n in self.nodes.values()]}
 
@@ -411,6 +422,48 @@ class ControlPlane:
                 p["resources_available"], window_s=2.0
             )
         return {"ok": True}
+
+    def record_event(self, kind: str, message: str, **fields):
+        """Structured cluster event (reference dashboard/modules/event +
+        gcs event recording): bounded ring, queryable via rpc_list_events
+        / /api/events / `scripts.py list events`."""
+        self.cluster_events.append({
+            "ts": time.time(), "kind": kind, "message": message, **fields,
+        })
+
+    async def rpc_list_events(self, conn, p):
+        events = list(self.cluster_events)
+        kind = p.get("kind")
+        if kind:
+            events = [e for e in events if e["kind"] == kind]
+        return events[-int(p.get("limit", 1000)):]
+
+    async def rpc_op_stats(self, conn, p):
+        """Per-RPC-route handler stats (asio event-stats analog)."""
+        return self.server.stats_snapshot()
+
+    async def rpc_list_worker_failures(self, conn, p):
+        """Durable worker failure records (reference GcsWorkerManager's
+        failure table)."""
+        return list(self.worker_failures)[-int(p.get("limit", 1000)):]
+
+    async def rpc_report_worker_failure(self, conn, p):
+        rec = {
+            "ts": time.time(),
+            "worker_id": p.get("worker_id"),
+            "node_id": p.get("node_id"),
+            "exit_code": p.get("exit_code"),
+            "reason": p.get("reason", ""),
+        }
+        self.worker_failures.append(rec)
+        self.record_event(
+            "WORKER_FAILURE",
+            f"worker {p.get('worker_id', b'').hex()[:12]} exited "
+            f"({p.get('reason', 'unknown')})",
+            node_id=p.get("node_id"), exit_code=p.get("exit_code"),
+        )
+        self.mark_dirty()
+        return True
 
     async def rpc_get_demand(self, conn, p):
         """Unsatisfied demand SHAPES for the autoscaler's bin-packing
@@ -1243,6 +1296,9 @@ class ControlPlane:
             return
         node.alive = False
         logger.warning("node %s dead: %s", node_id.hex()[:8], reason)
+        self.record_event("NODE_DEAD",
+                          f"node {node_id.hex()[:8]} dead: {reason}",
+                          node_id=node_id)
         cli = self._agent_clients.pop(node_id, None)
         if cli is not None:
             await cli.close()

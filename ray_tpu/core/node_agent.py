@@ -716,6 +716,18 @@ class NodeAgent:
         self._signal_worker_free()  # pool count dropped; waiters may spawn
         for uri in w.__dict__.pop("pkg_uris", ()):
             self.pkg_cache.release(uri)
+        if code not in (0, None):  # durable failure record on the head
+            try:
+                # oneway: a hung head must not park the reap loop behind
+                # an observability report
+                await self.head.oneway("report_worker_failure", {
+                    "worker_id": w.worker_id, "node_id": self.node_id,
+                    "exit_code": code,
+                    "reason": ("actor process died" if w.actor_id
+                               else "worker process died"),
+                })
+            except Exception:  # noqa: BLE001 — observability best-effort
+                pass
         if w.actor_id is not None:
             # actor process died → control plane decides restart
             for r, v in (w.actor_resources or {}).items():

@@ -152,6 +152,15 @@ class DashboardHead:
                              {"limit": int(query.get("limit", 1000))})
         if path == "/api/cluster":
             return self._cluster_summary()
+        if path == "/api/events":
+            return head.call("list_events", {
+                "limit": int(query.get("limit", 1000)),
+                "kind": query.get("kind")})
+        if path == "/api/op_stats":
+            return head.call("op_stats", {})
+        if path == "/api/worker_failures":
+            return head.call("list_worker_failures",
+                             {"limit": int(query.get("limit", 1000))})
         if path == "/api/logs":
             # list log files per node; ?node_id=<hex>&file=<name> fetches
             # a tail (&tail_bytes=N) — reference dashboard/modules/log

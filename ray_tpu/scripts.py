@@ -95,6 +95,11 @@ def _list_state(args):
         rows = ray_tpu.list_objects(limit=args.limit)
     elif kind == "jobs":
         rows = ray_tpu.list_jobs()
+    elif kind == "events":
+        from ray_tpu._private.api import _get_worker
+
+        rows = _get_worker().head.call("list_events",
+                                       {"limit": args.limit})
     else:
         rows = ray_tpu.nodes()
     print(json.dumps(
@@ -145,6 +150,78 @@ def _serve(args):
         ray_tpu.shutdown()
 
 
+def _load_cluster_config(path: str) -> dict:
+    import yaml
+
+    with open(path) as f:
+        cfg = yaml.safe_load(f)
+    if "provider" not in cfg:
+        raise ValueError("cluster config requires a 'provider' section")
+    return cfg
+
+
+def _build_provider(cfg: dict, dry_run: bool):
+    prov = cfg["provider"]
+    ptype = prov.get("type", "gcp_tpu")
+    if ptype == "gcp_tpu":
+        from ray_tpu.autoscaler.gcp import GCPTPUNodeProvider
+
+        cmds: list = []
+        exec_fn = cmds.append if dry_run else None
+        provider = GCPTPUNodeProvider(
+            project=prov["project"], zone=prov["zone"],
+            head_address=cfg.get("head_address", ""),
+            exec_fn=exec_fn,
+        )
+        return provider, cmds
+    raise ValueError(f"unknown provider type {ptype!r}")
+
+
+def _cluster_up(args):
+    """`ray up` analog (reference scripts.py:978 + commands.py create_or_
+    update_cluster, scaled to node launches — SSH bootstrap is the VM
+    image's job via the create metadata)."""
+    cfg = _load_cluster_config(args.config)
+    provider, cmds = _build_provider(cfg, args.dry_run)
+    node_type = cfg.get("node_type")
+    n = int(cfg.get("min_workers", 1))
+    launched = []
+    for _ in range(n):
+        launched.append(provider.create_node(node_type=node_type))
+    print(json.dumps({
+        "cluster": cfg.get("cluster_name", "cluster"),
+        "launched": [nd["name"] for nd in launched],
+        "dry_run_commands": [" ".join(c) for c in cmds],
+    }, indent=2))
+
+
+def _cluster_down(args):
+    """`ray down` analog: terminate nodes. Without --nodes, the LIVE
+    provider listing is the source of truth (a fresh process has no
+    in-memory tracking — silently terminating nothing would leave VMs
+    running and billing)."""
+    cfg = _load_cluster_config(args.config)
+    provider, cmds = _build_provider(cfg, args.dry_run)
+    names = args.nodes
+    if not names:
+        names = [nd["name"] for nd in provider.list_remote_nodes()]
+        if not names and args.dry_run:
+            print(json.dumps({
+                "terminated": [],
+                "note": "dry-run cannot list live instances; the "
+                        "recorded list command shows what a real run "
+                        "queries",
+                "dry_run_commands": [" ".join(c) for c in cmds],
+            }, indent=2))
+            return
+    for name in names:
+        provider.terminate_node(name)
+    print(json.dumps({
+        "terminated": names,
+        "dry_run_commands": [" ".join(c) for c in cmds],
+    }, indent=2))
+
+
 def _submit(args):
     env = dict(os.environ)
     env["RAY_TPU_ADDRESS"] = args.address
@@ -181,13 +258,24 @@ def main(argv=None):
 
     ls = sub.add_parser("list", help="state API listings")
     ls.add_argument("kind",
-                    choices=["tasks", "actors", "objects", "jobs", "nodes"])
+                    choices=["tasks", "actors", "objects", "jobs",
+                             "nodes", "events"])
     ls.add_argument("--address", required=True)
     ls.add_argument("--limit", type=int, default=100)
 
     db = sub.add_parser("dashboard", help="serve the dashboard backend")
     db.add_argument("--address", required=True)
     db.add_argument("--dash-port", type=int, default=8265)
+
+    up = sub.add_parser("up", help="launch cluster nodes from a config")
+    up.add_argument("config", help="cluster YAML (provider + node_type)")
+    up.add_argument("--dry-run", action="store_true",
+                    help="print provider commands without executing")
+    dn = sub.add_parser("down", help="terminate cluster nodes")
+    dn.add_argument("config")
+    dn.add_argument("--dry-run", action="store_true")
+    dn.add_argument("--nodes", nargs="*", default=None,
+                    help="specific node names (default: all tracked)")
 
     sv = sub.add_parser("serve", help="declarative serve deploy/status")
     sv_sub = sv.add_subparsers(dest="serve_cmd", required=True)
@@ -217,6 +305,10 @@ def main(argv=None):
         _dashboard(args)
     elif args.cmd == "serve":
         _serve(args)
+    elif args.cmd == "up":
+        _cluster_up(args)
+    elif args.cmd == "down":
+        _cluster_down(args)
 
 
 if __name__ == "__main__":

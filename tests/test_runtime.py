@@ -422,3 +422,25 @@ def test_user_profile_spans(cluster):
     user = [t for t in trace if t["cat"] == "user_span"]
     assert any(t["name"] == "phase_one" and t["dur"] >= 40_000
                for t in user)  # >= 40ms in trace microseconds
+
+
+def test_cluster_events_recorded(cluster):
+    """Structured cluster events carry node lifecycle entries
+    (reference dashboard/modules/event)."""
+    from ray_tpu._private.api import _get_worker
+
+    events = _get_worker().head.call("list_events", {"limit": 100})
+    kinds = {e["kind"] for e in events}
+    assert "NODE_ADDED" in kinds
+    assert all("ts" in e and "message" in e for e in events)
+
+
+def test_op_stats_exposed(cluster):
+    """Per-route RPC handler stats (asio event-stats analog)."""
+    from ray_tpu._private.api import _get_worker
+
+    stats = _get_worker().head.call("op_stats", {})
+    methods = {s["method"] for s in stats}
+    assert "heartbeat" in methods
+    hb = next(s for s in stats if s["method"] == "heartbeat")
+    assert hb["count"] > 0 and hb["mean_ms"] >= 0
