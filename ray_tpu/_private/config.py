@@ -45,6 +45,9 @@ _DEFS: dict[str, Any] = {
     # -- pallas kernels --
     "flash_block_q": 1024,  # v5e-tuned round 3: fewer, bigger grid cells
     "flash_block_k": 1024,  # win — per-cell overhead dominates at T=2048
+    # single-pass fwd: q-heads computed per grid cell (1 = off); divides
+    # n_heads, MHA only — amortizes per-cell overhead further
+    "flash_heads_per_block": 1,
     # -- memory monitor --
     "memory_monitor_interval_s": 2.0,
     "memory_usage_kill_fraction": 0.95,  # memory_monitor.h:52 analog

@@ -110,6 +110,25 @@ def _reduce_groups(key_blob, agg_blob, *parts):
     return [agg(k, rows) for k, rows in sorted(groups.items())]
 
 
+# Above this many map blocks the exchange switches to the push-based
+# topology (reference _internal/push_based_shuffle.py): map outputs are
+# MERGED per partition round-by-round, so live intermediate objects stay
+# ~O(round * P) instead of O(M * P), and merges pipeline with later maps.
+PUSH_SHUFFLE_THRESHOLD = 16
+PUSH_MERGE_ROUND = 8  # map blocks merged per round
+
+
+@ray_tpu.remote(num_cpus=1)
+def _merge_parts(*parts):
+    """Push-based merge: combine a round's pieces of ONE partition into a
+    single block (row order within a partition is decided by the reducer,
+    so a concat is sufficient for sort/groupby/shuffle alike)."""
+    rows: list = []
+    for p in parts:
+        rows.extend(block_rows(p))
+    return build_like(parts[0], rows)
+
+
 def _exchange(blocks: list, mode: str, specs, num_parts: int) -> list[list]:
     """Run phase 1 over all blocks; returns per-partition ref lists.
 
@@ -125,14 +144,44 @@ def _exchange(blocks: list, mode: str, specs, num_parts: int) -> list[list]:
         blobs = [serialization.pack_payload(s) for s in specs]
     else:  # shared spec: pack exactly once
         blobs = [serialization.pack_payload(specs)] * len(blocks)
-    part_refs = [
-        _partition_block.options(num_returns=num_parts).remote(
-            b, mode, blob
-        )
-        for b, blob in zip(blocks, blobs)
-    ]
-    # transpose: partition i gathers piece i of every block
-    return [[refs[i] for refs in part_refs] for i in range(num_parts)]
+
+    if len(blocks) <= PUSH_SHUFFLE_THRESHOLD:
+        part_refs = [
+            _partition_block.options(num_returns=num_parts).remote(
+                b, mode, blob
+            )
+            for b, blob in zip(blocks, blobs)
+        ]
+        # transpose: partition i gathers piece i of every block
+        return [[refs[i] for refs in part_refs] for i in range(num_parts)]
+
+    # push-based: merge each round's pieces per partition, and WAIT for
+    # the previous round's merges before mapping the next round — the
+    # live-intermediate bound is only real with backpressure (otherwise
+    # FIFO scheduling runs every map before any merge and peak objects
+    # are O(M * P) again). Dropping the piece refs lets distributed GC
+    # free them once the merges consume them.
+    merged: list[list] = [[] for _ in range(num_parts)]
+    prev_round: list = []
+    for lo in range(0, len(blocks), PUSH_MERGE_ROUND):
+        if prev_round:
+            ray_tpu.wait(prev_round, num_returns=len(prev_round),
+                         timeout=600)
+        round_blocks = blocks[lo:lo + PUSH_MERGE_ROUND]
+        round_blobs = blobs[lo:lo + PUSH_MERGE_ROUND]
+        part_refs = [
+            _partition_block.options(num_returns=num_parts).remote(
+                b, mode, blob
+            )
+            for b, blob in zip(round_blocks, round_blobs)
+        ]
+        prev_round = [
+            _merge_parts.remote(*[refs[i] for refs in part_refs])
+            for i in range(num_parts)
+        ]
+        for i in range(num_parts):
+            merged[i].append(prev_round[i])
+    return merged
 
 
 def sort_blocks(blocks: list, key, descending: bool,

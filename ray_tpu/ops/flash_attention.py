@@ -147,16 +147,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, c
 
 
 def _fwd_kernel_1pass(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal,
-                      scale, block_q, offset):
+                      scale, block_q, offset, heads_per_block):
     """Whole k row in one tile (nk == 1): plain softmax, no online-update
     machinery — no scratch init/finalize, no running max/corr passes.
-    The common short-to-medium-T case."""
+    The common short-to-medium-T case.
+
+    heads_per_block > 1 amortizes the per-grid-cell overhead (the
+    dominant cost at these shapes) by computing several heads per cell —
+    an inner python loop the compiler unrolls."""
     iq = pl.program_id(2)
     q_start = iq * block_q
 
-    def _compute(masked: bool):
-        q = q_ref[0, 0]  # [bq, d]
-        k = k_ref[0, 0]  # [s, d]
+    def _one_head(h: int, masked: bool):
+        q = q_ref[0, h]  # [bq, d]
+        k = k_ref[0, h]  # [s, d] (multi-head cells are MHA-only)
         s_ = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -170,18 +174,19 @@ def _fwd_kernel_1pass(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal,
             p = jnp.exp2(s_ - m)
         l = jnp.sum(p, axis=-1, keepdims=True)
         l_safe = jnp.where(l == 0.0, 1.0, l)
-        v = v_ref[0, 0]
+        v = v_ref[0, h]
         pv = jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        o_ref[0, 0] = (pv / l_safe).astype(o_ref.dtype)
+        o_ref[0, h] = (pv / l_safe).astype(o_ref.dtype)
         lse = jnp.where(
             l == 0.0, -_NEG_INF, (m + jnp.log2(l_safe)) * (1.0 / _LOG2E))
-        lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
+        lse_ref[0, h] = jnp.broadcast_to(lse, lse_ref.shape[2:])
 
     # every tile in a causal single-pass row straddles the diagonal
-    _compute(masked=causal)
+    for h in range(heads_per_block):
+        _one_head(h, masked=causal)
 
 
 def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
@@ -200,53 +205,81 @@ def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
         )
     scale = d ** -0.5
     nk = cdiv(s, block_k)
-    grid = (b, hq, cdiv(t, block_q), nk)
 
     if nk == 1:
+        from ray_tpu._private import config as _cfg
+
+        hb = max(1, _cfg.get("flash_heads_per_block"))
+        # heads-per-cell must divide hq; GQA keeps per-head cells (the
+        # kv-group remap inside a multi-head block isn't worth the edge
+        # cases — MHA is the bench-critical shape)
+        while hb > 1 and (hq % hb or group > 1):
+            hb //= 2
         kernel = functools.partial(
             _fwd_kernel_1pass, causal=causal, scale=scale,
-            block_q=block_q, offset=s - t,
+            block_q=block_q, offset=s - t, heads_per_block=hb,
         )
-        grid = grid[:3]
+        grid = (b, hq // hb, cdiv(t, block_q))
         scratch = []
+
+        def q_idx(bi, hi, qi):
+            return (bi, hi, qi, 0)
+
+        def kv_idx(bi, hi, qi):
+            # hb > 1 implies group == 1 (guard above), so the grouped
+            # mapping is correct in both branches
+            return (bi, hi // group, 0, 0)
+
+        in_specs = [
+            pl.BlockSpec((1, hb, block_q, d), q_idx),
+            pl.BlockSpec((1, hb, block_k, d), kv_idx),
+            pl.BlockSpec((1, hb, block_k, d), kv_idx),
+        ]
+        out_specs = [
+            pl.BlockSpec((1, hb, block_q, d), q_idx),
+            pl.BlockSpec((1, hb, block_q, 8), q_idx),
+        ]
+        dims = ("parallel", "parallel", "parallel")
     else:
         kernel = functools.partial(
             _fwd_kernel, causal=causal, scale=scale, block_q=block_q,
             block_k=block_k, offset=s - t,
         )
+        grid = (b, hq, cdiv(t, block_q), nk)
         scratch = [
             pltpu.VMEM((block_q, 128), jnp.float32),  # running max m
             pltpu.VMEM((block_q, 128), jnp.float32),  # running denom l
             pltpu.VMEM((block_q, d), jnp.float32),  # output accumulator
         ]
 
-    # grid is (b, h, q) single-pass or (b, h, q, k) tiled
-    def q_idx(bi, hi, qi, *k):
-        return (bi, hi, qi, 0)
+        def q_idx4(bi, hi, qi, ki):
+            return (bi, hi, qi, 0)
 
-    def kv_idx(bi, hi, qi, *k):
-        return (bi, hi // group, k[0] if k else 0, 0)
+        def kv_idx4(bi, hi, qi, ki):
+            return (bi, hi // group, ki, 0)
 
-    o_idx = q_idx
+        in_specs = [
+            pl.BlockSpec((1, 1, block_q, d), q_idx4),
+            pl.BlockSpec((1, 1, block_k, d), kv_idx4),
+            pl.BlockSpec((1, 1, block_k, d), kv_idx4),
+        ]
+        # lse is written 8-lane-replicated: mosaic requires the last
+        # block dim be a multiple of 128 or the full array dim, so a
+        # packed [B, H, T] output can't be blocked per-head; 8 lanes is
+        # the narrowest legal layout (16x less HBM than 128); a lane-
+        # major [8, bq] tile measured WORSE (the in-kernel sublane->
+        # lane transpose outcosts the narrow DMA).
+        out_specs = [
+            pl.BlockSpec((1, 1, block_q, d), q_idx4),
+            pl.BlockSpec((1, 1, block_q, 8), q_idx4),
+        ]
+        dims = ("parallel", "parallel", "parallel", "arbitrary")
 
     out, lse4 = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), q_idx),
-            pl.BlockSpec((1, 1, block_k, d), kv_idx),
-            pl.BlockSpec((1, 1, block_k, d), kv_idx),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, 1, block_q, d), o_idx),
-            # lse is written 8-lane-replicated: mosaic requires the last
-            # block dim be a multiple of 128 or the full array dim, so a
-            # packed [B, H, T] output can't be blocked per-head; 8 lanes is
-            # the narrowest legal layout (16x less HBM than 128); a lane-
-            # major [8, bq] tile measured WORSE (the in-kernel sublane->
-            # lane transpose outcosts the narrow DMA).
-            pl.BlockSpec((1, 1, block_q, 8), o_idx),
-        ],
+        in_specs=in_specs,
+        out_specs=out_specs,
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
             jax.ShapeDtypeStruct((b, hq, t, 8), jnp.float32),
@@ -255,8 +288,7 @@ def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
         # b/head/q rows are independent -> mosaic may pipeline them; only
         # the innermost k dim carries scratch state.
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel",) * len(grid[:3])
-            + (("arbitrary",) if nk > 1 else ()),
+            dimension_semantics=dims,
         ),
         interpret=interpret,
     )(q, k, v)

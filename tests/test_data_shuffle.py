@@ -220,3 +220,25 @@ def test_from_pandas_and_torch(cluster):
     batches = list(ds2.iter_torch_batches())
     total = sum(float(b.sum()) for b in batches)
     assert total == float(np.arange(12).sum())
+
+
+def test_push_based_shuffle_many_blocks(cluster):
+    """Above PUSH_SHUFFLE_THRESHOLD map blocks the exchange merges pieces
+    per partition round-by-round (reference push_based_shuffle.py):
+    results identical, intermediate pieces GC-able per round."""
+    from ray_tpu.data import shuffle as sh
+
+    n_blocks = sh.PUSH_SHUFFLE_THRESHOLD + 9  # forces the push topology
+    ds = rdata.from_items(list(range(1000)), parallelism=n_blocks)
+    assert ds.num_blocks() > sh.PUSH_SHUFFLE_THRESHOLD
+
+    from ray_tpu.data.block import block_rows
+
+    srt = ds.sort()
+    rows = [r for b in srt.iter_batches() for r in block_rows(b)]
+    assert rows == sorted(range(1000))
+
+    shuf = ds.random_shuffle(seed=3)
+    rows2 = [r for b in shuf.iter_batches() for r in block_rows(b)]
+    assert sorted(rows2) == list(range(1000))
+    assert rows2 != list(range(1000))  # actually permuted

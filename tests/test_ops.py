@@ -259,3 +259,26 @@ def test_flash_legacy_bwd_path_very_long_kv(rng):
     for a, b_ in zip(g_ref, g_flash):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    atol=2e-4, rtol=1e-3)
+
+
+@pytest.mark.parametrize("hb", [2, 4])
+def test_flash_heads_per_block_matches_reference(rng, hb):
+    """flash_heads_per_block > 1 (multi-head grid cells, MHA only) must
+    be numerically identical to the per-head layout."""
+    from ray_tpu._private import config as _cfg
+
+    b, t, h, d = 2, 256, 4, 64
+    k1, k2, k3 = jax.random.split(rng, 3)
+    q = jax.random.normal(k1, (b, t, h, d), jnp.float32)
+    k = jax.random.normal(k2, (b, t, h, d), jnp.float32)
+    v = jax.random.normal(k3, (b, t, h, d), jnp.float32)
+    want = attention_reference(q, k, v, causal=True)
+    old = _cfg.get("flash_heads_per_block")
+    try:
+        _cfg.set_system_config({"flash_heads_per_block": hb})
+        got = flash_attention(q, k, v, causal=True, block_q=128,
+                              block_k=256, interpret=True)
+    finally:
+        _cfg.set_system_config({"flash_heads_per_block": old})
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=1e-4)
