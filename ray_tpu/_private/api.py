@@ -411,6 +411,13 @@ class RemoteFunction:
         }
         self.__name__ = getattr(func, "__name__", "remote_function")
 
+    def __getstate__(self):
+        # drop the per-worker export cache: it holds the CoreWorker
+        # (locks, sockets) and is process-local by definition
+        state = dict(self.__dict__)
+        state.pop("_func_id_cache", None)
+        return state
+
     def options(self, **kw) -> "RemoteFunction":
         new = RemoteFunction(self._func)
         new._opts = {**self._opts}
@@ -430,6 +437,12 @@ class RemoteFunction:
     def remote(self, *args, **kwargs):
         w = _get_worker()
         o = self._opts
+        # export once per (worker, function): re-cloudpickling the
+        # function per .remote() dominated bursty submission profiles
+        cache = getattr(self, "_func_id_cache", None)
+        if cache is None or cache[0] is not w:
+            cache = (w, w.export_function(self._func))
+            self._func_id_cache = cache
         res = {"CPU": float(o["num_cpus"]), **o["resources"]}
         if o["num_tpus"]:
             res["TPU"] = float(o["num_tpus"])
@@ -447,7 +460,7 @@ class RemoteFunction:
             retries=o["max_retries"],
             scheduling_strategy=o["scheduling_strategy"],
             runtime_env=o.get("runtime_env"),
-            name=o.get("name", self.__name__), **pg_kw,
+            name=o.get("name", self.__name__), func_id=cache[1], **pg_kw,
         )
         refs = [ObjectRef(i) for i in ids]
         return refs[0] if o["num_returns"] in (1, "dynamic") else refs
@@ -708,9 +721,7 @@ def cancel(ref: ObjectRef, *, force: bool = False):
     w = _get_worker()
     e = w.memory.get(ref.binary())
     if e is not None and e.spec is not None:
-        w.agent.call("cancel_task", {
-            "task_id": e.spec["task_id"], "force": force,
-        })
+        w.cancel_task(e.spec["task_id"], force)
 
 
 def get_actor(name: str, namespace: str = "default") -> ActorHandle:

@@ -35,6 +35,28 @@ _DEFS: dict[str, Any] = {
     # direct-task lease caching (direct_task_transport.h:110 analog)
     "worker_lease_ttl_s": 10.0,
     "worker_lease_enabled": True,
+    # in-flight direct-pushed tasks per leased worker (reference
+    # max_tasks_in_flight_per_worker, direct_task_transport.h:211):
+    # pushes pipeline into the worker's exec queue, hiding submit RTT.
+    # Only engaged when the local agent refused a new lease AND reported
+    # no other node fits the shape (spillback stays intact).
+    "worker_lease_depth": 10,
+    # leased workers held concurrently per scheduling key (reference
+    # leases are per-SchedulingKey worker pools); grants refuse when no
+    # idle worker exists, so the pool cap bounds this naturally
+    "worker_lease_max_per_key": 16,
+    # owner-held tasks per key awaiting a lease slot (only on shapes the
+    # agent reported unspillable; a 2s no-progress flush hands them to
+    # the agent queue). Sized for 10k+-task drains staying owner-side.
+    "worker_lease_pending_max": 20000,
+    # agent reclaims a lease with no in-flight task after this idle time
+    # (well under the TTL): multi-owner workloads would otherwise see
+    # most of the worker pool pinned by idle leases between bursts
+    "worker_lease_idle_reclaim_s": 1.5,
+    # pipelined queued submission: .remote() enqueues; a background pump
+    # ships windowed batches to the agent instead of blocking per task
+    "submit_batch_max": 200,
+    "submit_pipeline_depth": 4,
     # -- control plane --
     "heartbeat_timeout_s": 10.0,
     "heartbeat_period_fraction": 0.25,

@@ -374,6 +374,13 @@ class SyncRpcClient:
         self._reconnect_lock = threading.Lock()
         self._push: dict[str, Any] = {}
         self.on_reconnect = None  # callable run (on caller thread) after
+        # fire() outbox: buffered one-way frames drained by ONE scheduled
+        # loop callback — a run_coroutine_threadsafe per fire costs a
+        # self-pipe wakeup + GIL bounce (~60µs) that dominates bursty
+        # submission paths
+        self._fire_buf: list[tuple] = []
+        self._fire_scheduled = False
+        self._fire_lock = threading.Lock()
         self.client = AsyncRpcClient(host, port)
         io.run(self.client.connect())
 
@@ -419,11 +426,59 @@ class SyncRpcClient:
         return self.io.run(self.client.oneway(method, payload))
 
     def fire(self, method: str, payload: Any = None):
-        """Fire-and-forget; safe from any thread including the IO loop."""
+        """Fire-and-forget; safe from any thread including the IO loop.
+
+        Buffered: frames append to an outbox and one loop callback drains
+        it, so a burst of fires costs one cross-thread wakeup, not one
+        each. Per-client FIFO order among fires is preserved; a fire may
+        be written after a concurrently-issued call() on the same client
+        (acceptable for one-way semantics). Write failures are dropped —
+        fire callers rely on the disconnect machinery, not acks."""
         if threading.current_thread() is self.io.thread:
-            asyncio.ensure_future(self.client.oneway(method, payload))
-        else:
-            self.io.submit(self.client.oneway(method, payload))
+            self._drain_one(method, payload)
+            return
+        # backpressure: oneway() awaited drain(); the outbox does not, so
+        # a stalled peer would grow the transport buffer without bound.
+        # Block the PRODUCER (we are off-loop by the check above) until
+        # the buffer recedes; give up after ~5s (peer is wedged — the
+        # disconnect machinery owns that failure).
+        waited = 0.0
+        while self._write_buffer_size() > 32 * 1024 * 1024 and waited < 5.0:
+            time.sleep(0.005)
+            waited += 0.005
+        with self._fire_lock:
+            self._fire_buf.append((method, payload))
+            if self._fire_scheduled:
+                return
+            self._fire_scheduled = True
+        try:
+            self.io.loop.call_soon_threadsafe(self._drain_fires)
+        except RuntimeError:  # loop closed mid-shutdown
+            pass
+
+    def _write_buffer_size(self) -> int:
+        try:
+            w = self.client._writer
+            return w.transport.get_write_buffer_size() if w else 0
+        except Exception:  # noqa: BLE001 — transport mid-close
+            return 0
+
+    def _drain_one(self, method, payload):  # io thread only
+        cli = self.client
+        try:
+            if cli.closed or cli._writer is None:
+                return
+            _write_frame(cli._writer, [ONEWAY, method, payload])
+        except (ConnectionError, RpcError, RuntimeError, OSError):
+            pass
+
+    def _drain_fires(self):  # io thread only
+        with self._fire_lock:
+            items = self._fire_buf
+            self._fire_buf = []
+            self._fire_scheduled = False
+        for method, payload in items:
+            self._drain_one(method, payload)
 
     def on_push(self, channel: str, fn):
         self._push[channel] = fn

@@ -171,21 +171,75 @@ def run_benchmarks(*, quick: bool = False) -> list[dict]:
     return results
 
 
+def _start_head_proc(store_capacity: int):
+    """Run the head (control plane + node agent) as a REAL subprocess via
+    the CLI, like the reference's `ray microbenchmark` measures against a
+    separate raylet/GCS — an in-process head shares the driver's GIL and
+    measures contention, not the runtime."""
+    import re
+    import subprocess
+    import sys
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.scripts", "start", "--head",
+         "--resources", '{"CPU": 8, "memory": 8589934592}',
+         "--store-capacity", str(store_capacity)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    assert proc.stdout is not None
+    deadline = time.time() + 30
+    line = ""
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            if proc.poll() is not None:
+                break  # head died before printing its address
+            time.sleep(0.05)
+            continue
+        m = re.search(r"--address (\S+:\d+)", line)
+        if m:
+            # keep draining the merged pipe or the head blocks on its
+            # next log write once the ~64KB buffer fills
+            import threading
+
+            def _drain(stream=proc.stdout):
+                for _ in stream:
+                    pass
+
+            threading.Thread(target=_drain, daemon=True).start()
+            return proc, m.group(1)
+    proc.kill()
+    raise RuntimeError(f"head failed to start: {line!r}")
+
+
 def main(argv=None):
     import argparse
 
     p = argparse.ArgumentParser()
     p.add_argument("--out", default=None, help="write results JSON here")
     p.add_argument("--quick", action="store_true")
+    p.add_argument("--in-process", action="store_true",
+                   help="head in the driver process (debug only)")
     p.add_argument("--store-capacity", type=int,
                    default=3 * 1024 * 1024 * 1024)  # fits the 1 GB put
     args = p.parse_args(argv)
 
-    ray_tpu.init(num_cpus=8, object_store_memory=args.store_capacity)
+    proc = None
+    if args.in_process:
+        ray_tpu.init(num_cpus=8, object_store_memory=args.store_capacity)
+    else:
+        proc, address = _start_head_proc(args.store_capacity)
+        ray_tpu.init(address=address)
     try:
         results = run_benchmarks(quick=args.quick)
     finally:
         ray_tpu.shutdown()
+        if proc is not None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=5)
+            except Exception:
+                proc.kill()
     if args.out:
         with open(args.out, "w") as f:
             json.dump({"results": results,

@@ -149,6 +149,22 @@ class CoreWorker:
         # (task_id, retries_left) -> ts: per-attempt failure dedup
         self._failing_tasks: dict[tuple, float] = {}
         self._lock = threading.Lock()
+        # Pipelined queued submission (reference pipelines lease pushes,
+        # direct_task_transport.h:211; we pipeline the agent submit hop):
+        # .remote() appends here and returns; a pump coroutine on the io
+        # loop ships windowed batches via submit_task_batch.
+        self._submit_buf: list[dict] = []
+        self._submit_lock = threading.Lock()
+        self._submit_inflight = 0  # batches on the wire (guarded by lock)
+        self._submit_pump_running = False
+        self._submit_kicked = False
+        # tasks this owner cancelled: a lease-revoked failover racing the
+        # agent's cancel notification must not resubmit them
+        self._cancelled_tasks: set[bytes] = set()
+        # liveness pump for owner-held pending lease tasks (guarded by
+        # _lease_lock): retries grants / flushes stalled pendings to the
+        # agent queue so long-running in-flight tasks can't strand them
+        self._pending_pump_running = False
 
         # the worker's own RPC server (owner endpoint + executor endpoint)
         self.server = RpcServer("127.0.0.1", 0)
@@ -169,6 +185,17 @@ class CoreWorker:
         self._dead_nodes: set[bytes] = set()
         self.head.on_push("node_dead", self._on_node_dead)
         self.head.call("subscribe", {"channel": "node_dead"})
+        # resurrection (a dead-marked node re-registered): stop failing
+        # tasks routed to it
+        self.head.on_push(
+            "node_added",
+            lambda p: self._dead_nodes.discard(p.get("node_id")),
+        )
+        self.head.call("subscribe", {"channel": "node_added"})
+        # tid -> (count, last_ts): routing failovers are retry-free, so
+        # they MUST be rate-limited or a stale dead-node view turns into
+        # an unbounded resubmit storm
+        self._routing_failures: dict[bytes, tuple[int, float]] = {}
         # Head restart (GCS FT): the SyncRpcClient reconnects transparently;
         # we must re-register and re-subscribe on the fresh connection.
         self.head.on_reconnect = self._resync_head
@@ -231,6 +258,10 @@ class CoreWorker:
 
     def shutdown(self):
         try:
+            self._flush_submits(timeout=5.0)
+        except Exception:
+            pass
+        try:
             self.io.run(self.server.stop(), timeout=5)
         except Exception:
             pass
@@ -248,6 +279,14 @@ class CoreWorker:
             self.store.close()
 
     # ------------- owner-side RPC (results pushed to us) -------------
+
+    async def rpc_push_results(self, conn, p):
+        """Batched results from one executor (one frame per drain window
+        instead of one per result — the owner loop is the task-storm
+        throughput ceiling on small hosts)."""
+        for msg in p["items"]:
+            await self.rpc_push_result(conn, msg)
+        return True
 
     async def rpc_push_result(self, conn, p):
         """An executor finished a task we own (or serves a borrowed get)."""
@@ -290,6 +329,8 @@ class CoreWorker:
 
     def _handle_task_failed(self, p):
         tid = p["task_id"]
+        if tid in self._cancelled_tasks:
+            p = {**p, "retriable": False, "reason": "cancelled"}
         self._task_nodes.pop(tid, None)
         self._task_node_hops.pop(tid, None)
         self._on_lease_task_done(tid, failed=True)
@@ -324,13 +365,25 @@ class CoreWorker:
             # a stale view sent the task to an already-dead node; nothing
             # executed, so resubmission neither burns a retry nor counts
             # as this attempt's failure (self-correcting once the view
-            # refreshes)
-            try:
-                self.agent.call("submit_task", spec)
-            except (rpc.ConnectionLost, rpc.RpcError):
-                pass
-            else:
-                return
+            # refreshes). Rate-limited HARD: one per task per 2s, max 5 —
+            # a falsely-dead node echoes a task_located per queued copy,
+            # and unbounded retry-free resubmits once snowballed a 600k
+            # agent queue. Beyond the cap, fall through to the normal
+            # retry path (which burns retries and terminates).
+            n, last = self._routing_failures.get(tid, (0, 0.0))
+            now = time.monotonic()
+            if n < 5:
+                if now - last < 2.0:
+                    return  # a recent resubmit of this task is in flight
+                self._routing_failures[tid] = (n + 1, now)
+                if len(self._routing_failures) > 10_000:
+                    self._routing_failures.clear()
+                try:
+                    self.agent.call("submit_task", spec)
+                except (rpc.ConnectionLost, rpc.RpcError):
+                    pass
+                else:
+                    return
         attempt_key = (tid, spec.get("retries_left", 0))
         now = time.monotonic()
         with self._lease_lock:
@@ -841,8 +894,10 @@ class CoreWorker:
                     retries: int = 3, pg_id: bytes | None = None,
                     bundle_index: int = -1, bundle_nodes: list | None = None,
                     scheduling_strategy=None, runtime_env: dict | None = None,
-                    name: str = "") -> list[bytes]:
-        func_id = self.export_function(func)
+                    name: str = "",
+                    func_id: bytes | None = None) -> list[bytes]:
+        if func_id is None:
+            func_id = self.export_function(func)
         # parent chain: drivers are roots; executor-submitted tasks chain
         # through their own worker ids via the counter namespace
         task_id = TaskID.for_task(
@@ -882,8 +937,163 @@ class CoreWorker:
         # completes or exhausts retries (reference_count.h:115).
         self._pin_task_deps(task_id, list(deps))
         if not self._try_lease_submit(spec):
-            self.agent.call("submit_task", spec)
+            self._enqueue_submit(spec)
         return return_ids
+
+    # -- pipelined queued submission: the agent hop must not serialize
+    # .remote() (async batch throughput was within 9% of sync when every
+    # submit blocked on its ack). Specs buffer here; a pump on the io
+    # loop ships them as windowed submit_task_batch calls with a bounded
+    # number of batches in flight. Failure backstop: a batch that errors
+    # fails its tasks through the normal retry machinery. --
+
+    def _enqueue_submit(self, spec: dict):
+        with self._submit_lock:
+            self._submit_buf.append(spec)
+            if self._submit_pump_running or self._submit_kicked:
+                return  # one wakeup per burst, not one per task
+            self._submit_kicked = True
+        self.io.call_soon(self._kick_submit_pump)
+
+    def _kick_submit_pump(self):  # io loop only
+        with self._submit_lock:
+            self._submit_kicked = False
+            if self._submit_pump_running:
+                return
+            self._submit_pump_running = True
+        import asyncio
+
+        asyncio.ensure_future(self._submit_pump())
+
+    async def _submit_pump(self):
+        import asyncio
+
+        from ray_tpu._private import config as _cfg
+
+        batch_max = _cfg.get("submit_batch_max")
+        window = _cfg.get("submit_pipeline_depth")
+        inflight: set = set()
+        try:
+            while True:
+                with self._submit_lock:
+                    batch = self._submit_buf[:batch_max]
+                    del self._submit_buf[:len(batch)]
+                    if not batch and not inflight:
+                        # terminal check under the lock: a concurrent
+                        # enqueue after this point re-kicks via call_soon,
+                        # which cannot interleave with this (same loop)
+                        self._submit_pump_running = False
+                        return
+                    if batch:
+                        self._submit_inflight += 1
+                if not batch:
+                    _done, inflight = await asyncio.wait(
+                        inflight, return_when=asyncio.FIRST_COMPLETED
+                    )
+                    continue
+                while len(inflight) >= window:
+                    _done, inflight = await asyncio.wait(
+                        inflight, return_when=asyncio.FIRST_COMPLETED
+                    )
+                inflight.add(
+                    asyncio.ensure_future(self._send_submit_batch(batch))
+                )
+        except BaseException:
+            self._submit_pump_running = False
+            raise
+
+    async def _send_submit_batch(self, specs: list[dict]):
+        import asyncio
+
+        # late-cancel filter: cancel_task may have marked specs that were
+        # already popped from _submit_buf into this batch
+        if self._cancelled_tasks:
+            specs = [s for s in specs
+                     if s["task_id"] not in self._cancelled_tasks]
+            if not specs:
+                return
+        try:
+            await self.agent.client.call(
+                "submit_task_batch", {"specs": specs}, timeout=60.0
+            )
+        except (rpc.ConnectionLost, rpc.RpcError,
+                asyncio.TimeoutError) as e:
+            reason = f"submit failed: {type(e).__name__}"
+            threading.Thread(
+                target=self._fail_submit_batch, args=(specs, reason),
+                daemon=True,
+            ).start()
+        finally:
+            with self._submit_lock:
+                self._submit_inflight -= 1
+
+    def _fail_submit_batch(self, specs: list[dict], reason: str):
+        for spec in specs:
+            self._handle_task_failed({
+                "task_id": spec["task_id"], "reason": reason,
+                "retriable": True,
+            })
+
+    def cancel_task(self, task_id: bytes, force: bool = False):
+        """Cancel before it ships (still in the submit buffer) or via the
+        agent once it has (reference CancelTask covers both queue states)."""
+        self._cancelled_tasks.add(task_id)
+        if len(self._cancelled_tasks) > 10_000:
+            self._cancelled_tasks.clear()
+        with self._submit_lock:
+            for i, s in enumerate(self._submit_buf):
+                if s["task_id"] == task_id:
+                    del self._submit_buf[i]
+                    break
+            else:
+                s = None
+        if s is None:
+            # owner-held pending lease task: cancel before it ships
+            with self._lease_lock:
+                for entry in self._lease_cache.values():
+                    for i, cand in enumerate(entry["pending"]):
+                        if cand["task_id"] == task_id:
+                            s = cand
+                            del entry["pending"][i]
+                            break
+                    if s is not None:
+                        break
+        if s is not None:
+            self._handle_task_failed({
+                "task_id": task_id, "reason": "cancelled",
+                "retriable": False,
+            })
+            return {"cancelled": "buffered"}
+        r = self.agent.call("cancel_task", {
+            "task_id": task_id, "force": force,
+        })
+        if r.get("cancelled") is None:
+            # possibly in an in-flight submit batch (popped from the
+            # buffer but not yet landed): the _cancelled_tasks mark
+            # filters it out of the batch; re-check the agent once the
+            # window has surely flushed
+            self._flush_submits(timeout=2.0)
+            r = self.agent.call("cancel_task", {
+                "task_id": task_id, "force": force,
+            })
+        return r
+
+    def _flush_submits(self, timeout: float = 10.0):
+        """Block until every buffered spec has been acked by the agent
+        (or errored into the retry path). Used at shutdown so a driver
+        that exits right after .remote() doesn't strand tasks."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._submit_lock:
+                clear = not self._submit_buf and self._submit_inflight == 0
+            if clear:
+                with self._lease_lock:
+                    clear = not any(e["pending"]
+                                    for e in self._lease_cache.values())
+            if clear:
+                return True
+            time.sleep(0.002)
+        return False
 
     # -- direct-task lease caching (direct_task_transport.h:110): repeat
     # same-shape tasks push straight to a leased worker, skipping the
@@ -906,8 +1116,17 @@ class CoreWorker:
         # LOCK DISCIPLINE: never touch the io loop (agent.call / oneway —
         # both block on it) while holding _lease_lock: the io thread takes
         # the same lock in _on_lease_task_done, which deadlocks the loop.
-        # The lease is reserved (busy + task recorded) BEFORE the push, so
-        # a result can never race its own bookkeeping.
+        # The lease is reserved (inflight bumped + task recorded) BEFORE
+        # the push, so a result can never race its own bookkeeping.
+        #
+        # Policy (reference direct_task_transport.h:110 lease pool +
+        # :211 pipelining, adapted): parallelism first — prefer an IDLE
+        # leased worker, then GRANT another lease (up to
+        # worker_lease_max_per_key), and only when the local node refuses
+        # AND no other alive node could fit the shape (the refusal's
+        # `spillable` bit) pipeline up to worker_lease_depth tasks onto
+        # the least-loaded lease. A spillable shape falls back to queued
+        # submission instead, so cluster spillback keeps working.
         from ray_tpu._private import config as _cfg
 
         if not _cfg.get("worker_lease_enabled"):
@@ -915,27 +1134,73 @@ class CoreWorker:
         key = self._lease_key(spec)
         if key is None:
             return False
+        depth = _cfg.get("worker_lease_depth")
+        max_leases = _cfg.get("worker_lease_max_per_key")
         now = time.monotonic()
         tid = spec["task_id"]
-        expired = None
+        to_return: list[bytes] = []
+        lease = None
         with self._lease_lock:
-            lease = self._lease_cache.get(key)
-            if lease is not None and now > lease["expires"]:
-                expired = self._lease_cache.pop(key)
-                lease = None
-            if lease is not None:
-                if lease["busy"]:
-                    lease = None  # one in-flight per lease; queue path
+            entry = self._lease_cache.get(key)
+            if entry is None:
+                entry = self._lease_cache[key] = {
+                    "leases": [], "no_grant_until": 0.0, "spillable": True,
+                    "pending": [],
+                }
+            # Idle staleness must be checked OWNER-side with margin under
+            # the agent's idle-reclaim threshold: pushing to a lease the
+            # agent reclaimed a moment ago double-books the worker (the
+            # push still executes) AND resubmits the task via the
+            # revocation failover — double execution.
+            idle_stale = _cfg.get("worker_lease_idle_reclaim_s") * 0.6
+            keep = []
+            for l in entry["leases"]:
+                stale = (l["inflight"] == 0
+                         and (now > l["expires"]
+                              or now - l.get("_last_use", now) > idle_stale))
+                if stale:
+                    to_return.append(l["lease_id"])
                 else:
-                    lease["busy"] = True
+                    keep.append(l)
+            entry["leases"] = keep
+            for l in keep:
+                if l["inflight"] == 0:
+                    lease = l
+                    break
+            if lease is not None:
+                lease["inflight"] = 1
+                lease["_last_use"] = now
+                self._lease_tasks[tid] = (key, lease["lease_id"])
+            want_grant = (lease is None and len(keep) < max_leases
+                          and now >= entry["no_grant_until"])
+            if lease is None and not want_grant and keep \
+                    and not entry["spillable"]:
+                # Local node refused recently and nowhere else fits the
+                # shape: pipeline up to depth onto the least-loaded leased
+                # worker (deep worker queues also let executors batch
+                # their result pushes), then hold overflow OWNER-SIDE
+                # (reference SchedulingKey queues) — returning results
+                # refill leases directly, so the drain never touches the
+                # agent loop.
+                cand = min(keep, key=lambda l: l["inflight"])
+                if cand["inflight"] < depth:
+                    lease = cand
+                    lease["inflight"] += 1
+                    lease["_last_use"] = now
                     self._lease_tasks[tid] = (key, lease["lease_id"])
-            reserved = lease is not None
-        if expired is not None and not expired["busy"]:
-            self.agent.fire("return_lease",
-                            {"lease_id": expired["lease_id"]})
-        if not reserved:
-            if expired is None and key in self._lease_cache:
-                return False  # busy lease: fall back to queued submit
+                elif len(entry["pending"]) < _cfg.get(
+                        "worker_lease_pending_max"):
+                    if not entry["pending"]:
+                        entry["pending_since"] = now
+                    entry["pending"].append(spec)
+                    start_pump = not self._pending_pump_running
+                    if start_pump:
+                        self._pending_pump_running = True
+                        self.io.call_soon(self._start_pending_pump)
+                    return True
+        for lid in to_return:
+            self.agent.fire("return_lease", {"lease_id": lid})
+        if lease is None and want_grant:
             try:
                 grant = self.agent.call("lease_worker", {
                     "resources": spec.get("resources", {}),
@@ -944,46 +1209,190 @@ class CoreWorker:
                 }, timeout=10.0)
             except (rpc.ConnectionLost, rpc.RpcError):
                 return False
-            if not grant:
+            if not grant or "lease_id" not in grant:
+                with self._lease_lock:
+                    entry = self._lease_cache.get(key)
+                    if entry is not None:
+                        entry["no_grant_until"] = now + 0.2
+                        entry["spillable"] = bool(
+                            (grant or {}).get("spillable", True)
+                        )
                 return False
             lease = {
-                **grant, "busy": True,
+                **grant, "inflight": 1, "_last_use": now,
                 "expires": now + grant["ttl_s"] * 0.8,
             }
             with self._lease_lock:
-                if key in self._lease_cache:
-                    extra = True  # another thread granted concurrently
-                else:
-                    extra = False
-                    self._lease_cache[key] = lease
-                    self._lease_tasks[tid] = (key, lease["lease_id"])
-            if extra:
-                self.agent.fire("return_lease",
-                                {"lease_id": grant["lease_id"]})
-                return False
+                entry = self._lease_cache.get(key)
+                if entry is None or len(entry["leases"]) >= max_leases:
+                    self._lease_tasks.pop(tid, None)
+                    self.agent.fire("return_lease",
+                                    {"lease_id": grant["lease_id"]})
+                    return False
+                entry["spillable"] = bool(grant.get("spillable", True))
+                entry["leases"].append(lease)
+                self._lease_tasks[tid] = (key, lease["lease_id"])
+        if lease is None:
+            return False
+        return self._lease_push(key, lease, spec, requeue_on_fail=False)
+
+    def _lease_push(self, key: tuple, lease: dict, spec: dict,
+                    requeue_on_fail: bool) -> bool:
+        """Push a reserved task to its leased worker. Called from submit
+        threads AND from the io loop (refill on result); the send is a
+        coalesced fire either way. requeue_on_fail routes the task to the
+        agent queue when the push fails (refill has no caller to return
+        False to)."""
+        tid = spec["task_id"]
         push = {k: v for k, v in spec.items() if not k.startswith("_")}
-        cli = self._peer({"addr": lease["addr"], "port": lease["port"]})
+        push["leased"] = True  # lets the executor batch its done-reports
+        addr = {"addr": lease["addr"], "port": lease["port"]}
+        # from the io loop, only a CACHED peer is safe (_peer's connect
+        # blocks on this very loop); leases pushed at least once from a
+        # submit thread always have one
+        if threading.current_thread() is self.io.thread:
+            cli = self._peer_clients.get((lease["addr"], lease["port"]))
+        else:
+            cli = self._peer(addr)
         ok = cli is not None
         if ok:
             try:
-                cli.oneway("execute_task", push)
+                # fire, not a blocking oneway: the io-loop round trip per
+                # push (~1ms thread hop) was the submission ceiling. An
+                # async write failure means the leased worker died — the
+                # agent's worker-death → lease_revoked path fails the
+                # task over to the queue, so no sync ack is needed.
+                cli.fire("execute_task", push)
             except (rpc.ConnectionLost, rpc.RpcError):
                 ok = False
         if not ok:
+            drain = []
             with self._lease_lock:
                 self._lease_tasks.pop(tid, None)
-                self._lease_cache.pop(key, None)
+                entry = self._lease_cache.get(key)
+                if entry is not None:
+                    entry["leases"] = [
+                        l for l in entry["leases"]
+                        if l["lease_id"] != lease["lease_id"]
+                    ]
+                    if not entry["leases"] and entry["pending"]:
+                        drain = entry["pending"]
+                        entry["pending"] = []
             self.agent.fire("return_lease", {"lease_id": lease["lease_id"]})
+            for s in drain:
+                self._enqueue_submit(s)
+            if requeue_on_fail:
+                self._enqueue_submit(spec)
             return False
         # async: let the agent track the leased task so its worker-death
-        # notification path covers direct pushes too
+        # notification path covers direct pushes too (slim spec: the
+        # agent only needs identity/owner/shape for failover + cancel)
         self.agent.fire("lease_task_started", {
-            "lease_id": lease["lease_id"], "spec": push,
+            "lease_id": lease["lease_id"],
+            "spec": {k: push[k] for k in
+                     ("task_id", "job_id", "name", "resources", "owner",
+                      "num_returns") if k in push},
         })
         # owner-side node tracking for direct pushes (they bypass the
         # agents' task_located notifies entirely)
         self._task_nodes[tid] = self.node_id
         return True
+
+    def _start_pending_pump(self):  # io loop
+        import asyncio
+
+        asyncio.ensure_future(self._pending_pump())
+
+    async def _pending_pump(self):
+        """While any scheduling key holds owner-side pending tasks, keep
+        them live: re-try lease grants once the refusal window lapses and
+        flush pendings that made no progress for 2s to the agent queue
+        (in-flight tasks may be long-running; the agent can spawn workers
+        or spill where the owner cannot)."""
+        import asyncio
+
+        from ray_tpu._private import config as _cfg
+
+        max_leases = _cfg.get("worker_lease_max_per_key")
+        loop = asyncio.get_running_loop()
+        try:
+            while True:
+                await asyncio.sleep(0.1)
+                now = time.monotonic()
+                drains: list[dict] = []
+                grant_keys: list[tuple] = []
+                with self._lease_lock:
+                    busy_keys = [k for k, e in self._lease_cache.items()
+                                 if e["pending"]]
+                    if not busy_keys:
+                        self._pending_pump_running = False
+                        return
+                    for key in busy_keys:
+                        e = self._lease_cache[key]
+                        stalled = (now - e.get("pending_since", now)) > 2.0
+                        if not e["leases"] or stalled:
+                            drains.extend(e["pending"])
+                            e["pending"] = []
+                        elif (now >= e["no_grant_until"]
+                              and len(e["leases"]) < max_leases):
+                            grant_keys.append(key)
+                for s in drains:
+                    self._enqueue_submit(s)
+                for key in grant_keys:
+                    await self._pump_grant_one(key, loop)
+        except Exception:
+            with self._lease_lock:
+                self._pending_pump_running = False
+            raise
+
+    async def _pump_grant_one(self, key: tuple, loop):
+        import asyncio
+
+        with self._lease_lock:
+            e = self._lease_cache.get(key)
+            if e is None or not e["pending"]:
+                return
+            res = dict(e["pending"][0].get("resources", {}))
+        import asyncio
+
+        try:
+            grant = await self.agent.client.call("lease_worker", {
+                "resources": res, "job_id": self.job_id,
+                "owner": self.owner_address,
+            }, timeout=10.0)
+        except (rpc.ConnectionLost, rpc.RpcError, asyncio.TimeoutError):
+            return
+        now = time.monotonic()
+        if not grant or "lease_id" not in grant:
+            with self._lease_lock:
+                e = self._lease_cache.get(key)
+                if e is not None:
+                    e["no_grant_until"] = now + 0.2
+                    e["spillable"] = bool(
+                        (grant or {}).get("spillable", True))
+            return
+        # peer connect must not block this loop
+        await loop.run_in_executor(
+            None, self._peer, {"addr": grant["addr"], "port": grant["port"]}
+        )
+        lease = {**grant, "inflight": 1, "_last_use": now,
+                 "expires": now + grant["ttl_s"] * 0.8}
+        spec = None
+        with self._lease_lock:
+            e = self._lease_cache.get(key)
+            if e is None or not e["pending"]:
+                spec = None
+            else:
+                e["spillable"] = bool(grant.get("spillable", True))
+                e["leases"].append(lease)
+                spec = e["pending"].pop(0)
+                e["pending_since"] = now
+                self._lease_tasks[spec["task_id"]] = (
+                    key, lease["lease_id"])
+        if spec is None:
+            self.agent.fire("return_lease", {"lease_id": grant["lease_id"]})
+            return
+        self._lease_push(key, lease, spec, requeue_on_fail=True)
 
     async def rpc_lease_revoked(self, conn, p):
         """Agent reclaimed our lease (TTL lapse, actor priority, or the
@@ -992,19 +1401,26 @@ class CoreWorker:
         agent's own task tracking, so the owner is the backstop."""
         wid = p.get("worker_id")
         orphans: list[bytes] = []
+        drain: list[dict] = []
         with self._lease_lock:
-            dead = [
-                (key, lease["lease_id"])
-                for key, lease in self._lease_cache.items()
-                if lease.get("worker_id") == wid
-            ]
-            for key, _lid in dead:
-                self._lease_cache.pop(key, None)
-            dead_ids = {lid for _, lid in dead}
+            dead_ids = set()
+            for entry in self._lease_cache.values():
+                for lease in entry["leases"]:
+                    if lease.get("worker_id") == wid:
+                        dead_ids.add(lease["lease_id"])
+                entry["leases"] = [
+                    l for l in entry["leases"]
+                    if l["lease_id"] not in dead_ids
+                ]
+                if not entry["leases"] and entry["pending"]:
+                    drain.extend(entry["pending"])
+                    entry["pending"] = []
             orphans.extend(
                 tid for tid, (_k, lid) in self._lease_tasks.items()
                 if lid in dead_ids
             )
+        for s in drain:
+            self._enqueue_submit(s)
         for tid in orphans:
             threading.Thread(
                 target=self._handle_task_failed,
@@ -1015,24 +1431,65 @@ class CoreWorker:
         return True
 
     def _on_lease_task_done(self, task_id: bytes, failed: bool):
+        refill: list[dict] = []
+        drain: list[dict] = []
         with self._lease_lock:
-            entry = self._lease_tasks.pop(task_id, None)
+            rec = self._lease_tasks.pop(task_id, None)
+            if rec is None:
+                return
+            key, lease_id = rec
+            entry = self._lease_cache.get(key)
             if entry is None:
                 return
-            key, lease_id = entry
-            lease = self._lease_cache.get(key)
-            if lease is None or lease.get("lease_id") != lease_id:
-                return  # the task's lease was replaced; don't touch the new one
+            lease = next(
+                (l for l in entry["leases"] if l["lease_id"] == lease_id),
+                None,
+            )
+            if lease is None:
+                return  # the task's lease was dropped/replaced already
             if failed:
                 # worker likely died; agent released its half already
-                self._lease_cache.pop(key, None)
-                return
-            lease["busy"] = False
-            lease["expires"] = time.monotonic() + lease["ttl_s"] * 0.8
-        try:
-            self.agent.fire("renew_lease", {"lease_id": lease["lease_id"]})
-        except (rpc.ConnectionLost, rpc.RpcError):
-            pass
+                entry["leases"].remove(lease)
+                if not entry["leases"] and entry["pending"]:
+                    drain = entry["pending"]
+                    entry["pending"] = []
+            else:
+                lease["inflight"] = max(0, lease["inflight"] - 1)
+                lease["_last_use"] = time.monotonic()
+                lease["expires"] = time.monotonic() + lease["ttl_s"] * 0.8
+                if entry["pending"]:
+                    # refill: top the lease back up to depth from the
+                    # owner-side queue — the drain loop (result → next
+                    # pushes) never touches the agent (reference lease
+                    # pipelining), and deep worker queues let executors
+                    # batch result pushes
+                    from ray_tpu._private import config as _cfg
+
+                    depth = _cfg.get("worker_lease_depth")
+                    refill = []
+                    while entry["pending"] and lease["inflight"] < depth:
+                        s = entry["pending"].pop(0)
+                        lease["inflight"] += 1
+                        self._lease_tasks[s["task_id"]] = (key, lease_id)
+                        refill.append(s)
+                    if refill:
+                        entry["pending_since"] = time.monotonic()
+                        lease["_last_use"] = entry["pending_since"]
+        for s in drain:
+            self._enqueue_submit(s)
+        if failed:
+            return
+        now = time.monotonic()
+        if now - lease.get("_last_renew", 0.0) > lease["ttl_s"] * 0.25:
+            # rate-limited: one renew per TTL quarter, not one per result
+            lease["_last_renew"] = now
+            try:
+                self.agent.fire("renew_lease",
+                                {"lease_id": lease["lease_id"]})
+            except (rpc.ConnectionLost, rpc.RpcError):
+                pass
+        for s in refill:
+            self._lease_push(key, lease, s, requeue_on_fail=True)
 
     def _pack_args(self, args, kwargs):
         """Serialize args; extract refs as deps; inline owned small values.
@@ -1169,7 +1626,11 @@ class CoreWorker:
     def _send_actor_call(self, actor_id: bytes, call: dict):
         try:
             cli = self._actor_client(actor_id)
-            cli.oneway("actor_call", call)
+            # fire (coalesced outbox), not a blocking oneway: per-call io
+            # round trips capped 1:1 actor throughput ~1k/s. An async
+            # write failure means the actor's worker died — the
+            # actor_update DEAD/RESTARTING push fails over _actor_pending.
+            cli.fire("actor_call", call)
         except (rpc.ConnectionLost, rpc.RpcError, RayActorError) as e:
             err = serialization.pack_payload(
                 e if isinstance(e, RayActorError) else RayActorError(str(e))

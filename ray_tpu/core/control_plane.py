@@ -410,6 +410,12 @@ class ControlPlane:
         node = self.nodes.get(p["node_id"])
         if node is None:
             return {"unknown": True}  # tell agent to re-register
+        if not node.alive:
+            # a false positive (missed heartbeats under load, conn still
+            # up): make the agent re-register so the node RESURRECTS and
+            # node_added clears owners' dead-node routing state — without
+            # this, owners resubmit every task routed here forever
+            return {"unknown": True}
         node.last_heartbeat = time.monotonic()
         node.queued = p.get("queued", 0)
         node.queued_shapes = p.get("queued_shapes", [])

@@ -574,6 +574,7 @@ class NodeAgent:
 
     def _signal_worker_free(self):
         """Wake _pop_worker waiters (a worker went idle / died / spawned)."""
+        self._free_ver = getattr(self, "_free_ver", 0) + 1
         ev = getattr(self, "_worker_free_ev", None)
         if ev is not None:
             ev.set()
@@ -599,7 +600,8 @@ class NodeAgent:
     async def _pop_worker(self, job_id: bytes | None,
                           holds_tpu: bool = False,
                           runtime_env: dict | None = None, *,
-                          wait: bool = True) -> WorkerHandle | None:
+                          wait: bool = True,
+                          spawn_wait: bool = True) -> WorkerHandle | None:
         """Idle worker of the same job AND runtime env, else spawn
         (worker_pool.h PopWorker; env mismatch forces a new process).
         At the pool cap: evict an idle MISMATCHED worker to make room,
@@ -610,6 +612,9 @@ class NodeAgent:
         if not hasattr(self, "_worker_free_ev"):
             self._worker_free_ev = asyncio.Event()
         while True:
+            # snapshot BEFORE scanning: any free between scan and clear()
+            # bumps the version and forces an immediate rescan
+            ver = getattr(self, "_free_ver", 0)
             for w in self.workers.values():
                 if w.idle and w.ready.is_set() and w.job_id == job_id \
                         and getattr(w, "env_hash", None) == want \
@@ -631,6 +636,27 @@ class NodeAgent:
                                           key=lambda w: w.idle_since))
                     n_pool -= 1
             if n_pool < self._pool_worker_cap():
+                if not spawn_wait:
+                    # lease fast path: spawning takes ~100-400ms and the
+                    # grant RPC blocks the owner's submit loop — kick the
+                    # spawn in the background and refuse; the owner's
+                    # retry (pending pump) grants once it registers
+                    async def _bg_spawn():
+                        try:
+                            # re-check at RUN time: several refusals can
+                            # queue spawns before any executes — only the
+                            # ones still under the cap may fork
+                            n = sum(1 for w in self.workers.values()
+                                    if w.actor_id is None)
+                            if n >= self._pool_worker_cap():
+                                return
+                            await self._spawn_worker(
+                                job_id, holds_tpu, runtime_env)
+                        except Exception as e:  # noqa: BLE001
+                            logger.warning("background spawn failed: %s", e)
+
+                    asyncio.ensure_future(_bg_spawn())
+                    return None
                 w = await self._spawn_worker(job_id, holds_tpu, runtime_env)
                 # reserve: rpc_register_executor fires the free event the
                 # moment `ready` is set, and an unreserved idle worker
@@ -654,8 +680,13 @@ class NodeAgent:
                     f"no pool worker available within budget "
                     f"(cap {self._pool_worker_cap()})")
             # wait for a free signal, not a poll: hundreds of waiters
-            # polling starves the event loop
+            # polling starves the event loop. The version counter closes
+            # the lost-wakeup race — a worker freed between our scan and
+            # clear() would otherwise cost a silent 200ms stall per task
+            # (this was the queued-path throughput ceiling).
             self._worker_free_ev.clear()
+            if getattr(self, "_free_ver", 0) != ver:
+                continue  # freed since our scan; rescan immediately
             try:
                 await asyncio.wait_for(self._worker_free_ev.wait(),
                                        timeout=0.2)
@@ -694,15 +725,23 @@ class NodeAgent:
         while not self._dead:
             await asyncio.sleep(0.2)
             now = time.monotonic()
+            idle_reclaim = cfg.get("worker_lease_idle_reclaim_s")
             for lease_id, lease in list(self.leases.items()):
                 if now > lease["expires"]:
-                    if lease.get("active") is not None:
+                    if lease.get("active"):
                         # a direct-pushed task is still running: revoking
                         # now would hand its cpu to someone else and
                         # double-run the task — extend until it finishes
                         lease["expires"] = now + 1.0
                     else:
                         self._release_lease(lease_id)
+                elif (not lease.get("active")
+                      and now - lease["last_activity"] > idle_reclaim):
+                    # idle well under TTL: hand the worker back to the
+                    # pool so other owners/shapes aren't starved by
+                    # parked leases (the owner is notified and re-grants
+                    # in one RTT if its burst resumes)
+                    self._release_lease(lease_id)
             for w in list(self.workers.values()):
                 code = w.proc.poll()
                 if code is not None:
@@ -849,6 +888,16 @@ class NodeAgent:
             asyncio.ensure_future(self._notify_task_located(spec))
         self._kick_dispatch()
         return {"queued": "local"}
+
+    async def rpc_submit_task_batch(self, conn, p):
+        """Windowed batch from an owner's submission pump: one ack covers
+        the whole batch, so .remote() never blocks per task (the owner
+        pipelines these; reference pipelines lease pushes instead,
+        direct_task_transport.h:211)."""
+        out = []
+        for spec in p["specs"]:
+            out.append(await self.rpc_submit_task(conn, spec))
+        return {"n": len(out)}
 
     async def _notify_dep_lost(self, spec: dict, oid: bytes):
         try:
@@ -1086,12 +1135,21 @@ class NodeAgent:
         for w in self.workers.values():
             if w.actor_id is None and not (w.idle and w.ready.is_set()):
                 room -= 1
+        # Bound the saturated scan: when nothing is being granted (no
+        # worker room or no resources), rotating the whole queue per tick
+        # is O(n^2) churn across a drain (each task_done kicks a tick).
+        # A look-ahead window still finds smaller shapes queued behind
+        # big ones and keeps dep prefetch warm for imminent tasks.
+        stalled = 0
         for _ in range(len(self.task_queue)):
+            if stalled > 128:
+                break
             spec = self.task_queue.popleft()
             pool = self._task_pool(spec)
             if pool is None:
                 # PG bundle not here (yet) — requeue
                 self.task_queue.append(spec)
+                stalled += 1
                 continue
             need = spec.get("resources", {})
             if (pool is self.resources_available
@@ -1099,6 +1157,7 @@ class NodeAgent:
                     and not self._fits_with_reservations(need)):
                 # a pending actor has dibs on the next freed resources
                 self.task_queue.append(spec)
+                stalled += 1
                 continue
             if not self._fits(need, pool):
                 # A task this node can never satisfy re-evaluates the
@@ -1115,6 +1174,7 @@ class NodeAgent:
                             continue
                         spec["_spills"] -= 1
                 self.task_queue.append(spec)
+                stalled += 1
                 continue
             deps = spec.get("deps", [])
             missing = [d for d in deps if not self.store.contains(d)
@@ -1138,17 +1198,26 @@ class NodeAgent:
                     for d in missing:
                         asyncio.ensure_future(self._ensure_local(d))
                 self.task_queue.append(spec)
+                stalled += 1
                 continue
             if room <= 0:
                 # every pool worker is busy and the pool is at cap: leave
                 # the task queued; _kick_dispatch fires when a worker
                 # frees.
                 self.task_queue.append(spec)
+                stalled += 1
                 continue
             room -= 1
             self._take(need, pool)
             spec["_granted"] = True
+            stalled = 0
             progressed = True
+            # count the waiter AT GRANT TIME: ensure_future only schedules
+            # _run_task, and this loop can tick many times before it runs —
+            # counting inside _run_task left room computed against stale
+            # state, granting the entire queue in one burst (observed
+            # _pop_waiters at -545 equivalents)
+            self._pop_waiters = getattr(self, "_pop_waiters", 0) + 1
             asyncio.ensure_future(self._run_task(spec))
         return progressed
 
@@ -1164,7 +1233,8 @@ class NodeAgent:
         return dep in spec.get("inline_deps", ())
 
     async def _run_task(self, spec: dict):
-        self._pop_waiters = getattr(self, "_pop_waiters", 0) + 1
+        # NOTE: the matching _pop_waiters increment happened at grant time
+        # in _dispatch_once (see comment there)
         try:
             w = await self._pop_worker(
                 spec.get("job_id"),
@@ -1209,16 +1279,28 @@ class NodeAgent:
     def LEASE_TTL_S(self):  # read per call: honors late config overrides
         return cfg.get("worker_lease_ttl_s")
 
+    def _shape_spillable(self, need: dict) -> bool:
+        """Could any OTHER alive node's total resources fit this shape?
+        Refusals carry this bit so owners know whether pipelining onto an
+        existing lease would steal work from cluster spillback."""
+        return any(
+            v.get("alive") and nid != self.node_id
+            and all(v.get("resources_total", {}).get(r, 0) >= x
+                    for r, x in need.items() if x > 0)
+            for nid, v in self.cluster_view.items()
+        )
+
     async def rpc_lease_worker(self, conn, p):
         need = p.get("resources", {})
+        refusal = {"spillable": self._shape_spillable(need)}
         if not self._fits(need, self.resources_available):
-            return None  # busy: owner falls back to queued submission
+            return refusal  # busy: owner falls back to queued submission
         if self._actor_reservations and not self._fits_with_reservations(
             need
         ):
             # a pending actor has dibs — the fast path must honor the
             # same holdback as the dispatch loop or leases starve actors
-            return None
+            return refusal
         # take BEFORE the await: worker spawn can suspend for seconds and
         # the dispatch loop (or a concurrent lease) would double-book the
         # same resources
@@ -1230,13 +1312,14 @@ class NodeAgent:
             w = await self._pop_worker(
                 p.get("job_id"), holds_tpu=need.get("TPU", 0) > 0,
                 runtime_env=p.get("runtime_env"), wait=False,
+                spawn_wait=False,
             )
         except (asyncio.TimeoutError, OSError):
             w = None
         if w is None:
             for r, v in need.items():
                 self._release(r, v)
-            return None
+            return refusal
         lease_id = os.urandom(8)
         w.busy_task = b"__lease__" + lease_id
         now = time.monotonic()
@@ -1244,13 +1327,18 @@ class NodeAgent:
             "worker_id": w.worker_id,
             "resources": dict(need),
             "expires": now + self.LEASE_TTL_S,
-            "active": None,  # in-flight direct-pushed task id
+            "active": set(),  # in-flight direct-pushed task ids (owner
+            # pipelines up to worker_lease_depth onto one lease)
             "last_activity": now,
             "owner": p.get("owner"),
         }
         return {"lease_id": lease_id, "worker_id": w.worker_id,
                 "addr": w.addr, "port": w.port,
-                "ttl_s": self.LEASE_TTL_S}
+                "ttl_s": self.LEASE_TTL_S,
+                # grants carry the spill bit too: an owner that hits its
+                # lease cap without ever seeing a refusal must still know
+                # whether owner-side queueing would steal spillback work
+                "spillable": refusal["spillable"]}
 
     async def rpc_renew_lease(self, conn, p):
         lease = self.leases.get(p["lease_id"])
@@ -1281,7 +1369,7 @@ class NodeAgent:
         spec["_leased"] = True
         spec["_lease_id"] = p["lease_id"]
         spec["_worker_id"] = lease["worker_id"]
-        lease["active"] = tid
+        lease["active"].add(tid)
         lease["last_activity"] = time.monotonic()
         self.running[tid] = spec
         return True
@@ -1355,9 +1443,21 @@ class NodeAgent:
                             "error": repr(r)})
         return {"node_id": self.node_id, "workers": out}
 
+    async def rpc_tasks_done(self, conn, p):
+        """Batched leased-task completions (executors flush every ~50ms;
+        lease active-set bookkeeping tolerates the latency)."""
+        for tid in p["task_ids"]:
+            self._task_done_one(tid)
+        self._kick_dispatch()
+        return True
+
     async def rpc_task_done(self, conn, p):
         """Worker reports completion; frees resources, worker back to pool."""
-        tid = p["task_id"]
+        self._task_done_one(p["task_id"])
+        self._kick_dispatch()
+        return True
+
+    def _task_done_one(self, tid: bytes):
         spec = self.running.pop(tid, None)
         if spec is None:
             # possibly a leased task whose started-fire hasn't landed yet
@@ -1368,8 +1468,8 @@ class NodeAgent:
         elif spec.get("_leased"):
             # lease holds the resources/worker until returned or expired
             lease = self.leases.get(spec.get("_lease_id", b""))
-            if lease is not None and lease.get("active") == tid:
-                lease["active"] = None
+            if lease is not None:
+                lease["active"].discard(tid)
                 lease["last_activity"] = time.monotonic()
         else:
             self._free_task_resources(spec)
@@ -1378,8 +1478,6 @@ class NodeAgent:
                 w.busy_task = None
                 w.idle_since = time.monotonic()
                 self._signal_worker_free()
-        self._kick_dispatch()
-        return True
 
     async def rpc_cancel_task(self, conn, p):
         tid = p["task_id"]
@@ -1397,11 +1495,30 @@ class NodeAgent:
             # _kill_worker removed the handle, so the reap loop will never
             # see this death — clean up the task here.
             self.running.pop(tid, None)
-            self._free_task_resources(spec)
+            if spec.get("_leased"):
+                # the LEASE holds this worker's resources (direct-pushed
+                # task): release it — a stale entry with the cancelled
+                # task still in its active set would never expire and
+                # leak the cpu — and fail over any other tasks pipelined
+                # onto the killed worker.
+                lease_id = spec.get("_lease_id", b"")
+                self._release_lease(lease_id)
+                for otid, ospec in list(self.running.items()):
+                    if ospec.get("_lease_id") == lease_id:
+                        self.running.pop(otid, None)
+                        await self._notify_task_failed(
+                            ospec, "leased worker killed by cancel"
+                        )
+            else:
+                self._free_task_resources(spec)
             self._kick_dispatch()
             await self._notify_task_failed(spec, "cancelled",
                                            retriable=False)
             return {"cancelled": "running"}
+        if spec is not None:
+            # found but force=False: tell the owner the task IS here so it
+            # doesn't treat the reply as "maybe still in a submit batch"
+            return {"cancelled": "running_noforce"}
         return {"cancelled": None}
 
     # ---------------- actors ----------------
@@ -1455,7 +1572,7 @@ class NodeAgent:
                 now_ = time.monotonic()
                 grace = self.LEASE_TTL_S * 0.9
                 for lease_id, lease in list(self.leases.items()):
-                    if (lease.get("active") is None
+                    if (not lease.get("active")  # empty set = no in-flight
                             and now_ - lease.get("last_activity", 0)
                             > grace):
                         self._release_lease(lease_id)

@@ -37,6 +37,13 @@ class Executor(CoreWorker):
         self._actor = None
         self._actor_id: bytes | None = None
         self._owner_hints: dict[bytes, dict] = {}
+        # batched task-event buffer (+periodic flusher, started post-init)
+        self._event_buf: list[dict] = []
+        self._event_buf_lock = threading.Lock()
+        self._event_buf_t0 = time.monotonic()
+        self._done_buf: list[bytes] = []  # leased task_done batch
+        self._result_buf: dict[tuple, list] = {}  # owner -> result msgs
+        self._result_buf_lock = threading.Lock()
         # Async-actor event loop + per-concurrency-group pools (reference
         # core_worker/transport/concurrency_group_manager.cc + fiber.h):
         # created lazily in _create_actor from the actor's options.
@@ -47,6 +54,15 @@ class Executor(CoreWorker):
         self._method_groups: dict[str, str] = {}
         super().__init__(**kw)
         self._start_exec_threads(1)
+
+        def _event_flusher():
+            while True:
+                time.sleep(self._EVENT_FLUSH_S)
+                self._flush_task_events()
+                self._flush_results()  # backstop for deferred batches
+
+        threading.Thread(target=_event_flusher, daemon=True,
+                         name="ray_tpu-events").start()
 
     def _start_exec_threads(self, n: int):
         while len(self._exec_threads) < n:
@@ -254,8 +270,12 @@ class Executor(CoreWorker):
         kwargs = {k: _resolve(v) for k, v in kwargs.items()}
         return args, kwargs
 
-    def _push_one(self, cli, spec, oid: bytes, value=None, error=None,
+    def _push_one(self, owner, spec, oid: bytes, value=None, error=None,
                   extra: dict | None = None):
+        """Build one result message and BUFFER it per owner — batches of
+        results ship as one push_results frame (one decode + handler
+        dispatch at the owner instead of one per result; the owner loop
+        is the single-host throughput ceiling for task storms)."""
         msg = {"object_id": oid, "task_id": spec["task_id"]}
         if extra:
             msg.update(extra)
@@ -272,34 +292,52 @@ class Executor(CoreWorker):
                 self._put_plasma(oid, payload)
                 msg["in_plasma"] = True
                 msg["size"] = size
-        if cli is not None:
+        key = (owner["addr"], owner["port"])
+        with self._result_buf_lock:
+            self._result_buf.setdefault(key, []).append(msg)
+            n = sum(len(v) for v in self._result_buf.values())
+        if n >= 16:
+            self._flush_results()
+
+    def _flush_results(self):
+        with self._result_buf_lock:
+            bufs = self._result_buf
+            self._result_buf = {}
+        for (addr, port), items in bufs.items():
+            cli = self._peer({"addr": addr, "port": port})
+            if cli is None:
+                continue
             try:
-                cli.oneway("push_result", msg)
+                if len(items) == 1:
+                    cli.fire("push_result", items[0])
+                else:
+                    cli.fire("push_results", {"items": items})
             except (rpc.ConnectionLost, rpc.RpcError):
                 pass
 
-    def _push_results(self, spec, owner, results, error=None):
-        cli = self._peer(owner)
+    def _push_results(self, spec, owner, results, error=None,
+                      defer_flush: bool = False):
         n = spec.get("num_returns", 1)
         task_id = spec["task_id"]
         if n == "dynamic":
             # error path for a generator task: fail the descriptor object
             oid = ObjectID.for_task_return(TaskID(task_id), 0).binary()
-            self._push_one(cli, spec, oid, error=error)
-            return
-        for i in range(n):
-            oid = ObjectID.for_task_return(TaskID(task_id), i).binary()
-            value = None if error is not None else (
-                results[i] if n > 1 else results
-            )
-            self._push_one(cli, spec, oid, value=value, error=error)
+            self._push_one(owner, spec, oid, error=error)
+        else:
+            for i in range(n):
+                oid = ObjectID.for_task_return(TaskID(task_id), i).binary()
+                value = None if error is not None else (
+                    results[i] if n > 1 else results
+                )
+                self._push_one(owner, spec, oid, value=value, error=error)
+        if not defer_flush:
+            self._flush_results()
 
     def _push_dynamic_results(self, spec, owner, results):
         """num_returns="dynamic" (reference _raylet.pyx:186
         ObjectRefGenerator): each yielded value becomes its own object at
         return index 1.., then the index-0 descriptor carries the id list.
         Items stream to the owner as the generator produces them."""
-        cli = self._peer(owner)
         task_id = spec["task_id"]
         oids: list[bytes] = []
         for value in results:
@@ -308,32 +346,66 @@ class Executor(CoreWorker):
             ).binary()
             # partial: the generator is still running — the owner must not
             # release submitted-task pins or in-flight tracking yet
-            self._push_one(cli, spec, oid, value=value,
+            self._push_one(owner, spec, oid, value=value,
                            extra={"partial": True})
+            self._flush_results()  # stream as produced
             oids.append(oid)
         desc = ObjectID.for_task_return(TaskID(task_id), 0).binary()
         # dynamic_items lets the owner register descriptor->items nesting
         # so dropping the descriptor ref frees the items too
-        self._push_one(cli, spec, desc, value=DynamicReturns(oids),
+        self._push_one(owner, spec, desc, value=DynamicReturns(oids),
                        extra={"dynamic_items": oids})
+        self._flush_results()
+
+    _EVENT_FLUSH_S = 0.05
+    _EVENT_FLUSH_N = 100
 
     def _emit_task_event(self, spec, state: str, start: float, end: float,
                          name: str | None = None):
         """TaskEventBuffer analog (task_event_buffer.h:205): lifecycle
-        events fired to the head's bounded event store."""
-        try:
-            self.head.fire("task_events", {"events": [{
-                "task_id": spec["task_id"],
-                "job_id": spec.get("job_id"),
-                "name": name or spec.get("name", "task"),
-                "state": state,
-                "worker_id": self.worker_id,
-                "node_id": self.node_id,
-                "start_s": start,
-                "end_s": end,
-            }]})
-        except Exception:  # noqa: BLE001 — observability is best-effort
-            pass
+        events buffered and flushed in batches — one frame per event cost
+        a head-side decode+dispatch per task (matters on small hosts)."""
+        ev = {
+            "task_id": spec["task_id"],
+            "job_id": spec.get("job_id"),
+            "name": name or spec.get("name", "task"),
+            "state": state,
+            "worker_id": self.worker_id,
+            "node_id": self.node_id,
+            "start_s": start,
+            "end_s": end,
+        }
+        now = time.monotonic()
+        flush = None
+        with self._event_buf_lock:
+            self._event_buf.append(ev)
+            if (len(self._event_buf) >= self._EVENT_FLUSH_N
+                    or now - self._event_buf_t0 >= self._EVENT_FLUSH_S):
+                flush = self._event_buf
+                self._event_buf = []
+                self._event_buf_t0 = now
+        if flush is not None:
+            try:
+                self.head.fire("task_events", {"events": flush})
+            except Exception:  # noqa: BLE001 — observability best-effort
+                pass
+
+    def _flush_task_events(self):
+        with self._event_buf_lock:
+            flush = self._event_buf
+            self._event_buf = []
+            dones = self._done_buf
+            self._done_buf = []
+        if flush:
+            try:
+                self.head.fire("task_events", {"events": flush})
+            except Exception:  # noqa: BLE001
+                pass
+        if dones:
+            try:
+                self.agent.fire("tasks_done", {"task_ids": dones})
+            except Exception:  # noqa: BLE001
+                pass
 
     def _execute_task(self, spec):
         owner = spec["owner"]
@@ -362,7 +434,10 @@ class Executor(CoreWorker):
                 # worker — the event would be lost in that race
                 self._emit_task_event(spec, "FINISHED", t_start,
                                       time.time())
-                self._push_results(spec, owner, results)
+                # defer the flush while more tasks are queued here: the
+                # next completion (or the 50ms flusher) ships the batch
+                self._push_results(spec, owner, results,
+                                   defer_flush=not self._exec_queue.empty())
         except BaseException as e:  # noqa: BLE001 — report, don't die
             tb = traceback.format_exc()
             logger.warning("task %s failed: %s", spec.get("name"), tb)
@@ -377,7 +452,19 @@ class Executor(CoreWorker):
             self._push_results(spec, owner, None, error=err)
         finally:
             try:
-                self.agent.call("task_done", {"task_id": spec["task_id"]})
+                if spec.get("leased"):
+                    # leased slots are owner-accounted; the agent's
+                    # active-set bookkeeping tolerates batching latency
+                    with self._event_buf_lock:
+                        self._done_buf.append(spec["task_id"])
+                else:
+                    # fire, not call: a full agent round-trip here would
+                    # serialize this worker's exec loop on the (shared,
+                    # busy) agent event loop — the ack is not needed to
+                    # start the next task. Pool-task dones stay unbatched:
+                    # the agent frees resources/workers on them.
+                    self.agent.fire("task_done",
+                                    {"task_id": spec["task_id"]})
             except (rpc.ConnectionLost, rpc.RpcError):
                 pass
 

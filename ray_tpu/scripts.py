@@ -36,7 +36,8 @@ def _run_head(args):
         port = await cp.start()
         res = json.loads(args.resources) if args.resources else \
             detect_resources()
-        agent = NodeAgent(args.host, port, host=args.host, resources=res)
+        agent = NodeAgent(args.host, port, host=args.host, resources=res,
+                          store_capacity=args.store_capacity)
         await agent.start()
         print(f"ray_tpu head up: --address {args.host}:{port}", flush=True)
         await asyncio.Event().wait()
@@ -52,7 +53,8 @@ def _run_node(args):
     async def _main():
         res = json.loads(args.resources) if args.resources else \
             detect_resources()
-        agent = NodeAgent(host, int(port), host=args.host, resources=res)
+        agent = NodeAgent(host, int(port), host=args.host, resources=res,
+                          store_capacity=args.store_capacity)
         await agent.start()
         print(f"ray_tpu node joined {args.address}", flush=True)
         await asyncio.Event().wait()
@@ -245,6 +247,9 @@ def main(argv=None):
     st.add_argument("--host", default="127.0.0.1")
     st.add_argument("--port", type=int, default=0)
     st.add_argument("--resources", default=None, help="JSON resource map")
+    st.add_argument("--store-capacity", type=int,
+                    default=512 * 1024 * 1024,
+                    help="shared-memory object store bytes")
     st.add_argument("--persist-path", default=None,
                     help="head snapshot file (GCS fault tolerance)")
 

@@ -34,10 +34,10 @@ def _rate(fn, n):
 def test_put_get_floors(cluster):
     kb = np.zeros(1024, dtype=np.uint8)
     ref = ray_tpu.put(b"ok")
-    assert _rate(lambda: ray_tpu.get(ref), 200) > 5_000  # measured ~300k/s
-    assert _rate(lambda: ray_tpu.put(kb), 100) > 300  # measured ~9k/s
+    assert _rate(lambda: ray_tpu.get(ref), 200) > 60_000  # measured ~320k/s
+    assert _rate(lambda: ray_tpu.put(kb), 100) > 3_000  # measured ~18k/s
     mb = np.zeros(1024 * 1024, dtype=np.uint8)
-    assert _rate(lambda: ray_tpu.put(mb), 30) > 50  # measured ~1k/s
+    assert _rate(lambda: ray_tpu.put(mb), 30) > 150  # measured ~860/s
 
 
 def test_task_throughput_floors(cluster):
@@ -52,13 +52,15 @@ def test_task_throughput_floors(cluster):
     out = ray_tpu.get([noop.remote() for _ in range(500)], timeout=120)
     rate = 500 / (time.perf_counter() - t0)
     assert sum(out) == 500
-    assert rate > 100, f"batched task throughput {rate:.0f}/s"  # ~700/s
+    # pipelined submission + lease refill: measured ~3.5k/s (r4); the
+    # floor would catch a regression to the pre-pipelining ~700/s path
+    assert rate > 1_000, f"batched task throughput {rate:.0f}/s"
 
     t0 = time.perf_counter()
     for _ in range(20):
         ray_tpu.get(noop.remote(), timeout=60)
     sync_rate = 20 / (time.perf_counter() - t0)
-    assert sync_rate > 50, f"sync task roundtrip {sync_rate:.0f}/s"  # ~850/s
+    assert sync_rate > 400, f"sync task roundtrip {sync_rate:.0f}/s"  # ~1.4k/s
 
 
 def test_no_worker_fork_storm(cluster):
@@ -88,7 +90,8 @@ def test_actor_call_floors(cluster):
     out = ray_tpu.get([a.ping.remote() for _ in range(500)], timeout=120)
     rate = 500 / (time.perf_counter() - t0)
     assert len(out) == 500
-    assert rate > 200, f"actor async call throughput {rate:.0f}/s"  # ~2k/s
+    # fired (non-blocking) actor calls: measured ~8.5k/s (r4)
+    assert rate > 2_000, f"actor async call throughput {rate:.0f}/s"
 
 
 def test_wait_1k_refs_floor(cluster):
