@@ -1,11 +1,16 @@
-"""Headline benchmark: Llama decoder training throughput on one TPU chip.
+"""Headline benchmark: Llama decoder training + decode throughput, one chip.
 
-Prints ONE JSON line: tokens/sec/chip for a full fwd+bwd+adamw train step on a
-350M-param Llama config (bf16 compute, f32 masters, remat, flash attention).
-`vs_baseline` is model FLOPs utilization (6*N*tokens FLOPs) against the
-north-star 45% MFU anchor from BASELINE.md.
+Prints ONE JSON line. The primary metric stays the round-1..3-comparable
+350M train tokens/s/chip (vs_baseline = MFU / 45% north star,
+BASELINE.md); `extra` additionally carries a ~1B-class train config (the
+largest of the family that fits one v5e HBM with f32 masters + bf16
+moments + dots_flash remat) and a KV-cache decode benchmark (whole decode
+loop scanned inside one jit — `models/llama.py generate_scan`).
+
+Standalone: `python bench.py [--only 350m|1b|decode]`.
 """
 
+import argparse
 import json
 import os
 import sys
@@ -22,11 +27,14 @@ if _kept != _flags:
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
-import optax  # noqa: E402
 
 from ray_tpu.models import llama  # noqa: E402
 from ray_tpu.parallel import MeshConfig, build_mesh, use_mesh  # noqa: E402
-from ray_tpu.train import batch_sharding, init_train_state, make_train_step  # noqa: E402
+from ray_tpu.train import (  # noqa: E402
+    batch_sharding,
+    init_train_state,
+    make_train_step,
+)
 
 PEAK_FLOPS = {
     "tpu v5 lite": 197e12,  # v5e bf16 peak per chip
@@ -44,9 +52,28 @@ def peak_flops_per_chip() -> float:
     return 197e12  # default to v5e
 
 
-def main():
-    batch, seq = (8, 2048)
-    cfg = llama.llama2_size("350m")
+def _sync(x):
+    # NOTE: jax.block_until_ready is a no-op under the axon TPU tunnel;
+    # device_get of an output scalar is the only reliable barrier.
+    return float(jax.device_get(x))
+
+
+def _retry_compile(fn, attempts: int = 4):
+    """The axon remote-compile helper intermittently 500s on large fresh
+    programs; retry before giving up (cached compiles are unaffected)."""
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except Exception:
+            if attempt == attempts - 1:
+                raise
+            time.sleep(20)
+
+
+def bench_train(size: str, batch: int, seq: int, *, windows: int = 8,
+                n_steps: int = 5, grads_dtype=None,
+                remat_policy: str = "dots_flash") -> dict:
+    cfg = llama.llama2_size(size)
     cfg = llama.LlamaConfig(
         **{
             **cfg.__dict__,
@@ -54,9 +81,9 @@ def main():
             "max_seq_len": seq,
             "dtype": "bfloat16",
             "remat": True,
-            # save the flash kernel's (out, lse) residuals: the backward
+            # default: save the flash (out, lse) residuals so the backward
             # reuses them instead of re-running the forward attention
-            "remat_policy": "dots_flash",
+            "remat_policy": remat_policy,
         }
     )
     n_params = cfg.num_params()
@@ -79,68 +106,142 @@ def main():
     step = make_train_step(
         lambda p, b: llama.loss_fn(p, b, cfg), opt, mesh, state_sh,
         compute_grad_norm=False,  # telemetry pass the bench doesn't read
+        grads_dtype=grads_dtype,
     )
 
     toks = jax.random.randint(
-        jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size, dtype=jnp.int32
+        jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size,
+        dtype=jnp.int32,
     )
     data = {"inputs": toks[:, :-1], "targets": toks[:, 1:]}
 
-    # NOTE: jax.block_until_ready is a no-op under the axon TPU tunnel;
-    # device_get of an output scalar is the only reliable barrier. Its
-    # roundtrip cost (~0.1s) is measured and subtracted.
-    def sync(metrics):
-        return float(jax.device_get(metrics["loss"]))
-
     with use_mesh(mesh):
         data = jax.device_put(data, batch_sharding(mesh))
-        # Warmup / compile. The axon remote-compile helper intermittently
-        # 500s on large fresh programs; retry before giving up (cached
-        # compiles are unaffected).
-        for attempt in range(4):
-            try:
-                for _ in range(2):
-                    state, metrics = step(state, data)
-                sync(metrics)
-                break
-            except Exception:
-                if attempt == 3:
-                    raise
-                time.sleep(20)
+
+        def warm():
+            nonlocal state
+            for _ in range(2):
+                state, metrics = step(state, data)
+            _sync(metrics["loss"])
+            return metrics
+
+        metrics = _retry_compile(warm)
         t0 = time.perf_counter()
-        sync(metrics)
+        _sync(metrics["loss"])
         sync_overhead = time.perf_counter() - t0
 
-        # best of 8 windows: the TPU behind the tunnel is time-shared, so
+        # best of N windows: the TPU behind the tunnel is time-shared, so
         # any single window can absorb another tenant's burst; min-of-
-        # windows is the standard timeit practice for measuring the
-        # machine rather than the neighbors.
-        n_steps = 5
+        # windows measures the machine rather than the neighbors.
         dt = float("inf")
-        for _ in range(8):
+        for _ in range(windows):
             t0 = time.perf_counter()
             for _ in range(n_steps):
                 state, metrics = step(state, data)
-            loss = sync(metrics)
+            loss = _sync(metrics["loss"])
             dt = min(dt, time.perf_counter() - t0 - sync_overhead)
 
     tokens_per_sec = batch * seq * n_steps / dt
-    model_flops = 6.0 * n_params * tokens_per_sec  # fwd+bwd FLOPs/token ~ 6N
+    model_flops = 6.0 * n_params * tokens_per_sec  # fwd+bwd FLOPs/token ~6N
     mfu = model_flops / peak_flops_per_chip()
+    return {
+        "tokens_per_sec": round(tokens_per_sec, 1),
+        "mfu": round(mfu, 4),
+        "n_params": n_params,
+        "batch": batch,
+        "seq": seq,
+        "step_time_s": round(dt / n_steps, 4),
+        "loss": round(loss, 4),
+    }
+
+
+def bench_decode(size: str, batch: int, prompt_len: int, new_tokens: int,
+                 *, windows: int = 5) -> dict:
+    """KV-cache serving throughput: prefill + `new_tokens` greedy decode
+    steps, the whole loop inside ONE jit (generate_scan) so the tunnel's
+    per-dispatch latency is paid once per sequence, not per token."""
+    cfg = llama.llama2_size(size)
+    cfg = llama.LlamaConfig(
+        **{
+            **cfg.__dict__,
+            "vocab_size": 32128,
+            "max_seq_len": prompt_len + new_tokens,
+            "dtype": "bfloat16",
+        }
+    )
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, prompt_len), 0, cfg.vocab_size,
+        dtype=jnp.int32,
+    )
+    max_len = prompt_len + new_tokens
+
+    def run():
+        cache = llama.init_cache(cfg, batch, max_len)
+        out, _ = llama.generate_scan(params, prompt, cfg, new_tokens, cache)
+        return _sync(out[0, -1])
+
+    _retry_compile(run)  # compile
+    dt = float("inf")
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        run()
+        dt = min(dt, time.perf_counter() - t0)
+    toks_per_s = batch * new_tokens / dt
+    return {
+        "decode_tokens_per_sec": round(toks_per_s, 1),
+        "per_stream_tokens_per_sec": round(toks_per_s / batch, 1),
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "n_params": cfg.num_params(),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--only", choices=["350m", "1b", "decode"], default=None)
+    args = ap.parse_args()
+
+    if args.only == "350m":
+        print(json.dumps(bench_train("350m", 8, 2048)))
+        return
+    if args.only == "1b":
+        print(json.dumps(bench_train("1b", 2, 2048,
+                                     grads_dtype=jnp.bfloat16,
+                                     remat_policy="flash_qkv")))
+        return
+    if args.only == "decode":
+        print(json.dumps(bench_decode("1b", 8, 128, 128)))
+        return
+
+    r350 = bench_train("350m", 8, 2048)
+    extra = {
+        "mfu": r350["mfu"],
+        "n_params": r350["n_params"],
+        "batch": r350["batch"],
+        "seq": r350["seq"],
+        "step_time_s": r350["step_time_s"],
+        "device": jax.devices()[0].device_kind,
+        "loss": r350["loss"],
+    }
+    try:
+        extra["train_1b"] = bench_train("1b", 2, 2048, windows=5,
+                                        grads_dtype=jnp.bfloat16,
+                                        remat_policy="flash_qkv")
+    except Exception as e:  # noqa: BLE001 — headline must still print
+        extra["train_1b"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    try:
+        extra["decode_1b"] = bench_decode("1b", 8, 128, 128)
+    except Exception as e:  # noqa: BLE001
+        extra["decode_1b"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+
     result = {
         "metric": "llama350m_train_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec, 1),
+        "value": r350["tokens_per_sec"],
         "unit": "tokens/s/chip",
-        "vs_baseline": round(mfu / NORTH_STAR_MFU, 4),
-        "extra": {
-            "mfu": round(mfu, 4),
-            "n_params": n_params,
-            "batch": batch,
-            "seq": seq,
-            "step_time_s": round(dt / n_steps, 4),
-            "device": jax.devices()[0].device_kind,
-            "loss": round(loss, 4),
-        },
+        "vs_baseline": round(r350["mfu"] / NORTH_STAR_MFU, 4),
+        "extra": extra,
     }
     print(json.dumps(result))
 

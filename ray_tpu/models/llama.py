@@ -415,13 +415,22 @@ def forward(params, tokens, cfg: LlamaConfig, *, positions=None):
                     "mlp_gate", "mlp_up"
                 ),
             )
+        elif cfg.remat_policy == "flash_qkv":
+            # memory-lean point for 1B-class states on one chip: save
+            # ONLY the flash residuals + rotary'd q/k/v (attention never
+            # re-runs) and recompute every projection/MLP dot in the
+            # backward (~40% of fwd FLOPs re-done for ~3x less saved
+            # activation bytes than 'dots').
+            policy = jax.checkpoint_policies.save_only_these_names(
+                "flash_out", "flash_lse", "qkv_q", "qkv_k", "qkv_v"
+            )
         elif cfg.remat_policy == "nothing":
             policy = None  # full remat: only layer inputs survive
         else:
             raise ValueError(
                 f"unknown remat_policy {cfg.remat_policy!r}; expected "
                 "'dots', 'dots_flash', 'dots_flash_qkv', "
-                "'dots_flash_qkv_mlp', or 'nothing'"
+                "'dots_flash_qkv_mlp', 'flash_qkv', or 'nothing'"
             )
         layer_fn = jax.checkpoint(layer_fn, policy=policy)
 
@@ -551,6 +560,30 @@ def _fwd_with_cache_jit(params, tokens, cache, cfg: LlamaConfig):
     # LlamaConfig is frozen/hashable, so the compiled step is cached per
     # config across calls (one prefill shape + one decode shape).
     return forward_with_cache(params, tokens, cfg, cache)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "max_new_tokens"))
+def generate_scan(params, prompt, cfg: LlamaConfig, max_new_tokens: int,
+                  cache: dict):
+    """Prefill + greedy decode with the WHOLE decode loop inside one jit
+    (lax.scan over steps, static-shape cache): one dispatch per sequence
+    instead of one per token — the right shape for TPU, and mandatory
+    when device dispatch rides a high-latency tunnel. Returns
+    ([B, max_new_tokens] generated tokens, final cache)."""
+    logits, cache = forward_with_cache(params, prompt, cfg, cache)
+    tok0 = jnp.argmax(logits[:, -1:], axis=-1).astype(prompt.dtype)
+
+    def step(carry, _):
+        tok, c = carry
+        lg, c = forward_with_cache(params, tok, cfg, c)
+        nxt = jnp.argmax(lg[:, -1:], axis=-1).astype(tok.dtype)
+        return (nxt, c), tok[:, 0]
+
+    (last, cache), toks = jax.lax.scan(
+        step, (tok0, cache), None, length=max_new_tokens - 1
+    )
+    out = jnp.concatenate([jnp.moveaxis(toks, 0, 1), last], axis=1)
+    return out, cache
 
 
 def greedy_generate(params, prompt, cfg: LlamaConfig, max_new_tokens: int,

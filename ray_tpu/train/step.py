@@ -124,6 +124,7 @@ def make_train_step(
     *,
     donate_state: bool = True,
     compute_grad_norm: bool = True,
+    grads_dtype=None,
 ):
     """Build the jitted SPMD train step: (state, batch) -> (state, metrics).
 
@@ -131,13 +132,26 @@ def make_train_step(
     compute_grad_norm=False drops the grad_norm metric — its global_norm is
     an extra full HBM pass over the gradient tree (~2 ms at 350M on v5e),
     real money in a tight step when the caller doesn't log it.
+    grads_dtype=bfloat16 differentiates through a low-precision view of
+    the params so the stored gradient tree is bf16 — halves the gradient
+    HBM footprint (the fit-enabler for 1B-class states on one v5e chip);
+    dot accumulation stays f32 inside XLA, and the fused optimizer
+    upcasts per-leaf before the f32 master update.
     """
     scalar = NamedSharding(mesh, PartitionSpec())
 
     def step(state: TrainState, batch):
-        (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, batch
-        )
+        if grads_dtype is not None:
+            p_low = jax.tree_util.tree_map(
+                lambda p: p.astype(grads_dtype)
+                if jnp.issubdtype(p.dtype, jnp.floating) else p,
+                state.params,
+            )
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(p_low, batch)
+        else:
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state.params, batch)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         if compute_grad_norm:

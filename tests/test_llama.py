@@ -143,3 +143,20 @@ def test_remat_policies_agree(rng):
             ),
             grads["dots"], grads[policy],
         )
+
+
+def test_generate_scan_matches_eager_greedy(rng):
+    """The one-jit scanned decode loop (bench/serve path) must produce
+    exactly the eager per-token greedy loop's tokens."""
+    cfg = _cfg()
+    params = llama.init_params(cfg, rng)
+    prompt = jax.random.randint(rng, (2, 7), 0, cfg.vocab_size,
+                                dtype=jnp.int32)
+    n_new = 9
+    eager = llama.greedy_generate(params, prompt, cfg, n_new)
+    cache = llama.init_cache(cfg, 2, prompt.shape[1] + n_new)
+    scanned, cache2 = llama.generate_scan(params, prompt, cfg, n_new, cache)
+    np.testing.assert_array_equal(np.asarray(eager[:, prompt.shape[1]:]),
+                                  np.asarray(scanned))
+    # the final sampled token is returned but never fed back through
+    assert int(cache2["pos"]) == prompt.shape[1] + n_new - 1
