@@ -207,7 +207,9 @@ def main():
         print(json.dumps(bench_train("350m", 8, 2048)))
         return
     if args.only == "1b":
-        print(json.dumps(bench_train("1b", 2, 2048,
+        # windows=5 matches the combined main() protocol so standalone
+        # reproductions are comparable to the committed numbers
+        print(json.dumps(bench_train("1b", 2, 2048, windows=5,
                                      grads_dtype=jnp.bfloat16,
                                      remat_policy="flash_qkv")))
         return

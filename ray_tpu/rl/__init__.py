@@ -22,3 +22,12 @@ from ray_tpu.rl.multi_agent import (  # noqa: F401
     SharedPolicyWrapper,
 )
 from ray_tpu.rl.vtrace import vtrace  # noqa: F401
+from ray_tpu.rl.sac import SAC, SACConfig, SACLearner  # noqa: F401
+from ray_tpu.rl.connectors import (  # noqa: F401
+    ClipAction,
+    Connector,
+    FrameStack,
+    ObsNormalizer,
+    Pipeline,
+)
+from ray_tpu.rl.envs import PendulumEnv  # noqa: F401

@@ -16,18 +16,23 @@ import ray_tpu
 @ray_tpu.remote(num_cpus=1)
 class EnvRunner:
     def __init__(self, env_creator_blob, obs_dim: int, n_actions: int,
-                 seed: int = 0):
+                 seed: int = 0, connectors_blob=None):
         import jax
 
         jax.config.update("jax_platforms", "cpu")
         from ray_tpu._private import serialization
+        from ray_tpu.rl import connectors as _conn
         from ray_tpu.rl import models
 
         env_creator = serialization.unpack_payload(env_creator_blob)
         self.env = env_creator()
         self.models = models
         self.rng = np.random.RandomState(seed)
-        self._obs = np.asarray(self.env.reset(), np.float32)
+        # env_to_module connector pipeline (rllib/connectors analog);
+        # obs_dim refers to the POST-connector width (e.g. FrameStack(k)
+        # multiplies the raw dim by k)
+        self.obs_pipe = _conn.pipeline_from_blob(connectors_blob)
+        self._obs = self.obs_pipe(np.asarray(self.env.reset(), np.float32))
         self._fwd = jax.jit(models.forward)
 
     def set_weights(self, params):
@@ -50,8 +55,12 @@ class EnvRunner:
             done_l.append(bool(done))
             logp_l.append(lp)
             val_l.append(float(value[0]))
-            obs = (np.asarray(self.env.reset(), np.float32) if done
-                   else np.asarray(nxt, np.float32))
+            if done:
+                self.obs_pipe.reset()
+                obs = self.obs_pipe(
+                    np.asarray(self.env.reset(), np.float32))
+            else:
+                obs = self.obs_pipe(np.asarray(nxt, np.float32))
         # bootstrap value of the final obs for GAE
         _, last_v = self._fwd(self.params, jnp.asarray(obs[None]))
         self._obs = obs

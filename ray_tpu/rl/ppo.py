@@ -38,6 +38,10 @@ class PPOConfig:
     # >1: distributed LearnerGroup actors with per-minibatch gradient
     # allreduce (reference learner_group.py:225 _distributed_update)
     num_learners: int = 1
+    # env_to_module connector pipeline factory shared by all runners
+    # (rllib/connectors analog, rl/connectors.py); obs_dim refers to the
+    # POST-connector width
+    connectors: Callable | None = None
 
     def build(self) -> "PPO":
         return PPO(self)
@@ -60,10 +64,13 @@ class PPO:
                 config.obs_dim, config.n_actions, lr=config.lr,
                 clip=config.clip, entropy_coeff=config.entropy_coeff,
             )
+        from ray_tpu.rl import connectors as _conn
+
         blob = serialization.pack_callable(config.env_creator)
+        conn_blob = _conn.pack_factory(config.connectors)
         self.runners = [
             EnvRunner.remote(blob, config.obs_dim, config.n_actions,
-                             seed=i)
+                             seed=i, connectors_blob=conn_blob)
             for i in range(config.num_env_runners)
         ]
         self._sync_weights()

@@ -11,11 +11,17 @@ import numpy as np
 
 
 class ReplayBuffer:
-    def __init__(self, capacity: int, obs_dim: int, seed: int = 0):
+    def __init__(self, capacity: int, obs_dim: int, seed: int = 0,
+                 action_dim: int | None = None):
+        """action_dim=None: discrete int actions (DQN); an int: float
+        action VECTORS of that width (SAC-class continuous control)."""
         self.capacity = capacity
         self.obs = np.zeros((capacity, obs_dim), np.float32)
         self.next_obs = np.zeros((capacity, obs_dim), np.float32)
-        self.actions = np.zeros(capacity, np.int32)
+        if action_dim is None:
+            self.actions = np.zeros(capacity, np.int32)
+        else:
+            self.actions = np.zeros((capacity, action_dim), np.float32)
         self.rewards = np.zeros(capacity, np.float32)
         self.dones = np.zeros(capacity, np.bool_)
         self._idx = 0

@@ -1,0 +1,439 @@
+"""SAC: off-policy continuous control with a tanh-squashed Gaussian
+policy, twin critics, and learned entropy temperature.
+
+Reference: rllib/algorithms/sac/sac.py:1 (+ sac_torch_policy.py's
+actor/critic/alpha losses). TPU-native shape: the whole update — twin-Q
+Bellman regression against the entropy-regularized target, reparameterized
+actor loss through min(Q1,Q2), alpha loss against the entropy target, and
+the polyak target blend — is ONE jitted function over a single params
+tree; no per-network module objects. Sampling actors run the squashed
+Gaussian on host CPU through a connector pipeline (obs normalization,
+action clipping — rllib/connectors analog, ray_tpu/rl/connectors.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+from ray_tpu._private import serialization
+from ray_tpu.rl.env_runner import EpisodeReturns
+from ray_tpu.rl.replay import ReplayBuffer
+
+LOG_STD_MIN, LOG_STD_MAX = -8.0, 2.0
+
+
+# ---------------- continuous-control networks ----------------
+
+def _dense(k, i, o):
+    return {"w": jax.random.normal(k, (i, o), jnp.float32) / jnp.sqrt(i),
+            "b": jnp.zeros((o,), jnp.float32)}
+
+
+def init_sac_params(key, obs_dim: int, action_dim: int,
+                    hidden: int = 128) -> dict:
+    ks = jax.random.split(key, 10)
+    actor = {
+        "h1": _dense(ks[0], obs_dim, hidden),
+        "h2": _dense(ks[1], hidden, hidden),
+        "mu": _dense(ks[2], hidden, action_dim),
+        "log_std": _dense(ks[3], hidden, action_dim),
+    }
+
+    def q_net(k1, k2, k3):
+        return {
+            "h1": _dense(k1, obs_dim + action_dim, hidden),
+            "h2": _dense(k2, hidden, hidden),
+            "out": _dense(k3, hidden, 1),
+        }
+
+    return {
+        "actor": actor,
+        "q1": q_net(ks[4], ks[5], ks[6]),
+        "q2": q_net(ks[7], ks[8], ks[9]),
+        # alpha = exp(log_alpha), learned against the entropy target
+        "log_alpha": jnp.zeros((), jnp.float32),
+    }
+
+
+def _mlp(p, x):
+    # relu, not tanh: critics regress onto returns whose magnitude is
+    # reward_scale-dependent; bounded features throttle how fast the
+    # linear head can reach large targets
+    h = jax.nn.relu(x @ p["h1"]["w"] + p["h1"]["b"])
+    return jax.nn.relu(h @ p["h2"]["w"] + p["h2"]["b"])
+
+
+def actor_dist(actor, obs):
+    """obs [B, O] -> (mu [B, A], log_std [B, A]) pre-squash."""
+    h = _mlp(actor, obs)
+    mu = h @ actor["mu"]["w"] + actor["mu"]["b"]
+    log_std = jnp.clip(
+        h @ actor["log_std"]["w"] + actor["log_std"]["b"],
+        LOG_STD_MIN, LOG_STD_MAX,
+    )
+    return mu, log_std
+
+
+def sample_action(actor, obs, key, action_scale: float):
+    """Reparameterized tanh-Gaussian sample: (action [B, A] in
+    [-scale, scale], log-prob [B] with the tanh/scale Jacobian folded in)."""
+    mu, log_std = actor_dist(actor, obs)
+    std = jnp.exp(log_std)
+    u = mu + std * jax.random.normal(key, mu.shape)
+    a = jnp.tanh(u)
+    # N(u; mu, std) log-density minus log|d tanh/du| minus log(scale)
+    logp = (
+        -0.5 * (((u - mu) / std) ** 2 + 2 * log_std + jnp.log(2 * jnp.pi))
+        - jnp.log(1.0 - a ** 2 + 1e-6) - jnp.log(action_scale)
+    ).sum(axis=-1)
+    return a * action_scale, logp
+
+
+def q_value(q, obs, act):
+    h = _mlp(q, jnp.concatenate([obs, act], axis=-1))
+    return (h @ q["out"]["w"] + q["out"]["b"])[:, 0]
+
+
+# ---------------- the jitted update ----------------
+
+class SACLearner:
+    """Owns params + target nets + three optimizers (actor/critic/alpha,
+    one optax chain each over masked subtrees would be equivalent; kept
+    explicit for readability). `grad_fn`/`apply_grads` form the
+    LearnerGroup seam: gradients over the WHOLE params tree computed on a
+    shard can be allreduced before apply (see SACLearnerGroup)."""
+
+    def __init__(self, obs_dim: int, action_dim: int, *,
+                 action_scale: float = 1.0, lr: float = 3e-4,
+                 lr_critic: float | None = None,
+                 gamma: float = 0.99, tau: float = 0.005,
+                 target_entropy: float | None = None,
+                 reward_scale: float = 1.0, seed: int = 0):
+        self.params = init_sac_params(
+            jax.random.PRNGKey(seed), obs_dim, action_dim
+        )
+        self.target = jax.tree.map(
+            jnp.copy, {"q1": self.params["q1"], "q2": self.params["q2"]}
+        )
+        self.gamma = gamma
+        self.tau = tau
+        self.action_scale = action_scale
+        # the original SAC's reward_scale hyperparameter: shrinks the
+        # Bellman-target magnitude into a range fresh critics can reach
+        self.reward_scale = reward_scale
+        self.target_entropy = (
+            -float(action_dim) if target_entropy is None else target_entropy
+        )
+        # separate learning rates (standard SAC practice): critics +
+        # temperature track moving Bellman targets and want ~3x the
+        # policy's rate
+        self.opt = optax.multi_transform(
+            {"actor": optax.adam(lr),
+             "critic": optax.adam(lr_critic if lr_critic else 3 * lr)},
+            param_labels={
+                "actor": "actor", "q1": "critic", "q2": "critic",
+                "log_alpha": "critic",
+            },
+        )
+        self.opt_state = self.opt.init(self.params)
+        self._key = jax.random.PRNGKey(seed + 1)
+        self._grad = jax.jit(self._grad_fn)
+        self._apply = jax.jit(self._apply_fn)
+
+    # -- losses --
+
+    def _loss(self, params, target, batch, key):
+        ka, kt = jax.random.split(key)
+        obs, act = batch["obs"], batch["actions"]
+        alpha = jnp.exp(params["log_alpha"])
+
+        # critic: y = r + gamma (1-d) [min Q_tgt(s', a') - alpha logp(a')]
+        a_next, logp_next = sample_action(
+            params["actor"], batch["next_obs"], kt, self.action_scale
+        )
+        q_next = jnp.minimum(
+            q_value(target["q1"], batch["next_obs"], a_next),
+            q_value(target["q2"], batch["next_obs"], a_next),
+        )
+        y = batch["rewards"] * self.reward_scale + self.gamma * (
+            1.0 - batch["dones"].astype(jnp.float32)
+        ) * jax.lax.stop_gradient(
+            q_next - alpha * logp_next
+        )
+        q1 = q_value(params["q1"], obs, act)
+        q2 = q_value(params["q2"], obs, act)
+        critic_loss = jnp.mean((q1 - y) ** 2) + jnp.mean((q2 - y) ** 2)
+
+        # actor: alpha logp - min Q, through the reparameterized sample;
+        # stop-grad the critics so the actor term cannot train them
+        a_pi, logp_pi = sample_action(
+            params["actor"], obs, ka, self.action_scale
+        )
+        q_pi = jnp.minimum(
+            q_value(jax.lax.stop_gradient(params["q1"]), obs, a_pi),
+            q_value(jax.lax.stop_gradient(params["q2"]), obs, a_pi),
+        )
+        actor_loss = jnp.mean(
+            jax.lax.stop_gradient(alpha) * logp_pi - q_pi
+        )
+
+        # temperature: alpha tracks the entropy target
+        alpha_loss = -jnp.mean(
+            params["log_alpha"]
+            * jax.lax.stop_gradient(logp_pi + self.target_entropy)
+        )
+
+        total = critic_loss + actor_loss + alpha_loss
+        return total, {
+            "critic_loss": critic_loss,
+            "actor_loss": actor_loss,
+            "alpha": alpha,
+            "entropy": -jnp.mean(logp_pi),
+        }
+
+    def _grad_fn(self, params, target, batch, key):
+        (_, metrics), grads = jax.value_and_grad(
+            self._loss, has_aux=True
+        )(params, target, batch, key)
+        return grads, metrics
+
+    def _apply_fn(self, params, target, opt_state, grads):
+        updates, opt_state = self.opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        target = jax.tree.map(
+            lambda t, p: (1.0 - self.tau) * t + self.tau * p,
+            target, {"q1": params["q1"], "q2": params["q2"]},
+        )
+        return params, target, opt_state
+
+    # -- public seam --
+
+    def next_key(self):
+        self._key, k = jax.random.split(self._key)
+        return k
+
+    def grad_fn(self, batch: dict, key) -> tuple:
+        return self._grad(self.params, self.target, batch, key)
+
+    def apply_grads(self, grads):
+        self.params, self.target, self.opt_state = self._apply(
+            self.params, self.target, self.opt_state, grads
+        )
+
+    def update(self, batch: dict, *, grad_hook=None) -> dict:
+        """One gradient step; grad_hook(grads, n_rows) -> grads is the
+        allreduce seam between gradient and apply."""
+        grads, metrics = self.grad_fn(batch, self.next_key())
+        if grad_hook is not None:
+            grads = grad_hook(grads, len(batch["obs"]))
+        self.apply_grads(grads)
+        return metrics
+
+    def act(self, obs: np.ndarray, key, deterministic: bool = False):
+        if deterministic:
+            mu, _ = actor_dist(self.params["actor"], obs)
+            return jnp.tanh(mu) * self.action_scale
+        a, _ = sample_action(
+            self.params["actor"], obs, key, self.action_scale
+        )
+        return a
+
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+
+# ---------------- sampling actor ----------------
+
+@ray_tpu.remote(num_cpus=1)
+class GaussianEnvRunner:
+    """Continuous-control sampler: squashed-Gaussian policy on host CPU,
+    obs/action connector pipelines applied around it."""
+
+    def __init__(self, env_creator_blob, action_scale: float,
+                 connectors_blob=None, seed: int = 0):
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", "cpu")
+        from ray_tpu.rl import connectors as _conn
+        from ray_tpu.rl import sac as _sac
+
+        env_creator = serialization.unpack_payload(env_creator_blob)
+        self.env = env_creator()
+        self.action_scale = action_scale
+        self._key = _jax.random.PRNGKey(seed)
+        self.rng = np.random.RandomState(seed)  # warmup exploration
+        self._sample = _jax.jit(
+            lambda p, o, k: _sac.sample_action(p, o, k, action_scale)
+        )
+        self.obs_pipe = _conn.pipeline_from_blob(connectors_blob)
+        self.act_pipe = _conn.ClipAction(-action_scale, action_scale)
+        self.returns = EpisodeReturns(1)
+        self._obs = self.obs_pipe(np.asarray(self.env.reset(), np.float32))
+
+    def set_weights(self, actor_params):
+        self.actor = actor_params
+
+    def connector_state(self) -> dict:
+        return self.obs_pipe.state_dict()
+
+    def sample(self, n_steps: int, random_until: int = 0,
+               total_steps: int = 0) -> dict:
+        import jax as _jax
+
+        obs_l, act_l, rew_l, done_l, next_l = [], [], [], [], []
+        obs = self._obs
+        for i in range(n_steps):
+            if total_steps + i < random_until:
+                a = self.rng.uniform(
+                    -self.action_scale, self.action_scale,
+                    size=(self.env.action_dim,),
+                ).astype(np.float32)
+            else:
+                self._key, k = _jax.random.split(self._key)
+                a = np.asarray(
+                    self._sample(self.actor, obs[None], k)[0][0],
+                    np.float32,
+                )
+            a = self.act_pipe(a)
+            nxt, r, done, info = self.env.step(a)
+            nxt = self.obs_pipe(np.asarray(nxt, np.float32))
+            self.returns.step(0, float(r), bool(done))
+            obs_l.append(obs)
+            act_l.append(a)
+            rew_l.append(float(r))
+            # bootstrap THROUGH time-limit truncations: only a true
+            # terminal zeroes the Bellman bootstrap (gymnasium's
+            # terminated/truncated distinction; rllib does the same)
+            done_l.append(bool(done) and not info.get("truncated", False))
+            next_l.append(nxt)
+            if done:
+                self.obs_pipe.reset()
+                obs = self.obs_pipe(
+                    np.asarray(self.env.reset(), np.float32)
+                )
+            else:
+                obs = nxt
+        self._obs = obs
+        return {
+            "obs": np.stack(obs_l),
+            "actions": np.stack(act_l),
+            "rewards": np.asarray(rew_l, np.float32),
+            "dones": np.asarray(done_l, np.bool_),
+            "next_obs": np.stack(next_l),
+            "episode_return_mean": self.returns.mean(),
+        }
+
+
+# ---------------- the algorithm ----------------
+
+@dataclass
+class SACConfig:
+    env_creator: Callable | None = None
+    obs_dim: int = 3
+    action_dim: int = 1
+    action_scale: float = 1.0
+    num_env_runners: int = 1
+    rollout_steps: int = 256
+    buffer_capacity: int = 100_000
+    learning_starts: int = 512
+    random_steps: int = 512          # uniform exploration warmup
+    train_batch_size: int = 128
+    grad_steps_per_iteration: int = 64
+    lr: float = 3e-4
+    gamma: float = 0.99
+    tau: float = 0.005
+    reward_scale: float = 1.0
+    target_entropy: float | None = None
+    # env_to_module connector pipeline factory (rllib/connectors analog);
+    # None = identity. e.g. lambda: Pipeline(ObsNormalizer())
+    connectors: Callable | None = None
+    seed: int = 0
+
+    def build(self) -> "SAC":
+        return SAC(self)
+
+
+class SAC:
+    def __init__(self, config: SACConfig):
+        assert config.env_creator is not None, "set SACConfig.env_creator"
+        self.config = config
+        self.learner = SACLearner(
+            config.obs_dim, config.action_dim,
+            action_scale=config.action_scale, lr=config.lr,
+            gamma=config.gamma, tau=config.tau,
+            target_entropy=config.target_entropy,
+            reward_scale=config.reward_scale, seed=config.seed,
+        )
+        self.buffer = ReplayBuffer(
+            config.buffer_capacity, config.obs_dim, seed=config.seed,
+            action_dim=config.action_dim,
+        )
+        from ray_tpu.rl import connectors as _conn
+
+        blob = serialization.pack_callable(config.env_creator)
+        conn_blob = _conn.pack_factory(config.connectors)
+        self.runners = [
+            GaussianEnvRunner.remote(
+                blob, config.action_scale, conn_blob,
+                seed=config.seed + i,
+            )
+            for i in range(config.num_env_runners)
+        ]
+        self.total_steps = 0
+        self.iteration = 0
+        self._sync_weights()
+
+    def _sync_weights(self):
+        actor = jax.device_get(self.learner.params["actor"])
+        ray_tpu.get(
+            [r.set_weights.remote(actor) for r in self.runners],
+            timeout=120,
+        )
+
+    def train(self) -> dict:
+        c = self.config
+        batches = ray_tpu.get(
+            [r.sample.remote(c.rollout_steps, c.random_steps,
+                             self.total_steps)
+             for r in self.runners],
+            timeout=600,
+        )
+        for b in batches:
+            self.buffer.add_batch(
+                b["obs"], b["actions"], b["rewards"], b["dones"],
+                b["next_obs"],
+            )
+            self.total_steps += len(b["rewards"])
+        metrics = {}
+        if len(self.buffer) >= c.learning_starts:
+            for _ in range(c.grad_steps_per_iteration):
+                mb = {k: jnp.asarray(v)
+                      for k, v in self.buffer.sample(
+                          c.train_batch_size).items()}
+                metrics = self.learner.update(mb)
+            metrics = {k: float(v) for k, v in metrics.items()}
+        self._sync_weights()
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "total_steps": self.total_steps,
+            "buffer_size": len(self.buffer),
+            "episode_return_mean": float(np.mean(
+                [b["episode_return_mean"] for b in batches]
+            )),
+            **metrics,
+        }
+
+    def stop(self):
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:  # noqa: BLE001
+                pass
