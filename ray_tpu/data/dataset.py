@@ -155,18 +155,23 @@ def _prefetched(refs: list, depth: int) -> Iterator[Any]:
 
 
 def _actor_pool_map(fn_blob, size: int, refs: list,
-                    timeout_s: float = 600.0) -> list:
+                    timeout_s: float = 600.0, meter=None) -> list:
     """Run one stage over all blocks on a pool of `size` map actors,
-    preserving order (reference ActorPoolMapOperator)."""
+    preserving order (reference ActorPoolMapOperator). With a
+    BudgetMeter, submission is byte-metered admission instead of an
+    all-upfront flood (per-operator budgets,
+    streaming_executor_state.py analog)."""
     import time as _time
 
     actors = [_MapActor.remote(fn_blob) for _ in builtins.range(size)]
     try:
         out: list = [None] * len(refs)
-        # round-robin assignment with bounded per-actor pipelining; the
-        # runtime's per-actor ordered queues keep each actor sequential
+        # round-robin assignment; the runtime's per-actor ordered queues
+        # keep each actor sequential
         for i, r in enumerate(refs):
             out[i] = actors[i % size].apply.remote(r)
+            if meter is not None:
+                meter.admit(out[i])
         # all results must exist BEFORE the pool tears down: killing an
         # actor with queued work would leave never-resolving refs in the
         # dataset cache. Progress-based deadline: stall, not total time.
@@ -181,6 +186,8 @@ def _actor_pool_map(fn_blob, size: int, refs: list,
                 raise TimeoutError(
                     f"actor-pool map stalled: {len(pending)} blocks made "
                     f"no progress in {timeout_s}s")
+        if meter is not None:
+            meter.drain()
         return out
     finally:
         for a in actors:
@@ -188,6 +195,30 @@ def _actor_pool_map(fn_blob, size: int, refs: list,
                 ray_tpu.kill(a)
             except Exception:  # noqa: BLE001
                 pass
+
+
+@ray_tpu.remote(num_cpus=0)
+def _count_rows(block) -> int:
+    """Remote row-count probe (limit pushdown): the count travels, the
+    block doesn't."""
+    from ray_tpu.data.block import block_rows
+
+    return len(block_rows(block))
+
+
+def _limit_refs(refs: list, n: int) -> list:
+    """First n rows from an ordered ref list, pulling only what's
+    needed."""
+    out, got = [], 0
+    for ref in refs:
+        if got >= n:
+            break
+        block = ray_tpu.get(ref, timeout=300)
+        rows = block_rows(block)
+        take = rows[: n - got]
+        got += len(take)
+        out.append(ray_tpu.put(build_like(block, take)))
+    return out
 
 
 class Dataset:
@@ -205,9 +236,12 @@ class Dataset:
                  _source_blobs: list | None = None):
         if _parent is not None:
             self._parent: "Dataset | None" = _parent
-            self._fn = _fn  # ("task", blob) | ("actors", blob, size)
+            # ("task", blob) | ("actors", blob, size) | ("limit", n)
+            # | ("exchange", kind, args)
+            self._fn = _fn
             self._cached: list | None = None
             self._source_blobs = None
+            self._budget = _parent._budget
         else:
             self._parent = None
             self._fn = None
@@ -217,6 +251,7 @@ class Dataset:
             self._source_blobs = _source_blobs
             self._cached = (None if _source_blobs is not None
                             else list(block_refs or []))
+            self._budget: int | None = None
         self._inflight = _inflight
 
     def _chain(self):
@@ -230,70 +265,55 @@ class Dataset:
         stages.reverse()
         return node, stages
 
-    @staticmethod
-    def _run_stages(root, stages, inflight) -> list:
-        """Execute (root -> stages) with bounded in-flight submission;
-        actor stages split the chain and run on their pools."""
-        # group consecutive task stages into fused segments; actor stages
-        # are fusion barriers with their own pools
-        fused: list = []
+    def _plan(self):
+        """Logical plan for the un-materialized suffix (data/logical.py):
+        Read leaf + one op per pending stage."""
+        from ray_tpu.data import logical as L
+
+        root, stages = self._chain()
+        if root._source_blobs is not None:
+            ops: list = [L.Read(list(root._source_blobs), lazy=True)]
+        else:
+            ops = [L.Read(list(root._cached or []), lazy=False)]
         for st in stages:
             if st[0] == "task":
-                if fused and isinstance(fused[-1], list):
-                    fused[-1].append(st[1])
-                else:
-                    fused.append([st[1]])
-            else:
-                fused.append(st)
+                ops.append(L.MapBatches(st[1]))
+            elif st[0] == "actors":
+                ops.append(L.MapBatches(st[1], actor_pool=st[2]))
+            elif st[0] == "limit":
+                ops.append(L.LimitRows(st[1]))
+            elif st[0] == "exchange":
+                ops.append(L.Exchange(st[1], st[2]))
+            else:  # pragma: no cover
+                raise ValueError(st)
+        return L.LogicalPlan(ops)
 
-        # stage 0: produce refs from the root (sources fuse into the
-        # first task segment)
-        first_task_blobs = (
-            fused.pop(0) if fused and isinstance(fused[0], list) else [])
-        refs: list = []
-        in_flight: list = []
-        if root._source_blobs is not None:
-            for src in root._source_blobs:
-                if len(in_flight) >= inflight:
-                    _, in_flight = ray_tpu.wait(
-                        in_flight, num_returns=1, timeout=300)
-                r = _source_and_map_fused.remote(src, first_task_blobs)
-                in_flight.append(r)
-                refs.append(r)
-        elif first_task_blobs:
-            for block_ref in root._cached:
-                if len(in_flight) >= inflight:
-                    _, in_flight = ray_tpu.wait(
-                        in_flight, num_returns=1, timeout=300)
-                r = _map_block_fused.remote(first_task_blobs, block_ref)
-                in_flight.append(r)
-                refs.append(r)
-        else:
-            refs = list(root._cached)
+    def explain(self) -> str:
+        """Optimized plan as text (reference Dataset.explain): shows
+        fusion, limit pushdown, and applied rules without executing."""
+        from ray_tpu.data import logical as L
 
-        # remaining segments
-        for seg in fused:
-            if isinstance(seg, list):  # fused task segment
-                nxt, in_flight = [], []
-                for r in refs:
-                    if len(in_flight) >= inflight:
-                        _, in_flight = ray_tpu.wait(
-                            in_flight, num_returns=1, timeout=300)
-                    o = _map_block_fused.remote(seg, r)
-                    in_flight.append(o)
-                    nxt.append(o)
-                refs = nxt
-            else:  # actor pool segment
-                _, blob, size = seg
-                refs = _actor_pool_map(blob, size, refs)
-        return refs
+        return L.optimize(self._plan()).explain()
+
+    def with_byte_budget(self, byte_budget: int) -> "Dataset":
+        """Set the dataset-level execution byte budget: EVERY stage —
+        fused maps, actor pools, shuffles — admits work through one
+        budget meter (reference streaming executor per-operator
+        budgets)."""
+        self._budget = byte_budget
+        return self
 
     @property
     def _blocks(self) -> list:
-        """Materialized block refs; fuses + executes pending stages once."""
+        """Materialized block refs; plans, optimizes, executes once."""
         if self._cached is None:
-            root, stages = self._chain()
-            self._cached = self._run_stages(root, stages, self._inflight)
+            from ray_tpu.data import logical as L
+
+            plan = L.optimize(self._plan())
+            self._cached = L.execute(
+                plan, byte_budget=self._budget,
+                max_in_flight=self._inflight,
+            )
         return self._cached
 
     def _root(self) -> "Dataset":
@@ -344,6 +364,123 @@ class Dataset:
         return Dataset(
             [], _parent=self, _fn=stage, _inflight=max_in_flight
         )
+
+    def flat_map(self, fn: Callable[[Any], Iterable[Any]],
+                 **kw) -> "Dataset":
+        """Row-wise fn returning 0..n output rows per input row
+        (reference dataset.py flat_map)."""
+        from ray_tpu._private import serialization
+
+        fn_blob = serialization.pack_callable(fn)
+
+        def _flat_block(block):
+            from ray_tpu._private import serialization as S
+            from ray_tpu.data.block import block_rows, build_like
+
+            f = S.unpack_payload(fn_blob)
+            out: list = []
+            for row in block_rows(block):
+                out.extend(f(row))
+            return build_like(block, out)
+
+        return self.map_batches(_flat_block, **kw)
+
+    def map(self, fn: Callable[[Any], Any], **kw) -> "Dataset":
+        """Row-wise map (reference dataset.py map)."""
+        from ray_tpu._private import serialization
+
+        fn_blob = serialization.pack_callable(fn)
+
+        def _map_block(block):
+            from ray_tpu._private import serialization as S
+            from ray_tpu.data.block import block_rows, build_like
+
+            f = S.unpack_payload(fn_blob)
+            return build_like(block, [f(r) for r in block_rows(block)])
+
+        return self.map_batches(_map_block, **kw)
+
+    def add_column(self, name: str, fn: Callable[[Any], Any],
+                   **kw) -> "Dataset":
+        """Add/overwrite a column on tabular (dict-row / DataFrame)
+        blocks (reference dataset.py add_column). fn(row) -> value."""
+        from ray_tpu._private import serialization
+
+        fn_blob = serialization.pack_callable(fn)
+
+        def _add(block):
+            from ray_tpu._private import serialization as S
+            from ray_tpu.data.block import block_rows, build_like
+
+            f = S.unpack_payload(fn_blob)
+            out = []
+            for row in block_rows(block):
+                row = dict(row)
+                row[name] = f(row)
+                out.append(row)
+            return build_like(block, out)
+
+        return self.map_batches(_add, **kw)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Row-align two datasets into (row_self, row_other) tuples
+        (reference dataset.py zip). Both sides materialize; row counts
+        must match."""
+        a = self.materialize()
+        b = other.materialize()
+        rows_a = [r for blk in a for r in block_rows(blk)]
+        rows_b = [r for blk in b for r in block_rows(blk)]
+        if len(rows_a) != len(rows_b):
+            raise ValueError(
+                f"zip row-count mismatch: {len(rows_a)} vs {len(rows_b)}")
+        pairs = list(builtins.zip(rows_a, rows_b))
+        k = builtins.max(1, len(a))
+        chunk = (len(pairs) + k - 1) // k
+        return Dataset([
+            ray_tpu.put(pairs[i:i + chunk])
+            for i in builtins.range(0, len(pairs), chunk)
+        ])
+
+    def schema(self):
+        """Column names/types from the first non-empty block (reference
+        dataset.py schema): dict rows -> {name: type}; arrays -> dtype;
+        plain rows -> type."""
+        for ref in self._blocks:
+            block = ray_tpu.get(ref, timeout=300)
+            rows = block_rows(block)
+            if not len(rows):
+                continue
+            if hasattr(block, "dtypes"):  # pandas
+                return {c: str(t) for c, t in block.dtypes.items()}
+            if isinstance(block, np.ndarray):
+                return {"value": str(block.dtype)}
+            row = rows[0]
+            if isinstance(row, dict):
+                return {k: type(v).__name__ for k, v in row.items()}
+            return {"value": type(row).__name__}
+        return {}
+
+    def stats(self) -> str:
+        """Human-readable execution stats (reference dataset.py stats):
+        the optimized plan plus per-block row/byte summaries."""
+        plan_line = self.explain()  # BEFORE materialization caches
+        refs = self._blocks
+        sizes = []
+        rows = []
+        from ray_tpu.data.logical import _ref_nbytes
+
+        for r in refs:
+            rows.append(len(block_rows(ray_tpu.get(r, timeout=300))))
+            sizes.append(_ref_nbytes(r))
+        lines = [
+            f"plan: {plan_line}",
+            f"blocks: {len(refs)}",
+            f"rows: total={sum(rows)} "
+            f"min={builtins.min(rows) if rows else 0} "
+            f"max={builtins.max(rows) if rows else 0}",
+            f"bytes: total={sum(sizes)}",
+        ]
+        return "\n".join(lines)
 
     def filter(self, pred: Callable[[Any], bool], **kw) -> "Dataset":
         from ray_tpu._private import serialization
@@ -402,10 +539,13 @@ class Dataset:
         import collections
 
         root, stages = self._chain()
-        if any(st[0] == "actors" for st in stages):
-            raise ValueError(
-                "streaming_iter_batches supports task stages only; "
-                "materialize actor-pool stages first")
+        if any(st[0] != "task" for st in stages):
+            # actor-pool / limit / shuffle stages: materialize through
+            # the planner first (their outputs are what streams), then
+            # stream the cached refs — matches the pre-lazy behavior
+            # where these ops were eager
+            self._blocks
+            root, stages = self._chain()
         blobs = [st[1] for st in stages]
         if root._source_blobs is not None:
             units = [("src", s) for s in root._source_blobs]
@@ -502,34 +642,31 @@ class Dataset:
         return Dataset(blocks)
 
     def limit(self, n: int) -> "Dataset":
-        """First n rows (pulls only the blocks it needs)."""
-        out, got = [], 0
-        for ref in self._blocks:
-            if got >= n:
-                break
-            block = ray_tpu.get(ref, timeout=300)
-            rows = block_rows(block)
-            take = rows[: n - got]
-            got += len(take)
-            out.append(ray_tpu.put(build_like(block, take)))
-        return Dataset(out)
+        """First n rows — LAZY: the optimizer pushes an early-stop hint
+        down to the Read so only the needed source units ever launch
+        (reference limit pushdown rule)."""
+        return Dataset([], _parent=self, _fn=("limit", n),
+                       _inflight=self._inflight)
 
     # -- shuffle family (data/shuffle.py: 2-phase map/reduce exchange) --
 
     def sort(self, key=None, *, descending: bool = False,
              num_blocks: int | None = None) -> "Dataset":
-        """Distributed sample-sort (push_based_shuffle.py analog)."""
-        from ray_tpu.data.shuffle import sort_blocks
-
+        """Distributed sample-sort (push_based_shuffle.py analog) — lazy
+        Exchange op; executes under the dataset's byte budget."""
         return Dataset(
-            sort_blocks(self._blocks, key, descending, num_blocks)
+            [], _parent=self,
+            _fn=("exchange", "sort", (key, descending, num_blocks)),
+            _inflight=self._inflight,
         )
 
     def random_shuffle(self, *, seed: int | None = None,
                        num_blocks: int | None = None) -> "Dataset":
-        from ray_tpu.data.shuffle import shuffle_blocks
-
-        return Dataset(shuffle_blocks(self._blocks, seed, num_blocks))
+        return Dataset(
+            [], _parent=self,
+            _fn=("exchange", "random_shuffle", (seed, num_blocks)),
+            _inflight=self._inflight,
+        )
 
     def groupby(self, key) -> "GroupedDataset":
         return GroupedDataset(self, key)
@@ -625,11 +762,12 @@ class GroupedDataset:
 
     def aggregate(self, agg: Callable[[Any, list], Any],
                   num_blocks: int | None = None) -> Dataset:
-        """agg(key_value, rows) -> one output row per group."""
-        from ray_tpu.data.shuffle import groupby_blocks
-
+        """agg(key_value, rows) -> one output row per group (lazy
+        Exchange op)."""
         return Dataset(
-            groupby_blocks(self._ds._blocks, self._key, agg, num_blocks)
+            [], _parent=self._ds,
+            _fn=("exchange", "groupby", (self._key, agg, num_blocks)),
+            _inflight=self._ds._inflight,
         )
 
     def count(self) -> Dataset:

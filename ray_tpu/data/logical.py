@@ -1,0 +1,337 @@
+"""Logical plan + rule-based optimizer + budgeted physical execution.
+
+Reference mapping:
+- logical ops / plan: data/_internal/logical/interfaces.py:1 (LogicalOp,
+  LogicalPlan) — here one linear op list per dataset lineage (the
+  Dataset DAG shares materialized ancestors instead of multi-child
+  plans).
+- rules: _internal/logical/rules/ (OperatorFusionRule, limit_pushdown) —
+  FuseMaps collapses consecutive task map stages into one fused task per
+  block; LimitPushdown annotates the Read with an early-stop hint so
+  execution stops launching source units once enough rows exist;
+  MergeLimits folds stacked limits.
+- planner/executor: _internal/planner/planner.py + streaming_executor
+  _state.py's per-operator resource budgets — execution here is
+  stage-sequential, but EVERY stage (fused map, actor pool, exchange)
+  admits work through one shared BudgetMeter, so a single dataset-level
+  byte budget paces the whole pipeline.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import ray_tpu
+
+DEFAULT_INFLIGHT = 4
+
+
+# ---------------- logical ops ----------------
+
+@dataclass
+class Read:
+    """Leaf: either materialized block refs or lazy source blobs."""
+
+    units: list
+    lazy: bool                   # True: units are zero-arg source blobs
+    limit_rows: int | None = None  # LimitPushdown early-stop hint
+
+    def label(self) -> str:
+        kind = "lazy" if self.lazy else "blocks"
+        hint = (f", limit_hint={self.limit_rows}"
+                if self.limit_rows is not None else "")
+        return f"Read[{len(self.units)} {kind}{hint}]"
+
+
+@dataclass
+class MapBatches:
+    fn_blob: bytes
+    actor_pool: int | None = None  # None: task stage
+
+    def label(self) -> str:
+        return (f"ActorPoolMap[{self.actor_pool}]"
+                if self.actor_pool else "MapBatches")
+
+
+@dataclass
+class FusedMap:
+    """Consecutive task map stages collapsed by FuseMaps."""
+
+    fn_blobs: list = field(default_factory=list)
+
+    def label(self) -> str:
+        return f"FusedMap[{len(self.fn_blobs)} fns]"
+
+
+@dataclass
+class LimitRows:
+    n: int
+
+    def label(self) -> str:
+        return f"Limit[{self.n}]"
+
+
+@dataclass
+class Exchange:
+    """All-to-all: sort / random_shuffle / groupby."""
+
+    kind: str
+    args: tuple
+
+    def label(self) -> str:
+        return f"Exchange[{self.kind}]"
+
+
+# ---------------- plan + rules ----------------
+
+@dataclass
+class LogicalPlan:
+    ops: list  # leaf (Read) first
+    applied_rules: list = field(default_factory=list)
+
+    def explain(self) -> str:
+        line = " -> ".join(op.label() for op in self.ops)
+        if self.applied_rules:
+            line += f"   (rules: {', '.join(self.applied_rules)})"
+        return line
+
+
+def _rule_merge_limits(ops, applied):
+    out = []
+    for op in ops:
+        if (isinstance(op, LimitRows) and out
+                and isinstance(out[-1], LimitRows)):
+            out[-1] = LimitRows(min(out[-1].n, op.n))
+            applied.append("MergeLimits")
+        else:
+            out.append(op)
+    return out
+
+
+def _rule_fuse_maps(ops, applied):
+    out = []
+    for op in ops:
+        if isinstance(op, MapBatches) and op.actor_pool is None:
+            if out and isinstance(out[-1], FusedMap):
+                out[-1].fn_blobs.append(op.fn_blob)
+                applied.append("FuseMaps")
+            else:
+                out.append(FusedMap([op.fn_blob]))
+        else:
+            out.append(op)
+    return out
+
+
+def _rule_limit_pushdown(ops, applied):
+    """Annotate the Read with the earliest limit separated from it only
+    by per-block map stages: execution can stop launching source units
+    once that many output rows exist. The LimitRows op itself stays (it
+    enforces the exact count; maps may change per-block row counts, the
+    hint is only an early-stop bound)."""
+    if not ops or not isinstance(ops[0], Read):
+        return ops
+    for op in ops[1:]:
+        if isinstance(op, FusedMap) or (
+                isinstance(op, MapBatches) and op.actor_pool is None):
+            # task maps run fused with the read, so the early-stop probe
+            # counts their OUTPUT rows — safe to skip past
+            continue
+        if isinstance(op, LimitRows):
+            if ops[0].limit_rows is None or op.n < ops[0].limit_rows:
+                ops[0].limit_rows = op.n
+                applied.append("LimitPushdown")
+        # Exchange and actor-pool stages are pushdown barriers: their
+        # output row counts are not what the read-side probe counts
+        break
+    return ops
+
+
+def optimize(plan: LogicalPlan) -> LogicalPlan:
+    applied: list = []
+    ops = list(plan.ops)
+    ops = _rule_merge_limits(ops, applied)
+    ops = _rule_fuse_maps(ops, applied)
+    ops = _rule_limit_pushdown(ops, applied)
+    return LogicalPlan(ops, applied)
+
+
+# ---------------- budgeted execution ----------------
+
+def _ref_nbytes(ref) -> int:
+    """Owner-side size of a READY block ref, without fetching the data:
+    plasma results carry their size in the push; inline results' payload
+    length is on the entry. 0 when unknown."""
+    from ray_tpu._private.api import _get_worker
+
+    try:
+        e = _get_worker().memory.get(ref.binary())
+        if e is None or not e.ready:
+            return 0
+        if e.size:
+            return int(e.size)
+        if e.payload is not None:
+            return len(e.payload[0]) + sum(len(b) for b in e.payload[1])
+    except Exception:  # noqa: BLE001
+        pass
+    return 0
+
+
+class BudgetMeter:
+    """Shared byte-metered admission (streaming_executor_state.py's
+    per-operator budgets, centralized): every stage asks admit() before
+    launching a unit of work; over-budget submission waits for in-flight
+    outputs to complete and counts their observed sizes.
+
+    With byte_budget=None only the in-flight window applies and drain()
+    is a no-op — unbudgeted pipelines keep the pre-planner behavior of
+    chaining stage N+1 tasks on stage N's pending refs."""
+
+    def __init__(self, byte_budget: int | None,
+                 max_in_flight: int = DEFAULT_INFLIGHT):
+        self.byte_budget = byte_budget
+        self.max_in_flight = max_in_flight
+        self.in_flight: list = []
+        self.avg = [0.0, 0]  # observed (total_bytes, n)
+
+    def _est(self) -> float:
+        if self.avg[1] == 0:
+            return 0.0
+        return self.avg[0] / self.avg[1]
+
+    def _over(self) -> bool:
+        if len(self.in_flight) >= self.max_in_flight:
+            return True
+        if self.byte_budget is None:
+            return False
+        return self._est() * (len(self.in_flight) + 1) > self.byte_budget
+
+    def observe(self, ref):
+        n = _ref_nbytes(ref)
+        if n:
+            self.avg[0] += n
+            self.avg[1] += 1
+
+    def admit(self, ref):
+        """Block until there is room, then count `ref` as in flight."""
+        while self.in_flight and self._over():
+            ready, rest = ray_tpu.wait(
+                self.in_flight, num_returns=1, timeout=300)
+            for r in ready:
+                self.observe(r)
+            self.in_flight = rest
+        self.in_flight.append(ref)
+
+    def drain(self):
+        if self.byte_budget is None:
+            self.in_flight = []  # no barrier: let downstream tasks chain
+            return
+        if self.in_flight:
+            ray_tpu.wait(self.in_flight,
+                         num_returns=len(self.in_flight), timeout=600)
+            for r in self.in_flight:
+                self.observe(r)
+            self.in_flight = []
+
+    def round_size(self, default: int, minimum: int = 2) -> int:
+        """How many blocks an exchange may keep live per merge round."""
+        if self.byte_budget is None or self._est() == 0:
+            return default
+        return max(minimum, min(default,
+                                int(self.byte_budget // self._est())))
+
+
+def execute(plan: LogicalPlan, *, byte_budget: int | None = None,
+            max_in_flight: int = DEFAULT_INFLIGHT) -> list:
+    """Run an optimized plan to materialized block refs. One BudgetMeter
+    paces every stage; intermediate refs drop as stages consume them so
+    distributed GC can reclaim them."""
+    from ray_tpu.data import dataset as D
+
+    meter = BudgetMeter(byte_budget, max_in_flight)
+    read = plan.ops[0]
+    assert isinstance(read, Read), plan.ops
+    ops = plan.ops[1:]
+
+    # the first fused-map segment runs fused WITH lazy sources
+    first_maps: list = []
+    if ops and isinstance(ops[0], FusedMap):
+        first_maps = ops[0].fn_blobs
+        ops = ops[1:]
+
+    refs: list = []
+    rows_seen = 0
+    count_refs: list = []
+    for unit in read.units:
+        if read.limit_rows is not None:
+            # the early-stop hint rides remote row counts; probes may
+            # lag submission by at most the in-flight window (pipelined
+            # submission would otherwise launch everything before the
+            # first count lands). LimitRows still enforces exactness.
+            while count_refs and (
+                    rows_seen < read.limit_rows
+                    and len(count_refs) >= meter.max_in_flight):
+                done, count_refs = ray_tpu.wait(
+                    count_refs, num_returns=1, timeout=120)
+                for c in done:
+                    rows_seen += ray_tpu.get(c, timeout=60)
+            done, count_refs = ray_tpu.wait(
+                count_refs, num_returns=len(count_refs), timeout=0,
+            ) if count_refs else ([], [])
+            for c in done:
+                rows_seen += ray_tpu.get(c, timeout=60)
+            if rows_seen >= read.limit_rows:
+                break
+        if read.lazy:
+            r = D._source_and_map_fused.remote(unit, first_maps)
+        elif first_maps:
+            r = D._map_block_fused.remote(first_maps, unit)
+        else:
+            r = unit
+        if read.lazy or first_maps:
+            meter.admit(r)
+        refs.append(r)
+        if read.limit_rows is not None:
+            count_refs.append(D._count_rows.remote(r))
+    meter.drain()
+
+    for op in ops:
+        if isinstance(op, FusedMap):
+            nxt = []
+            for r in refs:
+                o = D._map_block_fused.remote(op.fn_blobs, r)
+                meter.admit(o)
+                nxt.append(o)
+            refs = nxt
+            meter.drain()
+        elif isinstance(op, MapBatches) and op.actor_pool:
+            # unbudgeted pools keep the old flood-submit behavior; a
+            # budgeted pool's window must at least cover the pool or
+            # actors sit idle
+            if byte_budget is not None:
+                meter.max_in_flight = max(meter.max_in_flight,
+                                          2 * op.actor_pool)
+            refs = D._actor_pool_map(
+                op.fn_blob, op.actor_pool, refs,
+                meter=meter if byte_budget is not None else None)
+        elif isinstance(op, LimitRows):
+            refs = D._limit_refs(refs, op.n)
+        elif isinstance(op, Exchange):
+            from ray_tpu.data import shuffle as S
+
+            sm = meter if byte_budget is not None else None
+            if op.kind == "sort":
+                key, descending, nb = op.args
+                refs = S.sort_blocks(refs, key, descending, nb, meter=sm)
+            elif op.kind == "random_shuffle":
+                seed, nb = op.args
+                refs = S.shuffle_blocks(refs, seed, nb, meter=sm)
+            elif op.kind == "groupby":
+                key, agg, nb = op.args
+                refs = S.groupby_blocks(refs, key, agg, nb, meter=sm)
+            else:  # pragma: no cover
+                raise ValueError(op.kind)
+            meter.drain()
+        else:  # pragma: no cover
+            raise ValueError(f"unknown op {op!r}")
+    return refs

@@ -129,7 +129,8 @@ def _merge_parts(*parts):
     return build_like(parts[0], rows)
 
 
-def _exchange(blocks: list, mode: str, specs, num_parts: int) -> list[list]:
+def _exchange(blocks: list, mode: str, specs, num_parts: int,
+              meter=None) -> list[list]:
     """Run phase 1 over all blocks; returns per-partition ref lists.
 
     `specs` is either one spec for every block or a per-block list
@@ -163,12 +164,34 @@ def _exchange(blocks: list, mode: str, specs, num_parts: int) -> list[list]:
     # free them once the merges consume them.
     merged: list[list] = [[] for _ in range(num_parts)]
     prev_round: list = []
-    for lo in range(0, len(blocks), PUSH_MERGE_ROUND):
+    per_block_est = 0.0  # bytes of ONE input block, from merge outputs
+    lo = 0
+    prev_n = 0
+    while lo < len(blocks):
         if prev_round:
             ray_tpu.wait(prev_round, num_returns=len(prev_round),
                          timeout=600)
-        round_blocks = blocks[lo:lo + PUSH_MERGE_ROUND]
-        round_blobs = blobs[lo:lo + PUSH_MERGE_ROUND]
+            if meter is not None:
+                from ray_tpu.data.logical import _ref_nbytes
+
+                # a round's merge outputs together hold the round's
+                # input bytes: per-INPUT-block estimate = round bytes /
+                # blocks mapped that round (a raw merge-output size
+                # would undercount by ~num_parts)
+                round_bytes = sum(_ref_nbytes(r) for r in prev_round)
+                if round_bytes and prev_n:
+                    per_block_est = round_bytes / prev_n
+        # byte-budgeted round sizing (per-operator budgets): fewer live
+        # map outputs per round when blocks are large
+        round_n = PUSH_MERGE_ROUND
+        if meter is not None and meter.byte_budget and per_block_est:
+            round_n = max(2, min(
+                PUSH_MERGE_ROUND,
+                int(meter.byte_budget // per_block_est)))
+        round_blocks = blocks[lo:lo + round_n]
+        round_blobs = blobs[lo:lo + round_n]
+        prev_n = len(round_blocks)
+        lo += round_n
         part_refs = [
             _partition_block.options(num_returns=num_parts).remote(
                 b, mode, blob
@@ -185,7 +208,7 @@ def _exchange(blocks: list, mode: str, specs, num_parts: int) -> list[list]:
 
 
 def sort_blocks(blocks: list, key, descending: bool,
-                num_parts: int | None = None) -> list:
+                num_parts: int | None = None, meter=None) -> list:
     """Distributed sample-sort; returns sorted block refs."""
     if not blocks:
         return []
@@ -213,15 +236,19 @@ def sort_blocks(blocks: list, key, descending: bool,
         sample[(i + 1) * len(sample) // num_parts - 1]
         for i in range(num_parts - 1)
     ]
-    parts = _exchange(blocks, "range", (key_blob, bounds), num_parts)
-    out = [
-        _reduce_sorted.remote(key_blob, descending, *p) for p in parts
-    ]
+    parts = _exchange(blocks, "range", (key_blob, bounds), num_parts,
+                      meter=meter)
+    out = []
+    for p in parts:
+        r = _reduce_sorted.remote(key_blob, descending, *p)
+        if meter is not None:
+            meter.admit(r)
+        out.append(r)
     return out if not descending else list(reversed(out))
 
 
 def shuffle_blocks(blocks: list, seed: int | None,
-                   num_parts: int | None = None) -> list:
+                   num_parts: int | None = None, meter=None) -> list:
     if not blocks:
         return []
     num_parts = num_parts or len(blocks)
@@ -229,16 +256,19 @@ def shuffle_blocks(blocks: list, seed: int | None,
     parts = _exchange(
         blocks, "random",
         [(seed + 7919 * i, num_parts) for i in range(len(blocks))],
-        num_parts,
+        num_parts, meter=meter,
     )
-    return [
-        _reduce_concat.remote(seed + 1 + i, *p)
-        for i, p in enumerate(parts)
-    ]
+    out = []
+    for i, p in enumerate(parts):
+        r = _reduce_concat.remote(seed + 1 + i, *p)
+        if meter is not None:
+            meter.admit(r)
+        out.append(r)
+    return out
 
 
 def groupby_blocks(blocks: list, key, agg: Callable[[Any, list], Any],
-                   num_parts: int | None = None) -> list:
+                   num_parts: int | None = None, meter=None) -> list:
     """Hash-partition by key, then group+aggregate each partition.
 
     agg(key_value, rows) -> one output row per group.
@@ -249,5 +279,12 @@ def groupby_blocks(blocks: list, key, agg: Callable[[Any, list], Any],
     key_blob = serialization.pack_callable(key) if callable(key) else \
         serialization.pack_payload(key)
     agg_blob = serialization.pack_callable(agg)
-    parts = _exchange(blocks, "hash", (key_blob, num_parts), num_parts)
-    return [_reduce_groups.remote(key_blob, agg_blob, *p) for p in parts]
+    parts = _exchange(blocks, "hash", (key_blob, num_parts), num_parts,
+                      meter=meter)
+    out = []
+    for p in parts:
+        r = _reduce_groups.remote(key_blob, agg_blob, *p)
+        if meter is not None:
+            meter.admit(r)
+        out.append(r)
+    return out

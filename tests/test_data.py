@@ -100,3 +100,30 @@ def test_streaming_split_consumable_in_tasks(cluster):
 
     totals = ray_tpu.get([consume.remote(it) for it in its], timeout=120)
     assert sum(totals) == sum(range(30))
+
+
+def test_dataset_api_breadth_r4(cluster):
+    """flat_map / map / add_column / zip / schema / stats (reference
+    dataset.py surface, r4 additions)."""
+    from ray_tpu import data as rdata
+
+    ds = rdata.from_items([1, 2, 3, 4], parallelism=2)
+    assert sorted(
+        r for b in ds.flat_map(lambda x: [x, x * 10]).iter_batches()
+        for r in b
+    ) == [1, 2, 3, 4, 10, 20, 30, 40]
+    assert [r for b in ds.map(lambda x: x + 1).iter_batches()
+            for r in b] == [2, 3, 4, 5]
+
+    tab = rdata.from_items([{"a": 1}, {"a": 2}], parallelism=1)
+    rows = [r for b in tab.add_column("b", lambda r: r["a"] * 2)
+            .iter_batches() for r in b]
+    assert rows == [{"a": 1, "b": 2}, {"a": 2, "b": 4}]
+    assert tab.schema() == {"a": "int"}
+
+    z = ds.zip(ds.map(lambda x: -x))
+    assert [r for b in z.iter_batches() for r in b] == [
+        (1, -1), (2, -2), (3, -3), (4, -4)]
+
+    st = ds.map_batches(lambda b: b).stats()
+    assert "plan:" in st and "rows: total=4" in st, st
