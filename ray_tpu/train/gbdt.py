@@ -87,11 +87,22 @@ class GBDTTrainer:
                  params: dict | None = None, num_boost_round: int = 100,
                  rounds_per_report: int = 10,
                  early_stopping_rounds: int | None = None,
-                 mode: str = "regression"):
+                 mode: str = "regression", num_workers: int = 1,
+                 engine: str = "auto"):
+        """num_workers > 1: data-parallel boosting on the native
+        histogram engine (per-worker shard histograms allreduced per
+        tree level — the xgboost-ray scheme, train/hist_gbdt.py).
+        engine: "auto" (sklearn warm-start when num_workers == 1, hist
+        otherwise), "sklearn", or "hist"."""
         if "train" not in datasets:
             raise ValueError("datasets requires a 'train' entry")
         if mode not in ("regression", "classification"):
             raise ValueError(f"mode {mode!r}")
+        if engine == "auto":
+            engine = "sklearn" if num_workers == 1 else "hist"
+        if engine == "sklearn" and num_workers > 1:
+            raise ValueError("the sklearn engine is single-process; use "
+                             "engine='hist' with num_workers > 1")
         self.datasets = datasets
         self.label_column = label_column
         self.params = params or {}
@@ -99,6 +110,55 @@ class GBDTTrainer:
         self.rounds_per_report = rounds_per_report
         self.early_stopping_rounds = early_stopping_rounds
         self.mode = mode
+        self.num_workers = num_workers
+        self.engine = engine
+
+    def _fit_hist(self, X, y, Xv, yv):
+        """Round-chunked fit on the histogram engine with the same
+        report/early-stop semantics as the sklearn path."""
+        from ray_tpu.train import hist_gbdt as H
+
+        hp = H.HistParams(mode=self.mode, **self.params)
+        shards = [
+            (Xs, ys) for Xs, ys in zip(
+                np.array_split(X, self.num_workers),
+                np.array_split(y, self.num_workers),
+            )
+        ]
+        runner = H.DistributedFit(shards, hp) if self.num_workers > 1 \
+            else H.InProcessFit(shards, hp)
+        try:
+            trees: list = []
+            history = []
+            best_score, best_iter, stale = -np.inf, 0, 0
+            n = 0
+            while n < self.num_boost_round:
+                step = min(self.rounds_per_report,
+                           self.num_boost_round - n)
+                trees.extend(runner.boost(step))
+                n += step
+                model = H.HistModel(list(trees), 0.0, self.mode,
+                                    runner.edges)
+                entry = {"training_iteration": n,
+                         "train_score": model.score(X, y)}
+                if Xv is not None:
+                    vs = model.score(Xv, yv)
+                    entry["valid_score"] = vs
+                    if vs > best_score + 1e-12:
+                        best_score, best_iter, stale = vs, n, 0
+                    else:
+                        stale += step
+                        if (self.early_stopping_rounds is not None
+                                and stale >= self.early_stopping_rounds):
+                            history.append(entry)
+                            break
+                history.append(entry)
+        finally:
+            runner.close()
+        if Xv is not None and 0 < best_iter < len(trees):
+            trees = trees[:best_iter]
+        model = H.HistModel(trees, 0.0, self.mode, runner.edges)
+        return pickle.dumps(model), history, (best_iter or n)
 
     def fit(self):
         from ray_tpu.tune.tuner import Result
@@ -112,14 +172,17 @@ class GBDTTrainer:
             if vf != features:
                 raise ValueError(
                     f"valid features {vf} != train features {features}")
-        model_bytes, history, best_iter = ray_tpu.get(
-            _boost_task.remote(
-                self.mode, self.params, self.num_boost_round,
-                self.rounds_per_report, self.early_stopping_rounds,
-                X, y, Xv, yv,
-            ),
-            timeout=1800,
-        )
+        if self.engine == "hist":
+            model_bytes, history, best_iter = self._fit_hist(X, y, Xv, yv)
+        else:
+            model_bytes, history, best_iter = ray_tpu.get(
+                _boost_task.remote(
+                    self.mode, self.params, self.num_boost_round,
+                    self.rounds_per_report, self.early_stopping_rounds,
+                    X, y, Xv, yv,
+                ),
+                timeout=1800,
+            )
         ckpt_dir = tempfile.mkdtemp(prefix="ray_tpu_gbdt_")
         with open(os.path.join(ckpt_dir, "model.pkl"), "wb") as f:
             f.write(model_bytes)

@@ -1,0 +1,422 @@
+"""Histogram-based distributed GBDT engine.
+
+Reference capability: train/gbdt_trainer.py:105 delegating to xgboost-ray,
+whose data-parallel scheme is: workers hold row shards, compute per-node
+gradient/hessian HISTOGRAMS locally, allreduce the histograms, and every
+worker grows the identical tree from the merged histogram (rabit
+allreduce). This module implements that scheme natively:
+
+- quantile bin edges from deterministic per-shard samples (rank order),
+- level-wise tree growth; per level each shard bins its rows into
+  [node, feature, bin] x (grad, hess, count) histograms,
+- histograms merge via the framework `collective` allreduce (tree reduce
+  in rank order) — every worker derives the same splits locally, so the
+  only per-level traffic is the histogram itself,
+- single-process mode runs the SAME shard-then-merge code path in-process,
+  making a 1-worker and an N-worker run produce byte-identical models
+  over the same data + sharding.
+
+Squared-error regression and binary logloss classification (sigmoid
+margin), matching what the GBDTTrainer surface needs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+import ray_tpu
+
+EPS = 1e-12
+
+
+@dataclass
+class HistParams:
+    n_bins: int = 64
+    max_depth: int = 3
+    learning_rate: float = 0.1
+    reg_lambda: float = 1.0
+    min_child_hess: float = 1e-3
+    mode: str = "regression"  # or "classification"
+
+
+@dataclass
+class Tree:
+    """Flat arrays; node 0 is the root. leaf nodes have feature == -1."""
+
+    feature: list = field(default_factory=lambda: [-1])
+    threshold: list = field(default_factory=lambda: [0.0])
+    left: list = field(default_factory=lambda: [-1])
+    right: list = field(default_factory=lambda: [-1])
+    value: list = field(default_factory=lambda: [0.0])
+
+    def add_leaf(self, value: float) -> int:
+        self.feature.append(-1)
+        self.threshold.append(0.0)
+        self.left.append(-1)
+        self.right.append(-1)
+        self.value.append(value)
+        return len(self.feature) - 1
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        out = np.empty(len(X), np.float64)
+        feat = np.asarray(self.feature)
+        thr = np.asarray(self.threshold)
+        left = np.asarray(self.left)
+        right = np.asarray(self.right)
+        val = np.asarray(self.value)
+        for i, row in enumerate(X):
+            n = 0
+            while feat[n] >= 0:
+                n = left[n] if row[feat[n]] <= thr[n] else right[n]
+            out[i] = val[n]
+        return out
+
+
+def propose_bin_edges(sample_lists: list, n_bins: int) -> list:
+    """Global quantile proposals from per-shard samples, concatenated in
+    RANK ORDER (determinism is what buys single==distributed parity)."""
+    n_features = len(sample_lists[0])
+    edges = []
+    for f in range(n_features):
+        col = np.concatenate([np.asarray(s[f]) for s in sample_lists])
+        qs = np.quantile(col, np.linspace(0, 1, n_bins + 1)[1:-1])
+        edges.append(np.unique(qs))
+    return edges
+
+
+def bin_features(X: np.ndarray, edges: list) -> np.ndarray:
+    out = np.empty(X.shape, np.int32)
+    for f in range(X.shape[1]):
+        out[:, f] = np.searchsorted(edges[f], X[:, f], side="left")
+    return out
+
+
+def grad_hess(y: np.ndarray, margin: np.ndarray, mode: str):
+    if mode == "classification":
+        p = 1.0 / (1.0 + np.exp(-margin))
+        return p - y, np.maximum(p * (1.0 - p), EPS)
+    return margin - y, np.ones_like(y)  # squared error (factor 1/2)
+
+
+def node_histograms(binned, grad, hess, assign, node_ids, n_bins):
+    """[n_nodes, n_features, n_bins, 3] (grad, hess, count) over THIS
+    shard's rows."""
+    n_feat = binned.shape[1]
+    hist = np.zeros((len(node_ids), n_feat, n_bins, 3), np.float64)
+    for ni, node in enumerate(node_ids):
+        rows = np.nonzero(assign == node)[0]
+        if not len(rows):
+            continue
+        g, h = grad[rows], hess[rows]
+        for f in range(n_feat):
+            b = binned[rows, f]
+            hist[ni, f, :, 0] = np.bincount(b, weights=g,
+                                            minlength=n_bins)
+            hist[ni, f, :, 1] = np.bincount(b, weights=h,
+                                            minlength=n_bins)
+            hist[ni, f, :, 2] = np.bincount(b, minlength=n_bins)
+    return hist
+
+
+def best_splits(hist: np.ndarray, params: HistParams):
+    """From a MERGED histogram, the identical-everywhere split choice per
+    node: (feature, bin, gain) or None. xgboost's exact gain formula."""
+    lam = params.reg_lambda
+    out = []
+    for ni in range(hist.shape[0]):
+        g_tot = hist[ni, 0, :, 0].sum()
+        h_tot = hist[ni, 0, :, 1].sum()
+        parent = g_tot * g_tot / (h_tot + lam)
+        best = None  # (gain, feature, bin)
+        for f in range(hist.shape[1]):
+            gl = np.cumsum(hist[ni, f, :, 0])[:-1]
+            hl = np.cumsum(hist[ni, f, :, 1])[:-1]
+            gr = g_tot - gl
+            hr = h_tot - hl
+            ok = (hl > params.min_child_hess) & (hr > params.min_child_hess)
+            gain = np.where(
+                ok,
+                gl * gl / (hl + lam) + gr * gr / (hr + lam) - parent,
+                -np.inf,
+            )
+            b = int(np.argmax(gain))
+            if gain[b] > 0 and (best is None or gain[b] > best[0] + 0.0):
+                best = (float(gain[b]), f, b)
+        out.append(best)
+    return out
+
+
+def _merge(parts: list) -> np.ndarray:
+    """Rank-ordered merge — the SAME reduction collective.allreduce
+    applies (np stack + sum), so in-process and distributed agree
+    bit-for-bit."""
+    return np.stack(parts).sum(axis=0)
+
+
+class _ShardState:
+    """One shard's training state (rows never leave the shard)."""
+
+    def __init__(self, X, y, edges, mode):
+        self.X = np.asarray(X, np.float64)
+        self.y = np.asarray(y, np.float64)
+        self.binned = bin_features(self.X, edges)
+        self.margin = np.zeros(len(self.y), np.float64)
+        self.mode = mode
+        self.assign = None
+        self.grad = self.hess = None
+
+    def start_round(self):
+        self.grad, self.hess = grad_hess(self.y, self.margin, self.mode)
+        self.assign = np.zeros(len(self.y), np.int64)
+
+    def hists(self, node_ids, n_bins):
+        return node_histograms(self.binned, self.grad, self.hess,
+                               self.assign, node_ids, n_bins)
+
+    def apply_splits(self, node_ids, decisions, child_ids):
+        for node, dec, (lid, rid) in zip(node_ids, decisions, child_ids):
+            if dec is None:
+                continue
+            _, f, b = dec
+            rows = np.nonzero(self.assign == node)[0]
+            goes_left = self.binned[rows, f] <= b
+            self.assign[rows[goes_left]] = lid
+            self.assign[rows[~goes_left]] = rid
+
+    def apply_leaves(self, tree: Tree, lr: float):
+        # leaf ids in assign refer to tree node ids
+        vals = np.asarray(tree.value)
+        self.margin += lr * vals[self.assign]
+
+
+def grow_tree(states: list, params: HistParams, edges: list,
+              reduce_hists) -> Tree:
+    """One boosting round over the LOCAL shard states, in lockstep with
+    every peer: `reduce_hists(local_hist) -> merged [n,f,b,3]` hides the
+    reduction (in-process rank-ordered merge vs collective allreduce);
+    every participant reaches identical decisions because the merged
+    input is identical."""
+    tree = Tree()
+    lam = params.reg_lambda
+    for st in states:
+        st.start_round()
+    frontier = [0]
+    for _depth in range(params.max_depth):
+        if not frontier:
+            break
+        local = _merge([st.hists(frontier, params.n_bins)
+                        for st in states])
+        hist = reduce_hists(local)
+        decisions = best_splits(hist, params)
+        child_ids = []
+        next_frontier = []
+        for ni, (node, dec) in enumerate(zip(frontier, decisions)):
+            if dec is None:
+                child_ids.append((node, node))
+                continue
+            _, f, b = dec
+            lid = tree.add_leaf(0.0)
+            rid = tree.add_leaf(0.0)
+            tree.feature[node] = f
+            # threshold as the VALUE of the bin edge so predict() works
+            # on raw features
+            tree.threshold[node] = float(
+                edges[f][b] if b < len(edges[f]) else np.inf)
+            tree.left[node] = lid
+            tree.right[node] = rid
+            # leaf values from this level's histogram (overwritten if
+            # the child splits again)
+            gl = hist[ni, f, : b + 1, 0].sum()
+            hl = hist[ni, f, : b + 1, 1].sum()
+            gt = hist[ni, f, :, 0].sum()
+            ht = hist[ni, f, :, 1].sum()
+            tree.value[lid] = float(-gl / (hl + lam))
+            tree.value[rid] = float(-(gt - gl) / ((ht - hl) + lam))
+            child_ids.append((lid, rid))
+            next_frontier.extend([lid, rid])
+        for st in states:
+            st.apply_splits(frontier, decisions, child_ids)
+        frontier = next_frontier
+    for st in states:
+        st.apply_leaves(tree, params.learning_rate)
+    return tree
+
+
+@dataclass
+class HistModel:
+    trees: list
+    base: float
+    mode: str
+    edges: list
+    features: list | None = None
+
+    def raw_predict(self, X: np.ndarray) -> np.ndarray:
+        X = np.asarray(X, np.float64)
+        out = np.full(len(X), self.base, np.float64)
+        for t in self.trees:
+            out += t[0] * t[1].predict(X)
+        return out
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        m = self.raw_predict(X)
+        if self.mode == "classification":
+            return (m > 0).astype(np.int64)
+        return m
+
+    def predict_proba(self, X: np.ndarray) -> np.ndarray:
+        m = self.raw_predict(X)
+        return 1.0 / (1.0 + np.exp(-m))
+
+    def score(self, X, y) -> float:
+        y = np.asarray(y, np.float64)
+        if self.mode == "classification":
+            return float((self.predict(X) == y).mean())
+        pred = self.raw_predict(X)
+        denom = ((y - y.mean()) ** 2).sum()
+        return float(1.0 - ((y - pred) ** 2).sum() / (denom + EPS))
+
+
+def _sample_cols(X: np.ndarray, cap: int = 4096) -> list:
+    step = max(1, len(X) // cap)
+    sub = X[::step]
+    return [sub[:, f] for f in range(X.shape[1])]
+
+
+class InProcessFit:
+    """Single-process runner over the SAME shard-then-merge pipeline as
+    the distributed workers, so models agree bit-for-bit."""
+
+    def __init__(self, shards: list, params: HistParams):
+        samples = [_sample_cols(np.asarray(X, np.float64))
+                   for X, _ in shards]
+        self.edges = propose_bin_edges(samples, params.n_bins)
+        self.states = [_ShardState(X, y, self.edges, params.mode)
+                       for X, y in shards]
+        self.params = params
+
+    def boost(self, num_rounds: int) -> list:
+        return [
+            (self.params.learning_rate,
+             grow_tree(self.states, self.params, self.edges,
+                       reduce_hists=lambda h: h))
+            for _ in range(num_rounds)
+        ]
+
+    def close(self):
+        pass
+
+
+def fit_in_process(shards: list, params: HistParams,
+                   num_rounds: int) -> HistModel:
+    runner = InProcessFit(shards, params)
+    trees = runner.boost(num_rounds)
+    return HistModel(trees, 0.0, params.mode, runner.edges)
+
+
+# ---------------- distributed workers ----------------
+
+from ray_tpu.collective import CollectiveActorMixin
+
+
+@ray_tpu.remote(num_cpus=1)
+class GBDTShardWorker(CollectiveActorMixin):
+    """One data-parallel boosting worker: holds a row shard, computes
+    per-level histograms, allreduces them over the collective group, and
+    grows the identical tree locally (xgboost-ray/rabit scheme)."""
+
+    def __init__(self, X, y, mode: str):
+        self.X = np.asarray(X, np.float64)
+        self.y = np.asarray(y, np.float64)
+        self.mode = mode
+        self._group = None
+        self._world = 1
+
+    def join_group(self, world: int, rank: int, group: str):
+        self._group = group
+        self._world = world
+        self._rank = rank
+        return True
+
+    def sample_cols(self):
+        return _sample_cols(self.X)
+
+    def set_edges(self, edges):
+        self.state = _ShardState(self.X, self.y, edges, self.mode)
+        self.edges = edges
+        return True
+
+    def boost_round(self, params_dict: dict, num_rounds: int):
+        """Run `num_rounds` lockstep rounds; returns this worker's view
+        of the grown trees (identical on every rank)."""
+        from ray_tpu import collective
+
+        params = HistParams(**params_dict)
+
+        def reduce_hists(h):
+            if self._world > 1:
+                h = np.asarray(
+                    collective.allreduce(h, group_name=self._group))
+            return h
+
+        out = []
+        for _ in range(num_rounds):
+            tree = grow_tree([self.state], params, self.edges,
+                             reduce_hists)
+            out.append((params.learning_rate, tree))
+        return out
+
+
+class DistributedFit:
+    """Data-parallel runner: one worker actor per shard, histogram
+    allreduce per tree level; workers keep their margins between boost
+    calls so round-chunked training (reports/early stop) works."""
+
+    _seq = 0
+
+    def __init__(self, shards: list, params: HistParams):
+        from ray_tpu.collective import create_collective_group
+
+        self.params = params
+        self.workers = [GBDTShardWorker.remote(X, y, params.mode)
+                        for X, y in shards]
+        n = len(self.workers)
+        if n > 1:
+            DistributedFit._seq += 1
+            group = f"gbdt_hist_{DistributedFit._seq}"
+            create_collective_group(self.workers, n, list(range(n)),
+                                    group_name=group)
+            ray_tpu.get(
+                [w.join_group.remote(n, r, group)
+                 for r, w in enumerate(self.workers)], timeout=120)
+        samples = ray_tpu.get(
+            [w.sample_cols.remote() for w in self.workers], timeout=300)
+        self.edges = propose_bin_edges(samples, params.n_bins)
+        ray_tpu.get([w.set_edges.remote(self.edges)
+                     for w in self.workers], timeout=300)
+
+    def boost(self, num_rounds: int) -> list:
+        views = ray_tpu.get(
+            [w.boost_round.remote(self.params.__dict__, num_rounds)
+             for w in self.workers],
+            timeout=1800,
+        )
+        return views[0]
+
+    def close(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def fit_distributed(shards: list, params: HistParams,
+                    num_rounds: int) -> HistModel:
+    runner = DistributedFit(shards, params)
+    try:
+        trees = runner.boost(num_rounds)
+    finally:
+        runner.close()
+    return HistModel(trees, 0.0, params.mode, runner.edges)

@@ -1,0 +1,87 @@
+"""Distributed histogram GBDT (VERDICT r4 item 5).
+
+Reference capability: train/gbdt_trainer.py:105 via xgboost-ray's
+data-parallel boosting — per-worker shard histograms, allreduce, identical
+trees everywhere. The core bar: an N-worker distributed fit produces the
+IDENTICAL model to the single-process fit over the same data + sharding
+(the histogram merge is exact, unlike ensemble averaging)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.train.hist_gbdt import (
+    HistParams,
+    fit_distributed,
+    fit_in_process,
+)
+
+
+def _make_data(n=1200, d=5, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.uniform(-2, 2, size=(n, d))
+    y = np.sin(X[:, 0]) + 0.5 * X[:, 1] ** 2 + 0.1 * rng.normal(size=n)
+    return X, y
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(head_resources={"CPU": 8, "memory": 8 * 2**30})
+    c.connect()
+    yield c
+    c.shutdown()
+
+
+def test_in_process_engine_learns():
+    X, y = _make_data()
+    shards = list(zip(np.array_split(X, 4), np.array_split(y, 4)))
+    m = fit_in_process(shards, HistParams(max_depth=4), 50)
+    assert m.score(X, y) > 0.9
+
+
+def test_distributed_fit_matches_single_process_exactly(cluster):
+    """4 histogram workers allreducing per level == the in-process
+    shard-merge fit, tree for tree: predictions are bit-identical."""
+    X, y = _make_data()
+    shards = list(zip(np.array_split(X, 4), np.array_split(y, 4)))
+    params = HistParams(max_depth=3, learning_rate=0.2)
+    local = fit_in_process(shards, params, 20)
+    dist = fit_distributed(shards, params, 20)
+    Xq, _ = _make_data(seed=7)
+    np.testing.assert_array_equal(local.raw_predict(Xq),
+                                  dist.raw_predict(Xq))
+    # structures too, not just outputs
+    for (_, ta), (_, tb) in zip(local.trees, dist.trees):
+        assert ta.feature == tb.feature
+        assert ta.threshold == tb.threshold
+        assert ta.value == tb.value
+
+
+def test_trainer_hist_engine_end_to_end(cluster):
+    """GBDTTrainer(num_workers=4): fit over Dataset shards with a valid
+    set + early stopping; the predictor path is unchanged."""
+    from ray_tpu import data as rdata
+    from ray_tpu.train.gbdt import GBDTPredictor, GBDTTrainer
+
+    X, y = _make_data(n=800)
+    rows = [{"x0": r[0], "x1": r[1], "x2": r[2], "x3": r[3], "x4": r[4],
+             "y": t} for r, t in zip(X, y)]
+    train = rdata.from_items(rows[:600], parallelism=4)
+    valid = rdata.from_items(rows[600:], parallelism=2)
+
+    res = GBDTTrainer(
+        datasets={"train": train, "valid": valid},
+        label_column="y",
+        params={"max_depth": 3, "learning_rate": 0.15},
+        num_boost_round=40, rounds_per_report=10,
+        early_stopping_rounds=30,
+        num_workers=4,
+    ).fit()
+    assert res.metrics["train_score"] > 0.8, res.metrics
+    assert "valid_score" in res.metrics
+
+    pred = GBDTPredictor.from_checkpoint(res.checkpoint)
+    out = pred.predict(X[:50])
+    assert out.shape == (50,)
+    assert np.corrcoef(out, y[:50])[0, 1] > 0.8
