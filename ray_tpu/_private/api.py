@@ -835,7 +835,16 @@ def timeline(filename: str | None = None) -> list:
     chrome://tracing or Perfetto; pid = node, tid = worker."""
     events = list_tasks()
     trace = []
+    # task_id -> its complete event, for joining flow arrows
+    by_task = {ev["task_id"].hex(): ev for ev in events
+               if ev.get("state") != "PROFILE"}
     for ev in events:
+        args = {"state": ev.get("state"), "task_id": ev["task_id"].hex()}
+        tr = ev.get("trace") or {}
+        if tr:
+            args["trace_id"] = tr.get("trace_id")
+            if tr.get("parent"):
+                args["parent_span"] = tr["parent"]
         trace.append({
             "name": ev.get("name", "task"),
             # user spans (util/profiling.py profile()) land in their own
@@ -847,9 +856,24 @@ def timeline(filename: str | None = None) -> list:
             "dur": max(0.0, (ev["end_s"] - ev["start_s"]) * 1e6),
             "pid": ev["node_id"].hex()[:8],
             "tid": ev["worker_id"].hex()[:8],
-            "args": {"state": ev.get("state"),
-                     "task_id": ev["task_id"].hex()},
+            "args": args,
         })
+        # flow arrow parent -> child joins submit→execute→nested-submit
+        # into one connected trace (reference tracing_helper.py context
+        # propagation; Chrome "s"/"f" flow events on the shared id)
+        parent = by_task.get(tr.get("parent") or "")
+        if parent is not None:
+            flow_id = ev["task_id"].hex()[:16]
+            common = {"name": "submit", "cat": "trace",
+                      "id": flow_id}
+            trace.append({**common, "ph": "s",
+                          "ts": parent["start_s"] * 1e6,
+                          "pid": parent["node_id"].hex()[:8],
+                          "tid": parent["worker_id"].hex()[:8]})
+            trace.append({**common, "ph": "f", "bp": "e",
+                          "ts": ev["start_s"] * 1e6,
+                          "pid": ev["node_id"].hex()[:8],
+                          "tid": ev["worker_id"].hex()[:8]})
     if filename:
         import json
 

@@ -119,7 +119,16 @@ class PackageCache:
         self._idle_since: dict[str, float] = {}
 
     def _dir_for(self, uri: str) -> str:
-        return os.path.join(self.root, uri[len(PKG_SCHEME):])
+        # scheme-aware: "pkg://<h>" → <root>/<h> (legacy layout),
+        # plugin URIs ("pip://<h>") → <root>/<scheme>/<h>
+        scheme, _, rest = uri.partition("://")
+        if scheme == "pkg":
+            return os.path.join(self.root, rest)
+        return os.path.join(self.root, scheme, rest)
+
+    def dir_for(self, uri: str) -> str:
+        """Public: where this URI lives (plugins build into it)."""
+        return self._dir_for(uri)
 
     def dir_if_present(self, uri: str) -> str | None:
         dest = self._dir_for(uri)

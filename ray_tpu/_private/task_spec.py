@@ -1,0 +1,236 @@
+"""Typed task / actor specifications.
+
+The reference backs every task by a protobuf ``TaskSpecification``
+(/root/reference/src/ray/common/task/task_spec.h,
+/root/reference/src/ray/common/function_descriptor.h) so the three
+processes that touch a spec — owner worker, raylet, GCS — agree on one
+schema and malformed specs die at the boundary instead of drifting
+silently.  Our wire format is msgpack dicts, so the equivalent here is a
+``dict`` subclass with a declared field schema: construction
+(`TaskSpec.build`) and ingestion (`TaskSpec.from_wire`) both validate;
+everything downstream keeps plain ``spec["key"]`` access and msgpack
+serializes it as an ordinary map (zero wire change).
+
+Agent-local annotations (``_spills``, ``_granted``, ``_fetching`` …) are
+deliberately outside the schema: they are scratch state a node attaches
+while the task is in its custody, never contract between processes.
+Validation ignores ``_``-prefixed keys for that reason.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "InvalidTaskSpec",
+    "TaskSpec",
+    "ActorCreationSpec",
+    "ActorTaskSpec",
+]
+
+
+class InvalidTaskSpec(ValueError):
+    """A spec failed schema validation at a process boundary."""
+
+
+def _is_bytes(v):
+    return isinstance(v, (bytes, bytearray))
+
+
+def _is_str(v):
+    return isinstance(v, str)
+
+
+def _is_int(v):
+    return isinstance(v, int) and not isinstance(v, bool)
+
+
+def _is_bool(v):
+    return isinstance(v, bool)
+
+
+def _is_dict(v):
+    return isinstance(v, dict)
+
+
+def _is_list(v):
+    return isinstance(v, (list, tuple))
+
+
+def _is_resources(v):
+    return isinstance(v, dict) and all(
+        isinstance(k, str) and isinstance(x, (int, float))
+        and not isinstance(x, bool) and x >= 0
+        for k, x in v.items()
+    )
+
+
+def _is_num_returns(v):
+    return v == "dynamic" or (_is_int(v) and v >= 0)
+
+
+def _is_owner(v):
+    # owner address: {"worker_id": bytes, "addr": str, "port": int}
+    return (
+        isinstance(v, dict)
+        and _is_bytes(v.get("worker_id"))
+        and _is_str(v.get("addr"))
+        and _is_int(v.get("port"))
+    )
+
+
+def _is_dep_list(v):
+    return _is_list(v) and all(_is_bytes(x) for x in v)
+
+
+# field -> (required, predicate, human type name)
+_TASK_FIELDS = {
+    "task_id": (True, _is_bytes, "bytes"),
+    "job_id": (True, _is_bytes, "bytes"),
+    "func_id": (True, _is_bytes, "bytes"),
+    "name": (True, _is_str, "str"),
+    "args": (True, _is_dict, "dict"),
+    "inline_values": (True, _is_dict, "dict"),
+    "num_returns": (True, _is_num_returns, 'int>=0 or "dynamic"'),
+    "resources": (True, _is_resources, "{str: number>=0}"),
+    "owner": (True, _is_owner, "{worker_id, addr, port}"),
+    "deps": (True, _is_dep_list, "[bytes]"),
+    "retries_left": (True, _is_int, "int"),
+    "pg_id": (False, _is_bytes, "bytes"),
+    "bundle_index": (False, _is_int, "int"),
+    "bundle_nodes": (False, _is_list, "list"),
+    "scheduling_strategy": (False, lambda v: _is_dict(v) or _is_str(v),
+                            "dict|str"),
+    "runtime_env": (False, _is_dict, "dict"),
+    "trace": (False, _is_dict, "dict"),
+    # owner→leased-worker direct pushes mark this so the executor batches
+    # its done-reports to the agent instead of acking per task
+    "leased": (False, _is_bool, "bool"),
+}
+
+_ACTOR_FIELDS = {
+    "actor_id": (True, _is_bytes, "bytes"),
+    "job_id": (True, _is_bytes, "bytes"),
+    "name": (False, lambda v: v is None or _is_str(v), "str|None"),
+    "namespace": (True, _is_str, "str"),
+    "detached": (True, _is_bool, "bool"),
+    "max_restarts": (True, _is_int, "int"),
+    "resources": (True, _is_resources, "{str: number>=0}"),
+    "spec": (True, lambda v: v is not None, "payload"),
+    "owner_addr": (True, _is_owner, "{worker_id, addr, port}"),
+    "pg_id": (False, lambda v: v is None or _is_bytes(v), "bytes|None"),
+    "bundle_index": (False, _is_int, "int"),
+    "max_concurrency": (True, lambda v: _is_int(v) and v >= 1, "int>=1"),
+    "get_if_exists": (False, _is_bool, "bool"),
+    "runtime_env": (False, lambda v: v is None or _is_dict(v),
+                    "dict|None"),
+    "concurrency_groups": (False, _is_dict, "dict"),
+    "method_groups": (False, _is_dict, "dict"),
+    "trace": (False, _is_dict, "dict"),
+}
+
+_ACTOR_TASK_FIELDS = {
+    "task_id": (True, _is_bytes, "bytes"),
+    "actor_id": (True, _is_bytes, "bytes"),
+    "method": (True, _is_str, "str"),
+    "args": (True, _is_dict, "dict"),
+    "inline_values": (True, _is_dict, "dict"),
+    "num_returns": (True, _is_num_returns, 'int>=0 or "dynamic"'),
+    "owner": (True, _is_owner, "{worker_id, addr, port}"),
+    "deps": (False, _is_dep_list, "[bytes]"),
+    "concurrency_group": (False, lambda v: v is None or _is_str(v),
+                          "str|None"),
+    "seq": (True, _is_int, "int"),
+    "trace": (False, _is_dict, "dict"),
+}
+
+_ID_LENGTHS = {
+    # binary id byte lengths (ids.py _ID_SIZE): wrong-length ids are the
+    # classic silent-drift bug (truncated hex, doubled encode) — pin them.
+    "task_id": 16,
+    "job_id": 16,
+    "actor_id": 16,
+}
+
+
+def _validate(d: dict, schema: dict, kind: str) -> None:
+    if not isinstance(d, dict):
+        raise InvalidTaskSpec(f"{kind}: expected dict, got {type(d).__name__}")
+    for field, (required, pred, tname) in schema.items():
+        if field not in d:
+            if required:
+                raise InvalidTaskSpec(f"{kind}: missing field {field!r}")
+            continue
+        v = d[field]
+        if not pred(v):
+            raise InvalidTaskSpec(
+                f"{kind}: field {field!r} must be {tname}, "
+                f"got {type(v).__name__}={v!r:.80}"
+            )
+        want = _ID_LENGTHS.get(field)
+        if want is not None and _is_bytes(v) and len(v) != want:
+            raise InvalidTaskSpec(
+                f"{kind}: field {field!r} must be {want} bytes, "
+                f"got {len(v)}"
+            )
+    for field in d:
+        if field.startswith("_"):
+            continue  # node-local scratch, not contract
+        if field not in schema:
+            raise InvalidTaskSpec(f"{kind}: unknown field {field!r}")
+
+
+class _SpecBase(dict):
+    """dict subclass → msgpack packs it as a plain map; existing
+    ``spec["key"]`` consumers work unchanged."""
+
+    _SCHEMA: dict = {}
+    _KIND = "spec"
+
+    @classmethod
+    def build(cls, **fields):
+        """Owner-side construction: validate what we are about to ship."""
+        d = {k: v for k, v in fields.items() if v is not None}
+        _validate(d, cls._SCHEMA, cls._KIND)
+        return cls(d)
+
+    @classmethod
+    def from_wire(cls, payload):
+        """Boundary ingestion: validate what a peer sent us."""
+        _validate(payload, cls._SCHEMA, cls._KIND)
+        return cls(payload)
+
+    def validate(self):
+        _validate(self, self._SCHEMA, self._KIND)
+        return self
+
+
+class TaskSpec(_SpecBase):
+    """A normal (non-actor) task submission, owner → agent → worker."""
+
+    _SCHEMA = _TASK_FIELDS
+    _KIND = "TaskSpec"
+
+    @property
+    def task_id(self) -> bytes:
+        return self["task_id"]
+
+    @property
+    def owner(self):
+        return self["owner"]
+
+
+class ActorCreationSpec(_SpecBase):
+    """Actor registration, owner → head (GcsActorManager analog)."""
+
+    _SCHEMA = _ACTOR_FIELDS
+    _KIND = "ActorCreationSpec"
+
+    @property
+    def actor_id(self) -> bytes:
+        return self["actor_id"]
+
+
+class ActorTaskSpec(_SpecBase):
+    """A method call pushed owner → actor worker."""
+
+    _SCHEMA = _ACTOR_TASK_FIELDS
+    _KIND = "ActorTaskSpec"

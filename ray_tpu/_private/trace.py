@@ -1,0 +1,59 @@
+"""Cross-process trace-context propagation.
+
+Reference: python/ray/util/tracing/tracing_helper.py:33 — OpenTelemetry
+contexts are injected into task metadata at submit and extracted around
+execution, so submit→execute→nested-submit joins into one trace. Scaled
+equivalent: a {trace_id, parent} dict rides the typed TaskSpec's
+`trace` field; the executor sets a contextvar for the task's duration;
+nested submissions and user profile spans read it. No OpenTelemetry
+dependency — the head's task-event ring is the trace store and
+`timeline()` renders the joins as Chrome flow events.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+
+# (trace_id: str, span: str) — span is the hex task id currently
+# executing on this (thread/async task) context
+_ctx: contextvars.ContextVar = contextvars.ContextVar(
+    "ray_tpu_trace", default=None)
+
+
+def current() -> tuple[str, str] | None:
+    return _ctx.get()
+
+
+def set_current(trace_id: str, span: str):
+    """Enter a task's trace scope; returns a token for reset()."""
+    return _ctx.set((trace_id, span))
+
+
+def reset(token) -> None:
+    _ctx.reset(token)
+
+
+def new_trace_id() -> str:
+    return os.urandom(8).hex()
+
+
+def for_submit() -> dict:
+    """Trace field for an outgoing task/actor-call spec: continue the
+    current trace if inside one, else root a new trace (driver-side
+    top-level submit)."""
+    cur = current()
+    if cur is None:
+        return {"trace_id": new_trace_id()}
+    trace_id, span = cur
+    return {"trace_id": trace_id, "parent": span}
+
+
+def enter_spec(spec: dict):
+    """Executor-side: enter the spec's trace scope (span = own task id).
+    Returns the reset token (None when the spec carries no trace)."""
+    tr = spec.get("trace")
+    if not tr:
+        return None
+    return set_current(tr.get("trace_id") or new_trace_id(),
+                       spec["task_id"].hex())

@@ -30,7 +30,8 @@ import time
 import traceback
 from typing import Any
 
-from ray_tpu._private import rpc, serialization
+from ray_tpu._private import rpc, serialization, task_spec
+from ray_tpu._private import trace as _trace
 from ray_tpu._private.ids import (
     ActorID,
     JobID,
@@ -905,27 +906,29 @@ class CoreWorker:
             self.task_counter.next(),
         ).binary()
         args_spec, deps, inline_values = self._pack_args(args, kwargs)
-        spec = {
-            "task_id": task_id,
-            "job_id": self.job_id,
-            "func_id": func_id,
-            "name": name or getattr(func, "__name__", "task"),
-            "args": args_spec,
-            "inline_values": inline_values,
-            "num_returns": num_returns,
-            "resources": resources or {"CPU": 1.0},
-            "owner": self.owner_address,
-            "deps": deps,
-            "retries_left": retries,
-        }
-        if pg_id is not None:
-            spec["pg_id"] = pg_id
-            spec["bundle_index"] = bundle_index
-            spec["bundle_nodes"] = bundle_nodes or []
-        if scheduling_strategy is not None:
-            spec["scheduling_strategy"] = scheduling_strategy
-        if runtime_env:
-            spec["runtime_env"] = self._prepare_runtime_env(runtime_env)
+        # typed construction: schema-validated at build (reference backs
+        # this with a protobuf TaskSpecification, task_spec.h — here the
+        # schema lives in task_spec.py and both ends validate)
+        spec = task_spec.TaskSpec.build(
+            task_id=task_id,
+            job_id=self.job_id,
+            func_id=func_id,
+            name=name or getattr(func, "__name__", "task"),
+            args=args_spec,
+            inline_values=inline_values,
+            num_returns=num_returns,
+            resources=resources or {"CPU": 1.0},
+            owner=self.owner_address,
+            deps=deps,
+            retries_left=retries,
+            pg_id=pg_id,
+            bundle_index=bundle_index if pg_id is not None else None,
+            bundle_nodes=(bundle_nodes or []) if pg_id is not None else None,
+            scheduling_strategy=scheduling_strategy,
+            runtime_env=(self._prepare_runtime_env(runtime_env)
+                         if runtime_env else None),
+            trace=_trace.for_submit(),
+        )
         n_ret = 1 if num_returns == "dynamic" else num_returns
         return_ids = [
             ObjectID.for_task_return(TaskID(task_id), i).binary()
@@ -1544,20 +1547,23 @@ class CoreWorker:
                        concurrency_groups: dict | None = None,
                        method_groups: dict | None = None) -> dict:
         spec = serialization.pack_payload((cls, args, kwargs))
-        reply = self.head.call("register_actor", {
-            "actor_id": actor_id, "job_id": self.job_id,
-            "name": name, "namespace": namespace, "detached": detached,
-            "max_restarts": max_restarts,
-            "resources": resources or {"CPU": 1.0},
-            "spec": spec, "owner_addr": self.owner_address,
-            "pg_id": pg_id, "bundle_index": bundle_index,
-            "max_concurrency": max_concurrency,
-            "get_if_exists": get_if_exists,
-            "runtime_env": (self._prepare_runtime_env(runtime_env)
-                            if runtime_env else None),
-            "concurrency_groups": concurrency_groups or {},
-            "method_groups": method_groups or {},
-        })
+        reply = self.head.call(
+            "register_actor",
+            task_spec.ActorCreationSpec.build(
+                actor_id=actor_id, job_id=self.job_id,
+                name=name, namespace=namespace, detached=detached,
+                max_restarts=max_restarts,
+                resources=resources or {"CPU": 1.0},
+                spec=spec, owner_addr=self.owner_address,
+                pg_id=pg_id, bundle_index=bundle_index,
+                max_concurrency=max_concurrency,
+                get_if_exists=get_if_exists,
+                runtime_env=(self._prepare_runtime_env(runtime_env)
+                             if runtime_env else None),
+                concurrency_groups=concurrency_groups or {},
+                method_groups=method_groups or {},
+            ),
+        )
         return reply
 
     def _actor_client(self, actor_id: bytes,
@@ -1601,18 +1607,19 @@ class CoreWorker:
         seq = self._actor_seq.setdefault(actor_id, _Counter()).next()
         task_id = TaskID.for_actor_task(ActorID(actor_id), seq).binary()
         args_spec, deps, inline_values = self._pack_args(args, kwargs)
-        call = {
-            "task_id": task_id,
-            "actor_id": actor_id,
-            "method": method_name,
-            "args": args_spec,
-            "inline_values": inline_values,
-            "deps": deps,
-            "num_returns": num_returns,
-            "owner": self.owner_address,
-            "seq": seq,
-            "concurrency_group": concurrency_group,
-        }
+        call = task_spec.ActorTaskSpec.build(
+            task_id=task_id,
+            actor_id=actor_id,
+            method=method_name,
+            args=args_spec,
+            inline_values=inline_values,
+            deps=deps,
+            num_returns=num_returns,
+            owner=self.owner_address,
+            seq=seq,
+            concurrency_group=concurrency_group,
+            trace=_trace.for_submit(),
+        )
         return_ids = [
             ObjectID.for_task_return(TaskID(task_id), i).binary()
             for i in range(num_returns)

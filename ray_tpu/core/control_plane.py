@@ -34,7 +34,7 @@ import logging
 import time
 from typing import Any
 
-from ray_tpu._private import rpc
+from ray_tpu._private import rpc, task_spec
 from ray_tpu._private.rpc import RpcServer, ServerConn
 
 logger = logging.getLogger(__name__)
@@ -558,6 +558,10 @@ class ControlPlane:
     async def rpc_register_actor(self, conn, p):
         """Register + schedule an actor. Returns when placement is decided
         (worker spawn happens async on the node agent)."""
+        try:
+            p = task_spec.ActorCreationSpec.from_wire(p)
+        except task_spec.InvalidTaskSpec as e:
+            raise rpc.RpcError(f"rejected actor spec: {e}") from None
         aid = p["actor_id"]
         if aid in self.actors:
             # duplicate submission (e.g. a reconnect retry after the head

@@ -35,7 +35,7 @@ from collections import deque
 from typing import Any
 
 from ray_tpu._private import config as cfg
-from ray_tpu._private import rpc
+from ray_tpu._private import rpc, task_spec
 from ray_tpu._private.rpc import AsyncRpcClient, RpcServer
 from ray_tpu.core.object_store import ObjectStoreClient
 
@@ -376,6 +376,20 @@ class NodeAgent:
         # runtime-env-keyed pools).
         cwd = None
         pkg_uris: list[str] = []
+        try:
+            return await self._spawn_with_env(
+                worker_id, env, cwd, pkg_uris, runtime_env, job_id,
+                holds_tpu)
+        except BaseException:
+            # a failed spawn (missing package blob, plugin create error,
+            # exec failure) must release the URI refcounts already
+            # acquired, or the cache dirs are pinned forever
+            for uri in pkg_uris:
+                self.pkg_cache.release(uri)
+            raise
+
+    async def _spawn_with_env(self, worker_id, env, cwd, pkg_uris,
+                              runtime_env, job_id, holds_tpu):
         if runtime_env:
             from ray_tpu._private.runtime_env import PKG_NS, PKG_SCHEME
 
@@ -416,10 +430,21 @@ class NodeAgent:
                 env["PYTHONPATH"] = os.pathsep.join(
                     [*mods, prev] if prev else mods
                 )
+        py_exe = sys.executable
+        if runtime_env:
+            # plugin keys (pip envs, custom plugins): materialize into
+            # the same refcounted cache, let them swap the interpreter
+            from ray_tpu._private import runtime_env_plugins as rep
+
+            ctx = rep.RuntimeEnvContext(env=env, py_executable=py_exe,
+                                        cwd=cwd)
+            pkg_uris.extend(
+                await rep.apply_plugins(runtime_env, ctx, self.pkg_cache))
+            py_exe, cwd = ctx.py_executable, ctx.cwd
         if job_id:
             env["RAY_TPU_JOB_ID"] = job_id.hex()
         proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.worker_proc"],
+            [py_exe, "-m", "ray_tpu.core.worker_proc"],
             env=env, cwd=cwd,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         )
@@ -870,7 +895,12 @@ class NodeAgent:
 
     async def rpc_submit_task(self, conn, p):
         """Entry from a local worker/driver or a spilling peer agent."""
-        spec = p
+        # boundary validation (typed TaskSpec; `_`-prefixed node-local
+        # annotations from a forwarding peer pass through unchecked)
+        try:
+            spec = task_spec.TaskSpec.from_wire(p)
+        except task_spec.InvalidTaskSpec as e:
+            raise rpc.RpcError(f"rejected task spec: {e}") from None
         spec.setdefault("_spills", 0)
         target = await self._locality_target(spec) or self._choose_node(spec)
         if target is not None and target != self.node_id \
@@ -1249,9 +1279,14 @@ class NodeAgent:
             self.task_queue.append(spec)
             self._kick_dispatch()
             return
-        except (asyncio.TimeoutError, OSError) as e:
+        except Exception as e:  # noqa: BLE001 — any spawn failure
+            # (register timeout, exec OSError, runtime_env plugin create
+            # error, bad pip config …) must free the granted resources
+            # and fail the task — an escape here leaks the CPUs forever
+            # and hangs the owner's get()
             self._free_task_resources(spec)
-            await self._notify_task_failed(spec, f"worker spawn failed: {e}")
+            await self._notify_task_failed(spec,
+                                           f"worker spawn failed: {e!r}")
             return
         finally:
             self._pop_waiters -= 1

@@ -16,7 +16,8 @@ import threading
 import time
 import traceback
 
-from ray_tpu._private import rpc, serialization
+from ray_tpu._private import rpc, serialization, task_spec
+from ray_tpu._private import trace as _trace
 from ray_tpu._private.ids import ObjectID, TaskID
 from ray_tpu._private.worker import (
     INLINE_MAX,
@@ -26,6 +27,36 @@ from ray_tpu._private.worker import (
 )
 
 logger = logging.getLogger(__name__)
+
+
+def _poison_spec(spec, err) -> dict | None:
+    """Reduce a schema-rejected spec to a minimal, SANE dict the error
+    path can route (the raw spec's fields may be the very thing that is
+    malformed — a str task_id or \"host:port\" owner would crash the
+    recovery path it feeds). None = not routable, caller drops it."""
+    from ray_tpu._private.task_spec import _is_owner
+
+    if not isinstance(spec, dict):
+        return None
+    tid = spec.get("task_id")
+    if not (isinstance(tid, (bytes, bytearray)) and len(tid) == 16):
+        return None
+    if not _is_owner(spec.get("owner")):
+        return None
+    nr = spec.get("num_returns")
+    if not (isinstance(nr, int) and not isinstance(nr, bool) and nr >= 0):
+        nr = 1
+    out = {"task_id": bytes(tid), "owner": spec["owner"],
+           "num_returns": nr, "_invalid": str(err)}
+    name = spec.get("name") or spec.get("method")
+    if isinstance(name, str):
+        out["name"] = name[:120]
+    jid = spec.get("job_id")
+    if isinstance(jid, (bytes, bytearray)):
+        out["job_id"] = bytes(jid)
+    if spec.get("leased") is True:
+        out["leased"] = True
+    return out
 
 
 class Executor(CoreWorker):
@@ -94,6 +125,19 @@ class Executor(CoreWorker):
     # ---------- RPC endpoints (called by agent / owners) ----------
 
     async def rpc_execute_task(self, conn, spec):
+        # Executing-process boundary: same schema the owner built against.
+        # This handler is reached via fire/oneway (no reply path), so a
+        # raise here would be silently logged and the task lost with the
+        # worker marked busy — instead poison the spec and let the normal
+        # execution error path push a RayTaskError to the owner and
+        # report done to the agent.
+        try:
+            spec = task_spec.TaskSpec.from_wire(spec)
+        except task_spec.InvalidTaskSpec as e:
+            spec = _poison_spec(spec, e)
+            if spec is None:
+                logger.error("unroutable malformed task spec: %s", e)
+                return False
         self._exec_queue.put(("task", spec, None))
         return True
 
@@ -113,6 +157,16 @@ class Executor(CoreWorker):
 
     async def rpc_actor_call(self, conn, call):
         import inspect
+
+        try:
+            call = task_spec.ActorTaskSpec.from_wire(call)
+        except task_spec.InvalidTaskSpec as e:
+            # same poisoning as rpc_execute_task: this is a fire target,
+            # so raising would strand the caller's return refs forever
+            call = _poison_spec(call, e)
+            if call is None:
+                logger.error("unroutable malformed actor call: %s", e)
+                return False
 
         group = call.get("concurrency_group") or self._method_groups.get(
             call.get("method", "")
@@ -375,11 +429,26 @@ class Executor(CoreWorker):
             "start_s": start,
             "end_s": end,
         }
+        if spec.get("trace"):
+            ev["trace"] = spec["trace"]
         now = time.monotonic()
         flush = None
+        # pool tasks only: actor calls run via group pools / the async
+        # loop where _exec_queue is ALWAYS empty — inline flushing there
+        # would turn the hottest path into one head RPC per call. Actor
+        # teardown is covered by the SIGTERM drain instead.
+        terminal_idle = (state in ("FINISHED", "FAILED")
+                         and self._actor is None
+                         and self._exec_queue.empty())
         with self._event_buf_lock:
             self._event_buf.append(ev)
-            if (len(self._event_buf) >= self._EVENT_FLUSH_N
+            # Terminal events on an idle worker flush NOW: the result push
+            # that follows unblocks the owner's get(), and a fast driver
+            # exit then tears this worker down — a freshly spawned worker
+            # finishing its first task is younger than the 50ms window,
+            # so age-based batching alone loses the event in that race.
+            if (terminal_idle
+                    or len(self._event_buf) >= self._EVENT_FLUSH_N
                     or now - self._event_buf_t0 >= self._EVENT_FLUSH_S):
                 flush = self._event_buf
                 self._event_buf = []
@@ -410,7 +479,13 @@ class Executor(CoreWorker):
     def _execute_task(self, spec):
         owner = spec["owner"]
         t_start = time.time()
+        _tok = _trace.enter_spec(spec)
         try:
+            if spec.get("_invalid"):
+                raise RayTaskError(
+                    f"malformed task spec rejected at executor: "
+                    f"{spec['_invalid']}"
+                )
             fn = self.load_function(spec["func_id"])
             args, kwargs = self._resolve_args(spec)
             results = fn(*args, **kwargs)
@@ -467,6 +542,8 @@ class Executor(CoreWorker):
                                     {"task_id": spec["task_id"]})
             except (rpc.ConnectionLost, rpc.RpcError):
                 pass
+            if _tok is not None:
+                _trace.reset(_tok)
 
     def _create_actor(self, p):
         import asyncio
@@ -514,6 +591,10 @@ class Executor(CoreWorker):
         async def run():
             t_start = time.time()
             loop = asyncio.get_running_loop()
+            # contextvars: each asyncio task has its own context, so the
+            # trace scope set here is visible to nested submissions made
+            # by this call without leaking to concurrent calls
+            _tok = _trace.enter_spec(call)
             async with sem:
                 try:
                     method = getattr(self._actor, call["method"])
@@ -545,13 +626,22 @@ class Executor(CoreWorker):
                     self._emit_task_event(call, "FAILED", t_start,
                                           time.time(),
                                           name=call.get("method"))
+                finally:
+                    if _tok is not None:
+                        _trace.reset(_tok)
 
         asyncio.run_coroutine_threadsafe(run(), self._actor_loop)
 
     def _execute_actor_call(self, call):
         owner = call["owner"]
         t_start = time.time()
+        _tok = _trace.enter_spec(call)
         try:
+            if call.get("_invalid"):
+                raise RayTaskError(
+                    f"malformed actor call rejected at executor: "
+                    f"{call['_invalid']}"
+                )
             method = getattr(self._actor, call["method"])
             args, kwargs = self._resolve_args(call)
             results = method(*args, **kwargs)
@@ -563,7 +653,8 @@ class Executor(CoreWorker):
                                   name=call.get("method"))
         except BaseException as e:  # noqa: BLE001
             tb = traceback.format_exc()
-            logger.warning("actor call %s failed: %s", call["method"], tb)
+            logger.warning("actor call %s failed: %s",
+                           call.get("method"), tb)
             err = serialization.pack_payload(
                 e if _picklable(e) else
                 RayTaskError(f"{type(e).__name__}: {e}\n{tb}")
@@ -571,6 +662,9 @@ class Executor(CoreWorker):
             self._push_results(call, owner, None, error=err)
             self._emit_task_event(call, "FAILED", t_start, time.time(),
                                   name=call.get("method"))
+        finally:
+            if _tok is not None:
+                _trace.reset(_tok)
 
     async def rpc_push_result(self, conn, p):
         # clear owner-side actor pending on completion
@@ -612,6 +706,33 @@ def main():
     from ray_tpu._private import api
 
     api._set_global_worker(worker)
+    # Graceful SIGTERM: the agent's kill path sends TERM first with a
+    # grace window — drain buffered task events/results before dying so
+    # lifecycle state reaches the head even when the driver exits right
+    # after get() returns.
+    import signal as _signal
+
+    def _drain_and_exit(_sig, _frm):
+        # The drain can block (result pushes open peer connections) —
+        # run it on a bounded side thread and exit REGARDLESS: a worker
+        # that outlives its SIGTERM keeps answering actor calls from a
+        # node the cluster already declared dead.
+        def _drain():
+            try:
+                worker._flush_task_events()
+                worker._flush_results()
+            except Exception:  # noqa: BLE001 — dying anyway
+                pass
+
+        t = threading.Thread(target=_drain, daemon=True)
+        t.start()
+        t.join(0.5)  # also covers the io loop's socket write
+        # 143 = 128+SIGTERM: an involuntary kill (OOM policy, node drain)
+        # must stay nonzero or the agent skips its durable
+        # report_worker_failure record (_on_worker_death code==0 skip)
+        os._exit(143)
+
+    _signal.signal(_signal.SIGTERM, _drain_and_exit)
     # Fate-share with the node agent: a worker whose agent is gone can
     # never be dispatched to again — exit instead of leaking (reference
     # workers die when their raylet's connection breaks).

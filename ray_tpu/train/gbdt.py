@@ -31,6 +31,39 @@ def _dataset_to_xy(ds, label_column: str):
     return X, y, features
 
 
+def _chunked_boost(grow, train_score, valid_score, *, num_rounds: int,
+                   rounds_per_report: int, early_stopping_rounds):
+    """ONE report/early-stop driver for both engines (they drifted when
+    each had its own copy: `stale` advanced by the nominal chunk size on
+    one and the actual rounds grown on the other, changing early-stop
+    timing on the final partial chunk).
+
+    grow(step) grows `step` more rounds; train_score() / valid_score()
+    score the current ensemble (valid_score returns None when there is
+    no validation set). Returns (history, best_iter, rounds_done)."""
+    history = []
+    best_score, best_iter, stale = -np.inf, 0, 0
+    n = 0
+    while n < num_rounds:
+        step = min(rounds_per_report, num_rounds - n)
+        grow(step)
+        n += step
+        entry = {"training_iteration": n, "train_score": train_score()}
+        vs = valid_score()
+        if vs is not None:
+            entry["valid_score"] = vs
+            if vs > best_score + 1e-12:
+                best_score, best_iter, stale = vs, n, 0
+            else:
+                stale += step
+                if (early_stopping_rounds is not None
+                        and stale >= early_stopping_rounds):
+                    history.append(entry)
+                    break
+        history.append(entry)
+    return history, best_iter, n
+
+
 @ray_tpu.remote(num_cpus=1)
 def _boost_task(mode: str, params: dict, num_rounds: int,
                 rounds_per_report: int, early_stopping_rounds,
@@ -44,27 +77,17 @@ def _boost_task(mode: str, params: dict, num_rounds: int,
     cls = (GradientBoostingClassifier if mode == "classification"
            else GradientBoostingRegressor)
     est = cls(n_estimators=0, warm_start=True, **params)
-    history = []
-    best_score, best_iter, stale = -np.inf, 0, 0
-    n = 0
-    while n < num_rounds:
-        n = min(num_rounds, n + rounds_per_report)
-        est.set_params(n_estimators=n)
+
+    def grow(step):
+        est.set_params(n_estimators=est.get_params()["n_estimators"] + step)
         est.fit(X, y)
-        entry = {"training_iteration": n,
-                 "train_score": float(est.score(X, y))}
-        if Xv is not None:
-            vs = float(est.score(Xv, yv))
-            entry["valid_score"] = vs
-            if vs > best_score + 1e-12:
-                best_score, best_iter, stale = vs, n, 0
-            else:
-                stale += rounds_per_report
-                if (early_stopping_rounds is not None
-                        and stale >= early_stopping_rounds):
-                    history.append(entry)
-                    break
-        history.append(entry)
+
+    history, best_iter, n = _chunked_boost(
+        grow, lambda: float(est.score(X, y)),
+        lambda: float(est.score(Xv, yv)) if Xv is not None else None,
+        num_rounds=num_rounds, rounds_per_report=rounds_per_report,
+        early_stopping_rounds=early_stopping_rounds,
+    )
     if Xv is not None and 0 < best_iter < est.n_estimators_:
         # the checkpointed model must BE the reported best, not the
         # over-trained final state early stopping walked past
@@ -103,6 +126,23 @@ class GBDTTrainer:
         if engine == "sklearn" and num_workers > 1:
             raise ValueError("the sklearn engine is single-process; use "
                              "engine='hist' with num_workers > 1")
+        if engine == "hist" and params:
+            # fail HERE with the allowed set — 'auto' switches param
+            # vocabulary with num_workers, and an sklearn-only param
+            # would otherwise surface as an opaque TypeError inside fit()
+            import dataclasses
+
+            from ray_tpu.train.hist_gbdt import HistParams
+
+            allowed = {f.name for f in dataclasses.fields(HistParams)
+                       } - {"mode"}
+            unknown = sorted(set(params) - allowed)
+            if unknown:
+                raise ValueError(
+                    f"params {unknown} not supported by the hist engine "
+                    f"(selected by num_workers={num_workers}); allowed: "
+                    f"{sorted(allowed)}"
+                )
         self.datasets = datasets
         self.label_column = label_column
         self.params = params or {}
@@ -127,32 +167,39 @@ class GBDTTrainer:
         ]
         runner = H.DistributedFit(shards, hp) if self.num_workers > 1 \
             else H.InProcessFit(shards, hp)
-        try:
-            trees: list = []
-            history = []
-            best_score, best_iter, stale = -np.inf, 0, 0
-            n = 0
-            while n < self.num_boost_round:
-                step = min(self.rounds_per_report,
-                           self.num_boost_round - n)
-                trees.extend(runner.boost(step))
-                n += step
-                model = H.HistModel(list(trees), 0.0, self.mode,
-                                    runner.edges)
-                entry = {"training_iteration": n,
-                         "train_score": model.score(X, y)}
+        trees: list = []
+        # Running margins, extended by only the NEW trees each chunk —
+        # rescoring the whole ensemble per report is O(rounds²·n).
+        y64 = np.asarray(y, np.float64)
+        margin = np.zeros(len(X), np.float64)
+        if Xv is not None:
+            yv64 = np.asarray(yv, np.float64)
+            margin_v = np.zeros(len(Xv), np.float64)
+
+        def grow(step):
+            new = runner.boost(step)
+            trees.extend(new)
+            for w, t in new:
+                np.add(margin, w * t.predict(X), out=margin)
                 if Xv is not None:
-                    vs = model.score(Xv, yv)
-                    entry["valid_score"] = vs
-                    if vs > best_score + 1e-12:
-                        best_score, best_iter, stale = vs, n, 0
-                    else:
-                        stale += step
-                        if (self.early_stopping_rounds is not None
-                                and stale >= self.early_stopping_rounds):
-                            history.append(entry)
-                            break
-                history.append(entry)
+                    np.add(margin_v, w * t.predict(Xv), out=margin_v)
+
+        def _score(m, yy):
+            # matches HistModel.score with base = 0.0
+            if self.mode == "classification":
+                return float(((m > 0).astype(np.int64) == yy).mean())
+            denom = ((yy - yy.mean()) ** 2).sum()
+            return float(1.0 - ((yy - m) ** 2).sum() / (denom + H.EPS))
+
+        try:
+            history, best_iter, n = _chunked_boost(
+                grow, lambda: _score(margin, y64),
+                (lambda: _score(margin_v, yv64)) if Xv is not None
+                else (lambda: None),
+                num_rounds=self.num_boost_round,
+                rounds_per_report=self.rounds_per_report,
+                early_stopping_rounds=self.early_stopping_rounds,
+            )
         finally:
             runner.close()
         if Xv is not None and 0 < best_iter < len(trees):

@@ -60,18 +60,24 @@ class Tree:
         return len(self.feature) - 1
 
     def predict(self, X: np.ndarray) -> np.ndarray:
-        out = np.empty(len(X), np.float64)
+        # level-synchronous descent: all rows walk one edge per pass —
+        # max_depth passes of vectorized gathers instead of a Python
+        # while-loop per row
+        X = np.asarray(X, np.float64)
         feat = np.asarray(self.feature)
         thr = np.asarray(self.threshold)
         left = np.asarray(self.left)
         right = np.asarray(self.right)
         val = np.asarray(self.value)
-        for i, row in enumerate(X):
-            n = 0
-            while feat[n] >= 0:
-                n = left[n] if row[feat[n]] <= thr[n] else right[n]
-            out[i] = val[n]
-        return out
+        node = np.zeros(len(X), np.int64)
+        live = feat[node] >= 0
+        while live.any():
+            idx = np.nonzero(live)[0]
+            n = node[idx]
+            go_left = X[idx, feat[n]] <= thr[n]
+            node[idx] = np.where(go_left, left[n], right[n])
+            live[idx] = feat[node[idx]] >= 0
+        return val[node]
 
 
 def propose_bin_edges(sample_lists: list, n_bins: int) -> list:

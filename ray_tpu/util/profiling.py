@@ -34,7 +34,7 @@ def profile(name: str, extra: dict | None = None):
         w = _worker
         if w is not None:
             try:
-                w.head.fire("task_events", {"events": [{
+                ev = {
                     "task_id": b"span:" + f"{start:.6f}".encode(),
                     "job_id": w.job_id,
                     "name": name,
@@ -44,6 +44,14 @@ def profile(name: str, extra: dict | None = None):
                     "start_s": start,
                     "end_s": end,
                     "extra": extra or {},
-                }]})
+                }
+                # nest under the enclosing task's trace (trace.py): the
+                # span's parent is the task currently executing here
+                from ray_tpu._private import trace as _trace
+
+                cur = _trace.current()
+                if cur is not None:
+                    ev["trace"] = {"trace_id": cur[0], "parent": cur[1]}
+                w.head.fire("task_events", {"events": [ev]})
             except Exception:  # noqa: BLE001 — observability best-effort
                 pass

@@ -1,0 +1,164 @@
+"""runtime_env plugin API + pip plugin (reference
+python/ray/_private/runtime_env/plugin.py + pip.py).
+
+The e2e test hand-crafts a wheel (zero-egress image: no PyPI) and runs a
+task whose venv has a package the driver does not."""
+
+import os
+import zipfile
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import runtime_env_plugins as rep
+from ray_tpu._private.runtime_env import PackageCache
+from ray_tpu.cluster_utils import Cluster
+
+PKG = "graftpkg"
+VERSION = "0.1.0"
+
+
+def _craft_wheel(dirpath: str) -> str:
+    """A minimal valid py3-none-any wheel, built by hand."""
+    name = f"{PKG}-{VERSION}-py3-none-any.whl"
+    path = os.path.join(dirpath, name)
+    di = f"{PKG}-{VERSION}.dist-info"
+    files = {
+        f"{PKG}/__init__.py": f"__version__ = {VERSION!r}\n",
+        f"{di}/METADATA": (
+            f"Metadata-Version: 2.1\nName: {PKG}\nVersion: {VERSION}\n"
+        ),
+        f"{di}/WHEEL": (
+            "Wheel-Version: 1.0\nGenerator: test\nRoot-Is-Purelib: true\n"
+            "Tag: py3-none-any\n"
+        ),
+    }
+    record = "".join(f"{fn},,\n" for fn in files) + f"{di}/RECORD,,\n"
+    files[f"{di}/RECORD"] = record
+    with zipfile.ZipFile(path, "w") as z:
+        for fn, content in files.items():
+            z.writestr(fn, content)
+    return path
+
+
+def test_pip_uri_deterministic_and_config_sensitive():
+    p = rep.PipPlugin()
+    u1 = p.uri_for(["a==1", "b"])
+    assert u1.startswith("pip://")
+    assert p.uri_for(["b", "a==1"]) == u1  # order-insensitive
+    assert p.uri_for(["a==2", "b"]) != u1
+    assert p.uri_for({"packages": ["a==1", "b"],
+                      "install_options": ["--no-index"]}) != u1
+    with pytest.raises(ValueError):
+        p.uri_for("not-a-list")
+
+
+def test_package_cache_gc_evicts_plugin_uris(tmp_path):
+    """Idle plugin URIs share the pkg:// cache lifecycle: beyond the
+    keep cap, oldest-idle venv dirs are deleted from disk."""
+    from ray_tpu._private import runtime_env as re_mod
+
+    cache = PackageCache(str(tmp_path))
+    uris = [f"pip://{i:032x}" for i in range(re_mod.IDLE_CACHE_KEEP + 2)]
+    for u in uris:
+        os.makedirs(cache.dir_for(u))
+        cache.acquire(u)
+    for u in uris:
+        cache.release(u)
+    alive = [u for u in uris if os.path.isdir(cache.dir_for(u))]
+    assert len(alive) == re_mod.IDLE_CACHE_KEEP
+    # the survivors are the newest-idle ones
+    assert alive == uris[-re_mod.IDLE_CACHE_KEEP:]
+
+
+def test_pip_env_task_runs_package_driver_lacks(tmp_path):
+    with pytest.raises(ImportError):
+        import graftpkg  # noqa: F401 — the driver must NOT have it
+
+    wheel_dir = str(tmp_path)
+    _craft_wheel(wheel_dir)
+    env = {"pip": {"packages": [PKG],
+                   "install_options": ["--no-index", "--find-links",
+                                       wheel_dir]}}
+    c = Cluster(head_resources={"CPU": 4, "memory": 2 * 2**30})
+    c.connect()
+    try:
+        @ray_tpu.remote(runtime_env=env)
+        def use_pkg():
+            import graftpkg
+
+            return graftpkg.__version__, os.environ.get("VIRTUAL_ENV")
+
+        version, venv = ray_tpu.get(use_pkg.remote(), timeout=300)
+        assert version == VERSION
+        assert venv and "pip/" in venv.replace(os.sep, "/")
+        # node-level cache: the venv dir exists under the agent cache
+        uri = rep.PipPlugin().uri_for(env["pip"])
+        dest = c.head_agent.pkg_cache.dir_for(uri)
+        assert os.path.isdir(dest)
+        # second task with the SAME env reuses the cached venv (same
+        # VIRTUAL_ENV path, no rebuild — dir mtime unchanged)
+        mtime = os.path.getmtime(dest)
+        version2, venv2 = ray_tpu.get(use_pkg.remote(), timeout=120)
+        assert (version2, venv2) == (version, venv)
+        assert os.path.getmtime(dest) == mtime
+    finally:
+        c.shutdown()
+
+
+def test_bad_pip_env_fails_task_and_frees_resources():
+    """A plugin create error must FAIL the task (no hang) and leave the
+    node's resources and URI refcounts clean for the next task."""
+    c = Cluster(head_resources={"CPU": 2, "memory": 2 * 2**30})
+    c.connect()
+    try:
+        env = {"pip": {"packages": ["no-such-pkg-xyz-12345"],
+                       "install_options": ["--no-index"]}}
+
+        @ray_tpu.remote(runtime_env=env, max_retries=0)
+        def doomed():
+            return 1
+
+        with pytest.raises(ray_tpu.RayTaskError, match="spawn failed"):
+            ray_tpu.get(doomed.remote(), timeout=300)
+        # refcounts did not leak: the failed env's URI is not pinned
+        uri = rep.PipPlugin().uri_for(env["pip"])
+        assert c.head_agent.pkg_cache._refs.get(uri) is None
+        # and the node still runs ordinary tasks (resources were freed)
+        @ray_tpu.remote(num_cpus=2)
+        def fine():
+            return 42
+
+        assert ray_tpu.get(fine.remote(), timeout=120) == 42
+    finally:
+        c.shutdown()
+
+
+class _StampPlugin(rep.RuntimeEnvPlugin):
+    name = "stamp"
+    priority = 50
+
+    def uri_for(self, config):
+        return "stamp://" + rep._config_digest(config)
+
+    def create(self, uri, config, dest):
+        os.makedirs(dest + ".tmp", exist_ok=True)
+        os.replace(dest + ".tmp", dest)
+
+    def modify_context(self, uri, config, dest, ctx):
+        ctx.env["GRAFT_STAMP"] = str(config)
+
+
+def test_custom_plugin_modifies_worker_env():
+    rep.register_plugin(_StampPlugin())
+    c = Cluster(head_resources={"CPU": 4, "memory": 2 * 2**30})
+    c.connect()
+    try:
+        @ray_tpu.remote(runtime_env={"stamp": "xyz"})
+        def read_stamp():
+            return os.environ.get("GRAFT_STAMP")
+
+        assert ray_tpu.get(read_stamp.remote(), timeout=120) == "xyz"
+    finally:
+        c.shutdown()
+        rep.registry().pop("stamp", None)

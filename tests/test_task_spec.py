@@ -1,0 +1,182 @@
+"""Typed TaskSpec schema validation (reference: proto-backed
+TaskSpecification, src/ray/common/task/task_spec.h — malformed specs die
+at process boundaries instead of drifting)."""
+
+import random
+
+import pytest
+
+from ray_tpu._private import task_spec
+from ray_tpu._private.task_spec import (
+    ActorCreationSpec,
+    ActorTaskSpec,
+    InvalidTaskSpec,
+    TaskSpec,
+)
+
+OWNER = {"worker_id": b"w" * 16, "addr": "127.0.0.1", "port": 7001}
+
+
+def _valid_task_fields():
+    return dict(
+        task_id=b"t" * 16,
+        job_id=b"j" * 16,
+        func_id=b"\x01\x02",
+        name="f",
+        args={"payload": [b"", []]},
+        inline_values={},
+        num_returns=1,
+        resources={"CPU": 1.0},
+        owner=dict(OWNER),
+        deps=[b"o" * 16],
+        retries_left=3,
+    )
+
+
+def test_build_and_from_wire_roundtrip():
+    spec = TaskSpec.build(**_valid_task_fields())
+    assert isinstance(spec, dict)
+    assert spec["task_id"] == b"t" * 16
+    # msgpack round-trip: packs as a plain map, re-validates on ingest
+    import msgpack
+
+    wire = msgpack.unpackb(
+        msgpack.packb(dict(spec), use_bin_type=True), raw=False
+    )
+    spec2 = TaskSpec.from_wire(wire)
+    assert spec2["name"] == "f"
+
+
+def test_optional_fields_and_none_dropping():
+    spec = TaskSpec.build(**_valid_task_fields(), pg_id=None,
+                          scheduling_strategy=None, runtime_env=None)
+    assert "pg_id" not in spec
+    spec = TaskSpec.build(**_valid_task_fields(), pg_id=b"p" * 16,
+                          bundle_index=0, bundle_nodes=[b"n" * 16],
+                          scheduling_strategy="SPREAD")
+    assert spec["scheduling_strategy"] == "SPREAD"
+
+
+def test_dynamic_num_returns():
+    f = _valid_task_fields()
+    f["num_returns"] = "dynamic"
+    TaskSpec.build(**f)
+    f["num_returns"] = "bogus"
+    with pytest.raises(InvalidTaskSpec):
+        TaskSpec.build(**f)
+
+
+def test_missing_required_field_rejected():
+    for field in ("task_id", "job_id", "func_id", "owner", "deps"):
+        f = _valid_task_fields()
+        del f[field]
+        with pytest.raises(InvalidTaskSpec, match=field):
+            TaskSpec.build(**f)
+
+
+def test_unknown_field_rejected():
+    f = _valid_task_fields()
+    f["exfiltrate"] = True
+    with pytest.raises(InvalidTaskSpec, match="unknown field"):
+        TaskSpec.from_wire(f)
+
+
+def test_node_local_scratch_fields_pass():
+    f = _valid_task_fields()
+    f["_spills"] = 2
+    f["_granted"] = False
+    TaskSpec.from_wire(f)  # underscore keys are node-local, not contract
+
+
+def test_wrong_id_length_rejected():
+    f = _valid_task_fields()
+    f["task_id"] = b"short"
+    with pytest.raises(InvalidTaskSpec, match="16 bytes"):
+        TaskSpec.from_wire(f)
+
+
+def test_fuzz_mutations_rejected():
+    """Every single-field type corruption must be caught."""
+    rng = random.Random(0)
+    poisons = [None, 1.5, True, "x", b"", [1], [b"ok", "bad"],
+               {"CPU": "one"}, {"CPU": -1}, -3]
+    base = _valid_task_fields()
+    rejected = accepted = 0
+    for field in base:
+        for poison in poisons:
+            f = dict(base)
+            if f[field] == poison or (
+                    type(f[field]) is type(poison) and f[field] == poison):
+                continue
+            f[field] = poison
+            try:
+                TaskSpec.from_wire(f)
+                accepted += 1
+            except InvalidTaskSpec:
+                rejected += 1
+    # a handful of poisons are legitimately valid for permissive fields
+    # (e.g. empty dict for inline_values); the overwhelming majority of
+    # random corruptions must be rejected
+    assert rejected >= 5 * max(accepted, 1), (rejected, accepted)
+    # and shuffled key order doesn't matter
+    items = list(base.items())
+    rng.shuffle(items)
+    TaskSpec.from_wire(dict(items))
+
+
+def test_actor_creation_spec():
+    spec = ActorCreationSpec.build(
+        actor_id=b"a" * 16, job_id=b"j" * 16, name="svc",
+        namespace="default", detached=False, max_restarts=1,
+        resources={"CPU": 1.0}, spec=[b"meta", []], owner_addr=dict(OWNER),
+        max_concurrency=2, concurrency_groups={}, method_groups={},
+    )
+    assert spec["max_concurrency"] == 2
+    with pytest.raises(InvalidTaskSpec):
+        ActorCreationSpec.build(
+            actor_id=b"a" * 16, job_id=b"j" * 16, namespace="default",
+            detached=False, max_restarts=1, resources={"CPU": 1.0},
+            spec=[b"meta", []], owner_addr=dict(OWNER),
+            max_concurrency=0,  # must be >= 1
+        )
+
+
+def test_actor_task_spec():
+    call = ActorTaskSpec.build(
+        task_id=b"t" * 16, actor_id=b"a" * 16, method="ping",
+        args={"payload": [b"", []]}, inline_values={}, num_returns=1,
+        owner=dict(OWNER), seq=0, concurrency_group=None, deps=[],
+    )
+    assert "concurrency_group" not in call  # None dropped, .get() safe
+    with pytest.raises(InvalidTaskSpec, match="seq"):
+        ActorTaskSpec.build(
+            task_id=b"t" * 16, actor_id=b"a" * 16, method="ping",
+            args={}, inline_values={}, num_returns=1, owner=dict(OWNER),
+        )
+
+
+def test_agent_boundary_rejects_malformed():
+    """End-to-end: a hand-rolled malformed spec dies at the agent RPC
+    boundary with a schema error, not deep in dispatch."""
+    import ray_tpu
+    from ray_tpu._private import api, rpc
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(head_resources={"CPU": 2, "memory": 2 * 2**30})
+    c.connect()
+    try:
+        _agent_boundary_body(ray_tpu, api, rpc)
+    finally:
+        c.shutdown()
+
+
+def _agent_boundary_body(ray_tpu, api, rpc):
+    w = api._get_worker()
+    with pytest.raises(rpc.RpcError, match="rejected task spec"):
+        w.agent.call("submit_task", {"task_id": b"x" * 16, "name": 3})
+
+    @ray_tpu.remote
+    def ok():
+        return 41
+
+    assert ray_tpu.get(ok.remote(), timeout=60) == 41

@@ -1,0 +1,100 @@
+"""Cross-process trace propagation (reference
+python/ray/util/tracing/tracing_helper.py:33): a nested submit chain
+joins into one trace; user spans nest under their task."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(head_resources={"CPU": 4, "memory": 2 * 2**30})
+    c.connect()
+    yield c
+    c.shutdown()
+
+
+def _events_by_name(names, timeout=20):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        evs = {e["name"]: e for e in ray_tpu.list_tasks(limit=1000)}
+        if all(n in evs for n in names):
+            return evs
+        time.sleep(0.25)
+    raise AssertionError(f"events {names} never all arrived: "
+                         f"{sorted(evs)}")
+
+
+def test_nested_chain_joins_one_trace(cluster):
+    from ray_tpu._private import trace as _trace
+    from ray_tpu.util.profiling import profile
+
+    @ray_tpu.remote
+    def leaf_c():
+        tid, span = _trace.current()
+        return {"c_span": span, "trace_id": tid}
+
+    @ray_tpu.remote
+    def mid_b():
+        with profile("inner_span"):
+            out = ray_tpu.get(leaf_c.remote(), timeout=60)
+        tid, span = _trace.current()
+        out["b_span"] = span
+        assert out["trace_id"] == tid  # child continued OUR trace
+        return out
+
+    @ray_tpu.remote
+    def root_a():
+        out = ray_tpu.get(mid_b.remote(), timeout=60)
+        tid, span = _trace.current()
+        out["a_span"] = span
+        assert out["trace_id"] == tid
+        return out
+
+    out = ray_tpu.get(root_a.remote(), timeout=120)
+    evs = _events_by_name(["root_a", "mid_b", "leaf_c", "inner_span"])
+
+    # one trace id across all three tasks and the user span
+    for name in ("root_a", "mid_b", "leaf_c", "inner_span"):
+        assert evs[name]["trace"]["trace_id"] == out["trace_id"], name
+    # parent chain: driver-rooted a -> b -> c
+    assert "parent" not in evs["root_a"]["trace"]
+    assert evs["mid_b"]["trace"]["parent"] == out["a_span"]
+    assert evs["leaf_c"]["trace"]["parent"] == out["b_span"]
+    # the user span nests under the task that opened it
+    assert evs["inner_span"]["trace"]["parent"] == out["b_span"]
+    # and the span ids ARE the task ids (joinable against task events)
+    assert evs["mid_b"]["task_id"].hex() == out["b_span"]
+
+
+def test_timeline_renders_flow_arrows(cluster):
+    trace = ray_tpu.timeline()
+    flows = [t for t in trace if t.get("cat") == "trace"]
+    starts = [t for t in flows if t["ph"] == "s"]
+    ends = [t for t in flows if t["ph"] == "f"]
+    # the chain above yields at least two parent->child joins
+    assert len(starts) >= 2 and len(ends) >= 2
+    assert {t["id"] for t in starts} == {t["id"] for t in ends}
+    # user spans carry their parent span in args
+    spans = [t for t in trace if t.get("cat") == "user_span"]
+    assert any(t["args"].get("parent_span") for t in spans)
+
+
+def test_actor_calls_carry_trace(cluster):
+    from ray_tpu._private import trace as _trace
+
+    @ray_tpu.remote
+    class Svc:
+        def span(self):
+            cur = _trace.current()
+            return cur
+
+    svc = Svc.remote()
+    cur = ray_tpu.get(svc.span.remote(), timeout=60)
+    assert cur is not None  # actor call entered a trace scope
+    tid, span = cur
+    assert len(tid) == 16 and len(span) == 32
