@@ -1,0 +1,56 @@
+#!/usr/bin/env bash
+# Sanitizer gate for the two C++ components (SURVEY §4: the reference
+# runs its raylet/plasma tests under TSAN/ASAN bazel configs).
+#
+#   ray_tpu/_native/sanitize/run.sh [outfile]
+#
+# Builds stress_store / stress_scheduler under ThreadSanitizer and
+# AddressSanitizer+UBSan, runs each, and writes a summary JSON to
+# outfile (default SANITIZE.json at the repo root). Exits nonzero on
+# any build failure, sanitizer report, or stress failure.
+set -u
+HERE="$(cd "$(dirname "$0")" && pwd)"
+ROOT="$(cd "$HERE/../../.." && pwd)"
+OUT="${1:-$ROOT/SANITIZE.json}"
+TMP="$(mktemp -d)"
+trap 'rm -rf "$TMP"' EXIT
+
+STORE_SRC="$HERE/../../core/object_store/store.cc"
+SCHED_SRC="$HERE/../scheduler.cc"
+
+declare -a results=()
+fail=0
+
+run_one() {
+  local tag="$1" san="$2" stress="$3" src="$4"
+  local bin="$TMP/$tag"
+  local log="$TMP/$tag.log"
+  if ! g++ -g -O1 -std=c++17 -fno-omit-frame-pointer "-fsanitize=$san" \
+       -o "$bin" "$HERE/$stress" "$src" -lpthread -lrt 2>"$log"; then
+    echo "BUILD FAIL $tag"; cat "$log"; fail=1
+    results+=("{\"target\": \"$tag\", \"status\": \"build_fail\"}")
+    return
+  fi
+  # halt_on_error so a report fails the run loudly; abort_on_error=0
+  # keeps the exit code (66) parseable
+  if TSAN_OPTIONS="halt_on_error=1 exitcode=66" \
+     ASAN_OPTIONS="halt_on_error=1 exitcode=66 detect_leaks=1" \
+     UBSAN_OPTIONS="halt_on_error=1" \
+     timeout 600 "$bin" >"$log" 2>&1; then
+    echo "OK $tag"
+    results+=("{\"target\": \"$tag\", \"status\": \"clean\"}")
+  else
+    echo "SANITIZER FAIL $tag"; tail -50 "$log"; fail=1
+    results+=("{\"target\": \"$tag\", \"status\": \"failed\"}")
+  fi
+}
+
+run_one store_tsan thread stress_store.cc "$STORE_SRC"
+run_one store_asan address,undefined stress_store.cc "$STORE_SRC"
+run_one sched_tsan thread stress_scheduler.cc "$SCHED_SRC"
+run_one sched_asan address,undefined stress_scheduler.cc "$SCHED_SRC"
+
+printf '{"results": [%s], "clean": %s}\n' \
+  "$(IFS=,; echo "${results[*]}")" \
+  "$([ $fail -eq 0 ] && echo true || echo false)" >"$OUT"
+exit $fail

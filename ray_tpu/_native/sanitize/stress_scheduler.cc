@@ -1,0 +1,91 @@
+// Stress of the native cluster-resource scheduler under TSan /
+// ASan+UBSan (run.sh). The scheduler's concurrency CONTRACT is
+// single-caller (ctypes under the GIL from one agent loop), so threads
+// here serialize on a mutex mirroring that contract — the sanitizers
+// hunt memory errors (use-after-free on remove/pick, string lifetime,
+// fixed-point overflow UB), not lock-free races the API never promises.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* sched_new();
+void sched_free(void*);
+void sched_upsert_node(void*, const char*, int, const char**, const double*,
+                       const double*, int);
+void sched_remove_node(void*, const char*);
+int sched_num_nodes(void*);
+int sched_acquire(void*, const char*, const char**, const double*, int);
+void sched_release(void*, const char*, const char**, const double*, int);
+int sched_pick(void*, const char*, const char**, const double*, int, double,
+               int, int, uint64_t, char*, int);
+}
+
+namespace {
+
+constexpr int kThreads = 4;
+constexpr int kIters = 20000;
+constexpr int kNodes = 12;
+
+std::mutex gil;  // the API's real-world mutual exclusion
+
+uint64_t xorshift(uint64_t* s) {
+  *s ^= *s << 13;
+  *s ^= *s >> 7;
+  *s ^= *s << 17;
+  return *s;
+}
+
+void worker(void* sched, int tno) {
+  uint64_t rng = 0xa0761c4c18731ae9ULL * (tno + 1);
+  const char* names[3] = {"CPU", "memory", "TPU"};
+  for (int i = 0; i < kIters; i++) {
+    char node[32];
+    std::snprintf(node, sizeof(node), "node-%d",
+                  (int)(xorshift(&rng) % kNodes));
+    double total[3] = {8.0, 64.0, (double)(xorshift(&rng) % 5)};
+    double avail[3] = {(double)(xorshift(&rng) % 9), 32.0, total[2]};
+    double want[3] = {1.0 + (double)(xorshift(&rng) % 4), 1.0, 0.0};
+    std::lock_guard<std::mutex> g(gil);
+    switch (xorshift(&rng) % 6) {
+      case 0:
+        sched_upsert_node(sched, node, 1, names, total, avail, 3);
+        break;
+      case 1:
+        sched_remove_node(sched, node);
+        break;
+      case 2:
+        sched_acquire(sched, node, names, want, 2);
+        break;
+      case 3:
+        sched_release(sched, node, names, want, 2);
+        break;
+      default: {
+        char out[64];
+        sched_pick(sched, node, names, want, 2, 0.5, 3,
+                   (int)(xorshift(&rng) % 2), xorshift(&rng), out,
+                   sizeof(out));
+        break;
+      }
+    }
+  }
+}
+
+}  // namespace
+
+int main() {
+  void* sched = sched_new();
+  std::vector<std::thread> ts;
+  for (int t = 0; t < kThreads; t++) ts.emplace_back(worker, sched, t);
+  for (auto& t : ts) t.join();
+  {
+    std::lock_guard<std::mutex> g(gil);
+    sched_free(sched);
+  }
+  std::printf("stress_scheduler OK\n");
+  return 0;
+}

@@ -29,6 +29,13 @@ _DEFS: dict[str, Any] = {
     "spill_high_fraction": 0.8,          # spill primaries above this fill
     "spill_low_fraction": 0.5,           # ...until back under this
     "worker_register_timeout_s": 60.0,
+    # pull admission (pull_manager.py; reference pull_manager.h:52)
+    "pull_max_active": 8,
+    "pull_admission_watermark": 0.8,
+    # queued-path pipelining: tasks the dispatcher may stack into one
+    # pool worker's exec queue when no idle worker matches and the pool
+    # is at cap (the queued analog of lease-push pipelining)
+    "pool_dispatch_depth": 4,
     # soft cap on non-actor worker processes per node; 0 = auto
     # (max(4, 2*CPU)). See NodeAgent._pool_worker_cap.
     "max_pool_workers_per_node": 0,

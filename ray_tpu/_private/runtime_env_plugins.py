@@ -187,7 +187,13 @@ def registry() -> dict[str, RuntimeEnvPlugin]:
 
 def register_plugin(plugin: RuntimeEnvPlugin) -> None:
     """In-process registration (tests / embedded agents)."""
-    registry()[plugin.name] = plugin
+    global _registry
+    reg = registry()
+    reg[plugin.name] = plugin
+    # re-sort: `priority` promises lower-runs-first even for plugins
+    # registered after the registry was first built
+    _registry = {p.name: p for p in
+                 sorted(reg.values(), key=lambda p: p.priority)}
 
 
 # in-flight creates keyed by (cache_root, uri): two concurrent spawns of
@@ -202,24 +208,31 @@ async def apply_plugins(runtime_env: dict, ctx: RuntimeEnvContext,
     death, same as pkg:// URIs)."""
     acquired: list[str] = []
     loop = asyncio.get_running_loop()
-    for plugin in registry().values():
-        config = runtime_env.get(plugin.name)
-        if config is None:
-            continue
-        uri = plugin.uri_for(config)
-        dest = cache.dir_for(uri)
-        if not os.path.isdir(dest):
-            key = (cache.root, uri)
-            fut = _creating.get(key)
-            if fut is None:
-                fut = loop.run_in_executor(
-                    None, plugin.create, uri, config, dest)
-                _creating[key] = fut
-            try:
-                await fut
-            finally:
-                _creating.pop(key, None)
-        cache.acquire(uri)
-        acquired.append(uri)
-        plugin.modify_context(uri, config, dest, ctx)
+    try:
+        for plugin in registry().values():
+            config = runtime_env.get(plugin.name)
+            if config is None:
+                continue
+            uri = plugin.uri_for(config)
+            dest = cache.dir_for(uri)
+            if not os.path.isdir(dest):
+                key = (cache.root, uri)
+                fut = _creating.get(key)
+                if fut is None:
+                    fut = loop.run_in_executor(
+                        None, plugin.create, uri, config, dest)
+                    _creating[key] = fut
+                try:
+                    await fut
+                finally:
+                    _creating.pop(key, None)
+            cache.acquire(uri)
+            acquired.append(uri)
+            plugin.modify_context(uri, config, dest, ctx)
+    except BaseException:
+        # partial failure: the caller never sees `acquired`, so release
+        # the refcounts here or earlier plugins' dirs are pinned forever
+        for uri in acquired:
+            cache.release(uri)
+        raise
     return acquired

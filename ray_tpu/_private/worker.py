@@ -689,7 +689,28 @@ class CoreWorker:
 
     def get(self, object_ids: list[bytes], timeout: float | None = None):
         deadline = None if timeout is None else time.monotonic() + timeout
-        return [self._get_one(oid, deadline) for oid in object_ids]
+        # Executors tell their agent while they are parked in get() so
+        # the pool can backfill their slot and never pipeline onto them
+        # (reference NotifyDirectCallTaskBlocked, core_worker.cc) —
+        # without this, N workers blocked on nested tasks deadlock an
+        # N-slot pool. No-op for drivers (_notify_blocked → False).
+        blocked = False
+        try:
+            out = []
+            for oid in object_ids:
+                if not blocked and not self._entry(oid).ready:
+                    blocked = self._notify_blocked()
+                out.append(self._get_one(oid, deadline))
+            return out
+        finally:
+            if blocked:
+                self._notify_unblocked()
+
+    def _notify_blocked(self) -> bool:
+        return False  # drivers are not pool workers
+
+    def _notify_unblocked(self) -> None:
+        pass
 
     def _get_one(self, oid: bytes, deadline):
         e = self._entry(oid)
@@ -835,22 +856,29 @@ class CoreWorker:
         deadline = None if timeout is None else time.monotonic() + timeout
         ready: list[bytes] = []
         pending = list(object_ids)
-        while True:
-            still = []
-            for oid in pending:
-                e = self._entry(oid)
-                if not e.ready:
-                    self._try_resolve_remote(oid)
-                if e.ready:
-                    ready.append(oid)
-                else:
-                    still.append(oid)
-            pending = still
-            if len(ready) >= num_returns or not pending:
-                return ready, pending
-            if deadline is not None and time.monotonic() >= deadline:
-                return ready, pending
-            time.sleep(0.01)
+        blocked = False  # executor parked here: agent backfills the slot
+        try:
+            while True:
+                still = []
+                for oid in pending:
+                    e = self._entry(oid)
+                    if not e.ready:
+                        self._try_resolve_remote(oid)
+                    if e.ready:
+                        ready.append(oid)
+                    else:
+                        still.append(oid)
+                pending = still
+                if len(ready) >= num_returns or not pending:
+                    return ready, pending
+                if deadline is not None and time.monotonic() >= deadline:
+                    return ready, pending
+                if not blocked:
+                    blocked = self._notify_blocked()
+                time.sleep(0.01)
+        finally:
+            if blocked:
+                self._notify_unblocked()
 
     def free(self, object_ids: list[bytes]):
         plasma = []

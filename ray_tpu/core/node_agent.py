@@ -37,7 +37,8 @@ from typing import Any
 from ray_tpu._private import config as cfg
 from ray_tpu._private import rpc, task_spec
 from ray_tpu._private.rpc import AsyncRpcClient, RpcServer
-from ray_tpu.core.object_store import ObjectStoreClient
+from ray_tpu.core import pull_manager
+from ray_tpu.core.object_store import ObjectStoreClient, StoreFullError
 
 logger = logging.getLogger(__name__)
 
@@ -100,7 +101,14 @@ class WorkerHandle:
         self.port: int | None = None
         self.client: AsyncRpcClient | None = None
         self.ready = asyncio.Event()
-        self.busy_task: bytes | None = None
+        self.busy_task: bytes | None = None  # lease/reservation marker
+        self.blocked = 0  # depth of in-get parks (worker_blocked fires)
+        # Queued-path tasks pushed to this worker's exec queue and not
+        # yet done: dispatch pipelines up to pool_dispatch_depth of them
+        # (reference pipelines lease pushes, direct_task_transport.h:211
+        # — without this, every pool task pays a full dispatch→execute→
+        # done round trip before the next one starts on that worker).
+        self.pool_inflight: set[bytes] = set()
         self.actor_id: bytes | None = None
         self.job_id: bytes | None = None
         self.holds_tpu = False
@@ -111,7 +119,8 @@ class WorkerHandle:
 
     @property
     def idle(self) -> bool:
-        return self.busy_task is None and self.actor_id is None
+        return (self.busy_task is None and self.actor_id is None
+                and not self.pool_inflight)
 
 
 class NodeAgent:
@@ -145,7 +154,7 @@ class NodeAgent:
         self.bundles: dict[tuple[bytes, int], dict] = {}  # prepared/committed
         self.bundle_available: dict[tuple[bytes, int], dict] = {}
         self._peer_clients: dict[bytes, AsyncRpcClient] = {}
-        self._pulls_inflight: dict[bytes, asyncio.Future] = {}
+        self._pull_sched: pull_manager.PullScheduler | None = None
         # worker leases for owner-direct task pushes (lease caching,
         # reference direct_task_transport.h:110): lease_id -> grant
         self.leases: dict[bytes, dict] = {}
@@ -626,7 +635,8 @@ class NodeAgent:
                           holds_tpu: bool = False,
                           runtime_env: dict | None = None, *,
                           wait: bool = True,
-                          spawn_wait: bool = True) -> WorkerHandle | None:
+                          spawn_wait: bool = True,
+                          allow_pipeline: bool = False) -> WorkerHandle | None:
         """Idle worker of the same job AND runtime env, else spawn
         (worker_pool.h PopWorker; env mismatch forces a new process).
         At the pool cap: evict an idle MISMATCHED worker to make room,
@@ -646,8 +656,34 @@ class NodeAgent:
                         and w.proc.poll() is None:
                     w.idle_since = time.monotonic()
                     return w
+
+            def _pipeline_candidate():
+                # no idle match: pipeline onto the least-loaded MATCHING
+                # busy worker under the depth cap — the exec queue hides
+                # the dispatch→done round trip (the queued-path analog of
+                # lease-push pipelining, direct_task_transport.h:211).
+                # NEVER a blocked worker: its exec thread is parked in
+                # get() on nested work — stacking more tasks behind it
+                # is the nested-task deadlock.
+                depth = cfg.get("pool_dispatch_depth")
+                best = None
+                for w in self.workers.values():
+                    if (w.actor_id is None and w.busy_task is None
+                            and not w.blocked
+                            and w.ready.is_set() and w.job_id == job_id
+                            and getattr(w, "env_hash", None) == want
+                            and w.proc.poll() is None
+                            and 0 < len(w.pool_inflight) < depth):
+                        if best is None or len(w.pool_inflight) < len(
+                                best.pool_inflight):
+                            best = w
+                return best
+
+            # blocked workers don't hold a slot: each one parked in
+            # get() justifies one replacement (reference releases the
+            # blocked worker's CPU and spawns a backfill)
             n_pool = sum(1 for w in self.workers.values()
-                         if w.actor_id is None)
+                         if w.actor_id is None and not w.blocked)
             if n_pool >= self._pool_worker_cap():
                 # no matching idle worker and no room: evict the longest-
                 # idle MISMATCHED pool worker (job/env churn must not
@@ -660,6 +696,14 @@ class NodeAgent:
                     self._kill_worker(min(victims,
                                           key=lambda w: w.idle_since))
                     n_pool -= 1
+                elif allow_pipeline:
+                    # queued dispatch only — a LEASE must get a worker to
+                    # itself (the owner pushes depth-10 bursts assuming a
+                    # dedicated exec thread; stacking those behind another
+                    # task starves them)
+                    cand = _pipeline_candidate()
+                    if cand is not None:
+                        return cand
             if n_pool < self._pool_worker_cap():
                 if not spawn_wait:
                     # lease fast path: spawning takes ~100-400ms and the
@@ -672,7 +716,8 @@ class NodeAgent:
                             # queue spawns before any executes — only the
                             # ones still under the cap may fork
                             n = sum(1 for w in self.workers.values()
-                                    if w.actor_id is None)
+                                    if w.actor_id is None
+                                    and not w.blocked)
                             if n >= self._pool_worker_cap():
                                 return
                             await self._spawn_worker(
@@ -814,13 +859,16 @@ class NodeAgent:
                         await self._notify_task_failed(
                             spec, f"leased worker died (exit {code})"
                         )
-        if w.busy_task is not None:
-            spec = self.running.pop(w.busy_task, None)
+        for tid in [w.busy_task, *list(w.pool_inflight)]:
+            if tid is None:
+                continue
+            spec = self.running.pop(tid, None)
             if spec is not None:
                 self._free_task_resources(spec)
                 await self._notify_task_failed(
                     spec, f"worker died with exit code {code}"
                 )
+        w.pool_inflight.clear()
 
     async def _notify_task_failed(self, spec: dict, reason: str,
                                   retriable: bool = True):
@@ -1162,8 +1210,17 @@ class NodeAgent:
         # the room, or back-to-back ticks (no await between grants and
         # worker spawns) would over-grant the whole queue.
         room = self._pool_worker_cap() - getattr(self, "_pop_waiters", 0)
+        depth = cfg.get("pool_dispatch_depth")
         for w in self.workers.values():
-            if w.actor_id is None and not (w.idle and w.ready.is_set()):
+            if w.actor_id is None and not w.blocked \
+                    and not (w.idle and w.ready.is_set()):
+                # blocked workers don't consume room (their slot is
+                # backfillable — _pop_worker excludes them from the cap),
+                # and a pipeline-capable busy worker can absorb at least
+                # one more task into its exec queue
+                if (w.busy_task is None and w.ready.is_set()
+                        and 0 < len(w.pool_inflight) < depth):
+                    continue
                 room -= 1
         # Bound the saturated scan: when nothing is being granted (no
         # worker room or no resources), rotating the whole queue per tick
@@ -1215,7 +1272,8 @@ class NodeAgent:
                     spec["_fetching"] = True
                     spec["_fetching_since"] = now
                     for d in missing:
-                        asyncio.ensure_future(self._ensure_local(d))
+                        asyncio.ensure_future(self._ensure_local(
+                            d, priority=pull_manager.PRI_TASK_ARG))
                 elif now - spec.get("_fetching_since", now) > DEP_LOST_S:
                     # No copy appeared anywhere: tell the owner so it can
                     # lineage-reconstruct (object_recovery_manager.h:90),
@@ -1226,7 +1284,8 @@ class NodeAgent:
                                 self._notify_dep_lost(spec, d))
                     spec["_fetching_since"] = now
                     for d in missing:
-                        asyncio.ensure_future(self._ensure_local(d))
+                        asyncio.ensure_future(self._ensure_local(
+                            d, priority=pull_manager.PRI_TASK_ARG))
                 self.task_queue.append(spec)
                 stalled += 1
                 continue
@@ -1270,6 +1329,7 @@ class NodeAgent:
                 spec.get("job_id"),
                 holds_tpu=spec.get("resources", {}).get("TPU", 0) > 0,
                 runtime_env=spec.get("runtime_env"),
+                allow_pipeline=True,
             )
         except self.PoolSaturated:
             # node healthy, merely at its worker cap for the whole wait
@@ -1290,7 +1350,9 @@ class NodeAgent:
             return
         finally:
             self._pop_waiters -= 1
-        w.busy_task = spec["task_id"]
+        if w.busy_task == self._RESERVED:
+            w.busy_task = None  # reservation consumed by this dispatch
+        w.pool_inflight.add(spec["task_id"])
         self.running[spec["task_id"]] = spec
         spec["_worker_id"] = w.worker_id
         try:
@@ -1300,7 +1362,7 @@ class NodeAgent:
             )
         except (rpc.ConnectionLost, rpc.RpcError, OSError) as e:
             self.running.pop(spec["task_id"], None)
-            w.busy_task = None
+            w.pool_inflight.discard(spec["task_id"])
             self._signal_worker_free()
             self._free_task_resources(spec)
             await self._notify_task_failed(spec, f"dispatch failed: {e}")
@@ -1418,7 +1480,18 @@ class NodeAgent:
         w = self.workers.get(lease["worker_id"])
         if w is not None:
             w.busy_task = None
-            w.idle_since = time.monotonic()
+            # Direct-pushed tasks can STILL be executing on this worker
+            # (owner returned the lease while a long task runs, e.g. one
+            # blocked on nested work): migrate them to pool_inflight so
+            # the worker is NOT treated as idle — re-leasing or
+            # dispatching onto it would starve the new work behind the
+            # running task (observed: 10 pushed tasks lost per lease).
+            for tid in lease.get("active", ()):
+                if tid in self.running:
+                    w.pool_inflight.add(tid)
+                    self.running[tid]["_lease_migrated"] = True
+            if not w.pool_inflight:
+                w.idle_since = time.monotonic()
             self._signal_worker_free()
         if lease.get("owner"):
             # agent-initiated revocation (TTL lapse / actor reclaim): tell
@@ -1486,6 +1559,24 @@ class NodeAgent:
         self._kick_dispatch()
         return True
 
+    async def rpc_worker_blocked(self, conn, p):
+        """Worker parked in get() on nested work (reference
+        NotifyDirectCallTaskBlocked): free its pool slot so dispatch can
+        backfill — N workers blocked on nested tasks must not wedge an
+        N-slot pool."""
+        w = self.workers.get(p["worker_id"])
+        if w is not None:
+            w.blocked += 1
+            self._signal_worker_free()  # a slot just opened
+            self._kick_dispatch()
+        return True
+
+    async def rpc_worker_unblocked(self, conn, p):
+        w = self.workers.get(p["worker_id"])
+        if w is not None and w.blocked > 0:
+            w.blocked -= 1
+        return True
+
     async def rpc_task_done(self, conn, p):
         """Worker reports completion; frees resources, worker back to pool."""
         self._task_done_one(p["task_id"])
@@ -1506,12 +1597,24 @@ class NodeAgent:
             if lease is not None:
                 lease["active"].discard(tid)
                 lease["last_activity"] = time.monotonic()
+            elif spec.get("_lease_migrated"):
+                # lease was released mid-task; the task was migrated to
+                # pool_inflight accounting (resources already freed with
+                # the lease — only the idle bit needs clearing here)
+                w = self.workers.get(spec.get("_worker_id", b""))
+                if w is not None:
+                    w.pool_inflight.discard(tid)
+                    if not w.pool_inflight:
+                        w.idle_since = time.monotonic()
+                    self._signal_worker_free()
         else:
             self._free_task_resources(spec)
             w = self.workers.get(spec.get("_worker_id", b""))
             if w is not None:
-                w.busy_task = None
-                w.idle_since = time.monotonic()
+                w.pool_inflight.discard(tid)
+                if not w.pool_inflight:
+                    w.idle_since = time.monotonic()
+                # below-depth again: waiters may pipeline onto it
                 self._signal_worker_free()
 
     async def rpc_cancel_task(self, conn, p):
@@ -1723,26 +1826,24 @@ class NodeAgent:
                                       timeout=p.get("timeout", 60.0))
         return bool(ok)
 
-    async def _ensure_local(self, oid: bytes, timeout: float = 60.0) -> bool:
+    async def _ensure_local(self, oid: bytes, timeout: float = 60.0,
+                            priority: int = pull_manager.PRI_GET) -> bool:
+        """Make the object present locally via the pull scheduler:
+        priority-ordered (task args > gets > restores) and admission-
+        gated on store headroom (pull_manager.py; reference
+        pull_manager.h:52)."""
         if self.store.contains(oid):
             return True
-        inflight = self._pulls_inflight.get(oid)
-        if inflight is not None:
-            return await asyncio.shield(inflight)
-        fut = asyncio.get_running_loop().create_future()
-        self._pulls_inflight[oid] = fut
-        try:
-            ok = await self._pull_object(oid, timeout)
-            fut.set_result(ok)
-            return ok
-        except Exception as e:  # propagate to co-waiters
-            fut.set_exception(e)
-            raise
-        finally:
-            self._pulls_inflight.pop(oid, None)
+        if self._pull_sched is None:
+            self._pull_sched = pull_manager.PullScheduler(
+                self._pull_object, self.store,
+                max_active=cfg.get("pull_max_active"),
+                watermark=cfg.get("pull_admission_watermark"))
+        return await asyncio.shield(
+            self._pull_sched.request(oid, priority, timeout))
 
-    async def _pull_object(self, oid: bytes, timeout: float) -> bool:
-        deadline = time.monotonic() + timeout
+    async def _pull_object(self, oid: bytes, deadline: float,
+                           reserve=lambda n: None) -> bool:
         while time.monotonic() < deadline:
             try:
                 info = await self.head.call("object_wait_location", {
@@ -1755,6 +1856,7 @@ class NodeAgent:
                 continue
             if info is None:
                 return False
+            reserve(info.get("size") or 0)  # admission sees these bytes
             if self.node_id in info["locations"]:
                 return True  # a local writer beat us to it
             if not info["locations"] and info.get("spilled"):
@@ -1780,8 +1882,15 @@ class NodeAgent:
                 cli = await self._peer_agent(nid)
                 if cli is None:
                     continue
-                if await self._pull_from(cli, oid):
-                    pulled = True
+                try:
+                    if await self._pull_from(cli, oid):
+                        pulled = True
+                        break
+                except StoreFullError:
+                    # store saturated even after LRU eviction: back off
+                    # and retry within the deadline — the admission
+                    # watermark keeps concurrent pulls from compounding
+                    await asyncio.sleep(0.2)
                     break
             if pulled:
                 await self.head.call("object_add_location", {
@@ -1889,7 +1998,8 @@ class NodeAgent:
     async def _oom_kill_once(self, frac: float = 1.0) -> bool:
         """Kill the newest task worker (retriable-FIFO policy)."""
         candidates = [w for w in self.workers.values()
-                      if w.busy_task is not None and w.actor_id is None]
+                      if (w.busy_task is not None or w.pool_inflight)
+                      and w.actor_id is None]
         if not candidates:
             candidates = [w for w in self.workers.values()
                           if w.actor_id is not None]

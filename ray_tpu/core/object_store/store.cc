@@ -539,9 +539,25 @@ uint64_t store_evict(void* sp, uint64_t needed) {
   return freed;
 }
 
-uint64_t store_used_bytes(void* sp) { return ((Store*)sp)->hdr->used_bytes; }
+// Monitoring readers take the lock too: used_bytes/num_objects are
+// plain uint64 fields mutated under it — unlocked reads are a data race
+// (TSan-visible, and a torn read on platforms without atomic 64-bit
+// loads would report garbage capacity to the memory monitor).
+uint64_t store_used_bytes(void* sp) {
+  ShmHeader* h = ((Store*)sp)->hdr;
+  lock(h);
+  uint64_t v = h->used_bytes;
+  unlock(h);
+  return v;
+}
 uint64_t store_capacity(void* sp) { return ((Store*)sp)->hdr->heap_size; }
-uint64_t store_num_objects(void* sp) { return ((Store*)sp)->hdr->num_objects; }
+uint64_t store_num_objects(void* sp) {
+  ShmHeader* h = ((Store*)sp)->hdr;
+  lock(h);
+  uint64_t v = h->num_objects;
+  unlock(h);
+  return v;
+}
 
 uint8_t* store_base_ptr(void* sp) { return ((Store*)sp)->base; }
 uint64_t store_map_size(void* sp) { return ((Store*)sp)->map_size; }

@@ -1,0 +1,155 @@
+"""Priority-ordered, memory-gated object pull scheduling.
+
+Reference: src/ray/object_manager/pull_manager.h:52 — pulls are bundled
+by purpose (task args > worker gets > speculative restores), admitted
+while the store has headroom, and the highest-priority queued bundle
+activates first as space frees. Scaled design: one scheduler per node
+agent; `request()` dedups per object (sharing one future), escalates
+priority when a hotter request arrives for a queued object, and a pump
+activates pulls strictly in (priority, arrival) order while
+
+    used_bytes + reserved(active pulls) < capacity * watermark
+
+with one pull always admitted even above the watermark so a single
+object larger than the budget still makes progress (the store's LRU
+eviction reclaims space for it).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import heapq
+import logging
+import time
+
+logger = logging.getLogger(__name__)
+
+# priorities: lower = hotter (heap order)
+PRI_TASK_ARG = 0   # staging deps for a queued task: blocks dispatch
+PRI_GET = 1        # a worker/driver blocked in get()
+PRI_RESTORE = 2    # speculative restore / prefetch
+
+
+class PullScheduler:
+    def __init__(self, pull_fn, store, *, max_active: int = 8,
+                 watermark: float = 0.8):
+        """pull_fn(oid, deadline, reserve) -> bool coroutine: performs
+        the actual transfer; calls reserve(nbytes) once the size is
+        known so admission accounts for in-flight bytes. `store` needs
+        used_bytes() / capacity()."""
+        self._pull_fn = pull_fn
+        self._store = store
+        self.max_active = max_active
+        self.watermark = watermark
+        self._heap: list[tuple[int, int, bytes]] = []
+        self._seq = 0
+        # oid -> {"pri", "fut", "deadline", "queued": bool}
+        self._reqs: dict[bytes, dict] = {}
+        self._active: dict[bytes, int] = {}  # oid -> reserved bytes
+        self._kick = asyncio.Event()
+        self._pump_task: asyncio.Task | None = None
+
+    # ---- public ----
+
+    def request(self, oid: bytes, priority: int,
+                timeout: float) -> asyncio.Future:
+        """Queue (or join) a pull; returns a future resolving to bool.
+        A hotter duplicate escalates the queued entry's priority —
+        a task-arg request must not wait behind a speculative restore."""
+        now = time.monotonic()
+        req = self._reqs.get(oid)
+        if req is not None:
+            req["deadline"] = max(req["deadline"], now + timeout)
+            if priority < req["pri"]:
+                req["pri"] = priority
+                if req["queued"]:
+                    self._push(oid, priority)  # stale heap entry skipped
+            return req["fut"]
+        fut = asyncio.get_running_loop().create_future()
+        self._reqs[oid] = {"pri": priority, "fut": fut,
+                           "deadline": now + timeout, "queued": True}
+        self._push(oid, priority)
+        self._ensure_pump()
+        return fut
+
+    def stats(self) -> dict:
+        return {"queued": sum(1 for r in self._reqs.values()
+                              if r["queued"]),
+                "active": len(self._active),
+                "reserved_bytes": sum(self._active.values())}
+
+    # ---- internals ----
+
+    def _push(self, oid: bytes, pri: int):
+        self._seq += 1
+        heapq.heappush(self._heap, (pri, self._seq, oid))
+        self._kick.set()
+
+    def _ensure_pump(self):
+        if self._pump_task is None or self._pump_task.done():
+            self._pump_task = asyncio.ensure_future(self._pump())
+
+    def _headroom_ok(self) -> bool:
+        try:
+            used = self._store.used_bytes()
+            cap = self._store.capacity()
+        except Exception:  # noqa: BLE001 — store mid-teardown
+            return True
+        return used + sum(self._active.values()) < cap * self.watermark
+
+    async def _pump(self):
+        while self._reqs:
+            self._kick.clear()
+            now = time.monotonic()
+            # expire overdue QUEUED requests wherever they sit — a
+            # request parked behind a saturated slot must still resolve
+            # False at its deadline, not hang until it reaches the top
+            for oid, req in list(self._reqs.items()):
+                if req["queued"] and req["deadline"] < now:
+                    self._finish(oid, False)
+            progressed = True
+            while progressed and self._heap:
+                progressed = False
+                pri, seq, oid = self._heap[0]
+                req = self._reqs.get(oid)
+                if req is None or not req["queued"] or req["pri"] != pri:
+                    heapq.heappop(self._heap)  # stale/escalated entry
+                    progressed = True
+                    continue
+                if req["deadline"] < now:
+                    heapq.heappop(self._heap)
+                    self._finish(oid, False)
+                    progressed = True
+                    continue
+                if len(self._active) >= self.max_active:
+                    break
+                if not self._headroom_ok() and self._active:
+                    break  # wait for an active pull to finish/free space
+                heapq.heappop(self._heap)
+                req["queued"] = False
+                self._active[oid] = 0
+                asyncio.ensure_future(self._run(oid, req))
+                progressed = True
+            try:
+                await asyncio.wait_for(self._kick.wait(), timeout=0.2)
+            except asyncio.TimeoutError:
+                pass  # re-check deadlines / headroom
+
+    async def _run(self, oid: bytes, req: dict):
+        def reserve(nbytes: int):
+            if oid in self._active:
+                self._active[oid] = int(nbytes)
+
+        try:
+            ok = await self._pull_fn(oid, req["deadline"], reserve)
+        except Exception:  # noqa: BLE001 — a failed transfer fails the
+            logger.exception("pull of %s failed", oid.hex()[:12])
+            ok = False
+        self._finish(oid, bool(ok))
+
+    def _finish(self, oid: bytes, ok: bool):
+        self._active.pop(oid, None)
+        req = self._reqs.pop(oid, None)
+        if req is not None and not req["fut"].done():
+            req["fut"].set_result(ok)
+        self._kick.set()

@@ -122,6 +122,24 @@ class Executor(CoreWorker):
             except Exception:
                 logger.exception("executor loop error")
 
+    # blocked-in-get notifications (reference
+    # NotifyDirectCallTaskBlocked): the agent backfills this worker's
+    # pool slot while it waits on nested work
+    def _notify_blocked(self) -> bool:
+        try:
+            self.agent.fire("worker_blocked",
+                            {"worker_id": self.worker_id})
+            return True
+        except Exception:  # noqa: BLE001 — agent teardown
+            return False
+
+    def _notify_unblocked(self) -> None:
+        try:
+            self.agent.fire("worker_unblocked",
+                            {"worker_id": self.worker_id})
+        except Exception:  # noqa: BLE001
+            pass
+
     # ---------- RPC endpoints (called by agent / owners) ----------
 
     async def rpc_execute_task(self, conn, spec):

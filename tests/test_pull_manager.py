@@ -1,0 +1,177 @@
+"""Pull prioritization + store-pressure admission (reference
+src/ray/object_manager/pull_manager.h:52)."""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core import pull_manager as pm
+from ray_tpu.cluster_utils import Cluster
+
+
+class FakeStore:
+    def __init__(self, capacity=1000):
+        self._cap = capacity
+        self.used = 0
+
+    def used_bytes(self):
+        return self.used
+
+    def capacity(self):
+        return self._cap
+
+
+def _run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def test_priority_order_and_escalation():
+    """Task-arg pulls activate before earlier-queued restores; a hot
+    duplicate escalates a queued restore."""
+    order = []
+    gate = None  # first pull blocks until we release it
+
+    async def main():
+        nonlocal gate
+        gate = asyncio.Event()
+
+        async def pull(oid, deadline, reserve):
+            order.append(oid)
+            if oid == b"hold":
+                await gate.wait()
+            return True
+
+        s = pm.PullScheduler(pull, FakeStore(), max_active=1)
+        first = s.request(b"hold", pm.PRI_GET, 10)  # occupies the slot
+        await asyncio.sleep(0.05)
+        r_restore = s.request(b"restore", pm.PRI_RESTORE, 10)
+        r_restore2 = s.request(b"restore2", pm.PRI_RESTORE, 10)
+        r_arg = s.request(b"arg", pm.PRI_TASK_ARG, 10)  # queued LAST
+        s.request(b"restore2", pm.PRI_TASK_ARG, 10)     # escalate
+        await asyncio.sleep(0.05)
+        gate.set()
+        assert await asyncio.wait_for(r_arg, 5)
+        assert await asyncio.wait_for(r_restore, 5)
+        assert await asyncio.wait_for(r_restore2, 5)
+        assert await asyncio.wait_for(first, 5)
+
+    _run(main())
+    assert order[0] == b"hold"
+    # hottest first once the slot frees: arg and the escalated restore2
+    # both run before the plain restore
+    assert order.index(b"arg") < order.index(b"restore")
+    assert order.index(b"restore2") < order.index(b"restore")
+
+
+def test_admission_gates_on_headroom():
+    """With the store above the watermark, only ONE pull is admitted at
+    a time (forward progress), not the full max_active fan-out."""
+    store = FakeStore(capacity=1000)
+    store.used = 900  # above the 0.8 watermark
+    concurrent = []
+    peak = []
+
+    async def main():
+        async def pull(oid, deadline, reserve):
+            reserve(100)
+            concurrent.append(oid)
+            peak.append(len([1 for _ in concurrent]))
+            await asyncio.sleep(0.05)
+            concurrent.remove(oid)
+            return True
+
+        s = pm.PullScheduler(pull, store, max_active=8)
+        futs = [s.request(bytes([i]) * 4, pm.PRI_GET, 10)
+                for i in range(6)]
+        assert all(await asyncio.wait_for(asyncio.gather(*futs), 10))
+
+    _run(main())
+    assert max(peak) == 1  # serialized under pressure
+
+
+def test_admission_fans_out_with_headroom():
+    store = FakeStore(capacity=10_000)
+    active = []
+    peak = []
+
+    async def main():
+        async def pull(oid, deadline, reserve):
+            reserve(10)
+            active.append(oid)
+            peak.append(len(active))
+            await asyncio.sleep(0.05)
+            active.remove(oid)
+            return True
+
+        s = pm.PullScheduler(pull, store, max_active=4)
+        futs = [s.request(bytes([i]) * 4, pm.PRI_GET, 10)
+                for i in range(8)]
+        assert all(await asyncio.wait_for(asyncio.gather(*futs), 10))
+
+    _run(main())
+    assert max(peak) == 4  # capped by max_active, not serialized
+
+
+def test_dedup_and_timeout():
+    async def main():
+        calls = []
+
+        async def pull(oid, deadline, reserve):
+            calls.append(oid)
+            await asyncio.sleep(0.2)
+            return True
+
+        s = pm.PullScheduler(pull, FakeStore(), max_active=2)
+        a = s.request(b"x", pm.PRI_GET, 10)
+        b = s.request(b"x", pm.PRI_GET, 10)
+        assert a is b  # shared future
+        assert await asyncio.wait_for(a, 5)
+        assert calls == [b"x"]
+        # an expired queued request resolves False, doesn't hang
+        blocker_gate = asyncio.Event()
+
+        async def slow_pull(oid, deadline, reserve):
+            await blocker_gate.wait()
+            return True
+
+        s2 = pm.PullScheduler(slow_pull, FakeStore(), max_active=1)
+        s2.request(b"b1", pm.PRI_GET, 30)
+        s2.request(b"b2", pm.PRI_GET, 30)  # fills queue behind b1
+        doomed = s2.request(b"late", pm.PRI_RESTORE, 0.1)
+        assert (await asyncio.wait_for(doomed, 5)) is False
+        blocker_gate.set()
+
+    _run(main())
+
+
+def test_pulls_exceeding_capacity_make_progress():
+    """E2E chaos-under-pressure: a node pulls a working set LARGER than
+    its store; admission + LRU eviction keep every task completing
+    instead of OOM-killing the store."""
+    cap = 32 * 1024 * 1024
+    c = Cluster(head_resources={"CPU": 2, "memory": 2 * 2**30},
+                store_capacity=cap)
+    c.connect()
+    second = c.add_node(resources={"CPU": 2, "memory": 2 * 2**30})
+    try:
+        # 12 x 4MB objects created on the head node = 48MB > 32MB store
+        blobs = [ray_tpu.put(np.full(1024 * 1024, i, np.float32))
+                 for i in range(12)]
+
+        @ray_tpu.remote(num_cpus=2)
+        def consume(x, i):
+            return float(x[0]) == float(i) and x.nbytes == 4 * 1024 * 1024
+
+        # num_cpus=2 forces spillback spread; every dep must be pulled
+        # to whichever node runs the task
+        out = ray_tpu.get(
+            [consume.remote(b, i) for i, b in enumerate(blobs)],
+            timeout=300,
+        )
+        assert all(out), out
+        assert second.store.used_bytes() <= cap
+    finally:
+        c.shutdown()

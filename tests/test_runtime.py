@@ -290,7 +290,10 @@ def test_memory_monitor_kills_newest_task_worker(cluster):
     agent = cluster.head_agent
     deadline = time.time() + 30
     while time.time() < deadline:
-        if any(w.busy_task for w in agent.workers.values()):
+        # pool tasks track in pool_inflight (busy_task is only the
+        # lease/reservation marker since dispatch pipelining)
+        if any(w.busy_task or w.pool_inflight
+               for w in agent.workers.values()):
             break
         time.sleep(0.1)
     fut = asyncio.run_coroutine_threadsafe(

@@ -1,0 +1,27 @@
+"""TSan/ASan gate over the C++ components (SURVEY §4: the reference
+exercises its raylet/plasma C++ under sanitizer configs). The stress
+binaries live in ray_tpu/_native/sanitize/; run.sh builds each under
+ThreadSanitizer and AddressSanitizer+UBSan and fails on any report."""
+
+import json
+import os
+import shutil
+import subprocess
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(ROOT, "ray_tpu", "_native", "sanitize", "run.sh")
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no toolchain")
+def test_sanitizers_clean(tmp_path):
+    out = str(tmp_path / "SANITIZE.json")
+    r = subprocess.run([SCRIPT, out], capture_output=True, text=True,
+                       timeout=1200)
+    assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"
+    summary = json.load(open(out))
+    assert summary["clean"] is True
+    assert {e["target"] for e in summary["results"]} == {
+        "store_tsan", "store_asan", "sched_tsan", "sched_asan"}
+    assert all(e["status"] == "clean" for e in summary["results"])
