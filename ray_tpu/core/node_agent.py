@@ -681,9 +681,17 @@ class NodeAgent:
 
             # blocked workers don't hold a slot: each one parked in
             # get() justifies one replacement (reference releases the
-            # blocked worker's CPU and spawns a backfill)
-            n_pool = sum(1 for w in self.workers.values()
-                         if w.actor_id is None and not w.blocked)
+            # blocked worker's CPU and spawns a backfill) — up to a hard
+            # process ceiling, or unbounded recursion (f blocking on
+            # f.remote() all the way down) re-creates the fork storm the
+            # cap exists to prevent; past the ceiling, work queues.
+            total_pool = sum(1 for w in self.workers.values()
+                             if w.actor_id is None)
+            if total_pool >= 4 * self._pool_worker_cap():
+                n_pool = total_pool  # at ceiling: behave as saturated
+            else:
+                n_pool = sum(1 for w in self.workers.values()
+                             if w.actor_id is None and not w.blocked)
             if n_pool >= self._pool_worker_cap():
                 # no matching idle worker and no room: evict the longest-
                 # idle MISMATCHED pool worker (job/env churn must not
@@ -715,10 +723,12 @@ class NodeAgent:
                             # re-check at RUN time: several refusals can
                             # queue spawns before any executes — only the
                             # ones still under the cap may fork
-                            n = sum(1 for w in self.workers.values()
-                                    if w.actor_id is None
-                                    and not w.blocked)
-                            if n >= self._pool_worker_cap():
+                            pool_ws = [w for w in self.workers.values()
+                                       if w.actor_id is None]
+                            n = sum(1 for w in pool_ws if not w.blocked)
+                            if (n >= self._pool_worker_cap()
+                                    or len(pool_ws)
+                                    >= 4 * self._pool_worker_cap()):
                                 return
                             await self._spawn_worker(
                                 job_id, holds_tpu, runtime_env)
@@ -1561,12 +1571,22 @@ class NodeAgent:
 
     async def rpc_worker_blocked(self, conn, p):
         """Worker parked in get() on nested work (reference
-        NotifyDirectCallTaskBlocked): free its pool slot so dispatch can
-        backfill — N workers blocked on nested tasks must not wedge an
-        N-slot pool."""
+        NotifyDirectCallTaskBlocked): free its pool slot AND the blocked
+        task's granted CPUs so dispatch can backfill — N workers blocked
+        on nested num_cpus>=1 children must not wedge the node on either
+        the slot axis or the resource axis."""
         w = self.workers.get(p["worker_id"])
         if w is not None:
             w.blocked += 1
+            spec = self.running.get(p.get("task_id") or b"")
+            if spec is not None and spec.get("_granted") \
+                    and not spec.get("_blocked_released"):
+                # release while parked; re-taken on unblock (temporary
+                # oversubscription, same as the reference's CPU borrow).
+                # _free_task_resources clears _granted, so a death or
+                # completion in the window cannot double-free.
+                self._free_task_resources(spec)
+                spec["_blocked_released"] = True
             self._signal_worker_free()  # a slot just opened
             self._kick_dispatch()
         return True
@@ -1575,6 +1595,14 @@ class NodeAgent:
         w = self.workers.get(p["worker_id"])
         if w is not None and w.blocked > 0:
             w.blocked -= 1
+        spec = self.running.get(p.get("task_id") or b"")
+        if spec is not None and spec.pop("_blocked_released", None):
+            # re-take even if it drives availability negative: the task
+            # resumes NOW; new grants wait until the pool recovers
+            pool = self._task_pool(spec)
+            if pool is not None:
+                self._take(spec.get("resources", {}), pool)
+                spec["_granted"] = True
         return True
 
     async def rpc_task_done(self, conn, p):

@@ -124,19 +124,27 @@ class Executor(CoreWorker):
 
     # blocked-in-get notifications (reference
     # NotifyDirectCallTaskBlocked): the agent backfills this worker's
-    # pool slot while it waits on nested work
+    # pool slot — and releases the blocked TASK's granted CPUs — while
+    # it waits on nested work. The task id rides along so the agent can
+    # find the grant (thread-local: each exec thread runs one task).
+    _cur_task = threading.local()
+
     def _notify_blocked(self) -> bool:
         try:
-            self.agent.fire("worker_blocked",
-                            {"worker_id": self.worker_id})
+            self.agent.fire("worker_blocked", {
+                "worker_id": self.worker_id,
+                "task_id": getattr(self._cur_task, "tid", None),
+            })
             return True
         except Exception:  # noqa: BLE001 — agent teardown
             return False
 
     def _notify_unblocked(self) -> None:
         try:
-            self.agent.fire("worker_unblocked",
-                            {"worker_id": self.worker_id})
+            self.agent.fire("worker_unblocked", {
+                "worker_id": self.worker_id,
+                "task_id": getattr(self._cur_task, "tid", None),
+            })
         except Exception:  # noqa: BLE001
             pass
 
@@ -498,6 +506,7 @@ class Executor(CoreWorker):
         owner = spec["owner"]
         t_start = time.time()
         _tok = _trace.enter_spec(spec)
+        self._cur_task.tid = spec["task_id"]
         try:
             if spec.get("_invalid"):
                 raise RayTaskError(
@@ -560,6 +569,7 @@ class Executor(CoreWorker):
                                     {"task_id": spec["task_id"]})
             except (rpc.ConnectionLost, rpc.RpcError):
                 pass
+            self._cur_task.tid = None
             if _tok is not None:
                 _trace.reset(_tok)
 

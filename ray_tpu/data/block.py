@@ -31,6 +31,22 @@ def _arrow_table_type():
 def block_rows(block) -> list:
     """Rows of a block: dicts for DataFrames/Tables, items otherwise."""
     if isinstance(block, _arrow_table_type()):
+        from ray_tpu.data.tensor_ext import ArrowTensorType
+
+        if any(isinstance(f.type, ArrowTensorType)
+               for f in block.schema):
+            # tensor-extension columns come back as per-row ndarrays,
+            # not exploded Python lists (tensor_ext.py)
+            cols = {}
+            for name in block.column_names:
+                col = block.column(name).combine_chunks()
+                if isinstance(col.type, ArrowTensorType):
+                    t = col.to_numpy_tensor()
+                    cols[name] = [t[i] for i in range(len(t))]
+                else:
+                    cols[name] = col.to_pylist()
+            n = block.num_rows
+            return [{k: v[i] for k, v in cols.items()} for i in range(n)]
         return block.to_pylist()
     try:
         import pandas as pd
@@ -49,6 +65,19 @@ def build_like(proto, rows: list):
     if isinstance(proto, _arrow_table_type()):
         import pyarrow as pa
 
+        from ray_tpu.data.tensor_ext import ArrowTensorType, tensor_table
+
+        if rows and isinstance(rows[0], dict) and any(
+                isinstance(v, np.ndarray) for v in rows[0].values()):
+            return tensor_table({
+                k: (np.stack([r[k] for r in rows])
+                    if isinstance(rows[0][k], np.ndarray)
+                    else [r[k] for r in rows])
+                for k in rows[0]
+            })
+        if any(isinstance(f.type, ArrowTensorType)
+               for f in proto.schema) and not rows:
+            return proto.slice(0, 0)
         return pa.Table.from_pylist(rows, schema=proto.schema)
     try:
         import pandas as pd

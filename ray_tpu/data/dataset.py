@@ -422,6 +422,137 @@ class Dataset:
 
         return self.map_batches(_add, **kw)
 
+    def select_columns(self, cols: list, **kw) -> "Dataset":
+        """Project to the named columns (reference dataset.py
+        select_columns): native column selection on arrow/pandas blocks,
+        dict projection on row blocks."""
+        cols = list(cols)
+
+        def _select(block):
+            from ray_tpu.data.block import _arrow_table_type, block_rows
+
+            if isinstance(block, _arrow_table_type()):
+                return block.select(cols)
+            try:
+                import pandas as pd
+
+                if isinstance(block, pd.DataFrame):
+                    return block[cols]
+            except ImportError:  # pragma: no cover
+                pass
+            return [{k: r[k] for k in cols} for r in block_rows(block)]
+
+        return self.map_batches(_select, **kw)
+
+    def drop_columns(self, cols: list, **kw) -> "Dataset":
+        """Drop the named columns (reference dataset.py drop_columns)."""
+        cols = set(cols)
+
+        def _drop(block):
+            from ray_tpu.data.block import _arrow_table_type, block_rows
+
+            if isinstance(block, _arrow_table_type()):
+                keep = [c for c in block.column_names if c not in cols]
+                return block.select(keep)
+            try:
+                import pandas as pd
+
+                if isinstance(block, pd.DataFrame):
+                    return block.drop(columns=[c for c in cols
+                                               if c in block.columns])
+            except ImportError:  # pragma: no cover
+                pass
+            return [{k: v for k, v in r.items() if k not in cols}
+                    for r in block_rows(block)]
+
+        return self.map_batches(_drop, **kw)
+
+    def rename_columns(self, mapping: dict, **kw) -> "Dataset":
+        """Rename columns via {old: new} (reference rename_columns)."""
+        mapping = dict(mapping)
+
+        def _rename(block):
+            from ray_tpu.data.block import _arrow_table_type, block_rows
+
+            if isinstance(block, _arrow_table_type()):
+                return block.rename_columns(
+                    [mapping.get(c, c) for c in block.column_names])
+            try:
+                import pandas as pd
+
+                if isinstance(block, pd.DataFrame):
+                    return block.rename(columns=mapping)
+            except ImportError:  # pragma: no cover
+                pass
+            return [{mapping.get(k, k): v for k, v in r.items()}
+                    for r in block_rows(block)]
+
+        return self.map_batches(_rename, **kw)
+
+    def unique(self, key=None) -> list:
+        """Distinct values of a column (or of plain rows) — per-block
+        distinct in tasks, union on the driver (reference unique)."""
+        from ray_tpu.data.shuffle import _keyfn
+
+        kf = _keyfn(key)
+
+        def _distinct(block):
+            from ray_tpu.data.block import block_rows
+
+            return sorted({kf(r) for r in block_rows(block)})
+
+        seen: set = set()
+        for block in self.map_batches(_distinct).iter_batches():
+            seen.update(block)
+        return sorted(seen)
+
+    def random_sample(self, fraction: float, *,
+                      seed: int | None = None) -> "Dataset":
+        """Bernoulli row sample (reference random_sample)."""
+
+        def _sample(block):
+            import numpy as _np
+
+            from ray_tpu.data.block import block_rows, build_like
+            from ray_tpu.utils.hashing import stable_hash
+
+            rows = block_rows(block)
+            if seed is None:
+                rng = _np.random.default_rng()
+            else:
+                # per-block stream derived from the block's CONTENT
+                # boundaries: equal-sized blocks must not share a keep
+                # mask (a plain seed+len would position-correlate the
+                # sample across every block)
+                fp = stable_hash((len(rows),
+                                  repr(rows[0]) if rows else "",
+                                  repr(rows[-1]) if rows else ""))
+                rng = _np.random.default_rng([seed, fp % (2**31)])
+            keep = rng.random(len(rows)) < fraction
+            return build_like(block,
+                              [r for r, k in builtins.zip(rows, keep)
+                               if k])
+
+        return self.map_batches(_sample)
+
+    def columns(self) -> list:
+        """Column names (reference dataset.py columns)."""
+        return list(self.schema().keys())
+
+    def take_all(self, limit: int = 100_000) -> list:
+        """Every row, erroring above `limit` (reference take_all)."""
+        rows: list = []
+        for block in self.iter_batches():
+            rows.extend(block_rows(block))
+            if len(rows) > limit:
+                raise ValueError(
+                    f"take_all: dataset exceeds limit={limit} rows")
+        return rows
+
+    def show(self, n: int = 20) -> None:
+        for row in self.take(n):
+            print(row)
+
     def zip(self, other: "Dataset") -> "Dataset":
         """Row-align two datasets into (row_self, row_other) tuples
         (reference dataset.py zip). Both sides materialize; row counts
@@ -711,7 +842,60 @@ class Dataset:
                 n += 1
         return total / n if n else float("nan")
 
+    def std(self, key=None, ddof: int = 1):
+        """Sample standard deviation (reference dataset.py std): one
+        pass of per-block (n, sum, sumsq) partials."""
+        import math
+
+        n, s, ss = self._moments(key)
+        if n <= ddof:
+            return float("nan")
+        var = (ss - s * s / n) / (n - ddof)
+        return math.sqrt(builtins.max(0.0, var))
+
+    def var(self, key=None, ddof: int = 1):
+        n, s, ss = self._moments(key)
+        if n <= ddof:
+            return float("nan")
+        return (ss - s * s / n) / (n - ddof)
+
+    def _moments(self, key):
+        from ray_tpu.data.shuffle import _keyfn
+
+        kf = _keyfn(key)
+
+        def _partial(block):
+            from ray_tpu.data.block import block_rows
+
+            vals = [float(kf(r)) for r in block_rows(block)]
+            return [(len(vals), builtins.sum(vals),
+                     builtins.sum(v * v for v in vals))]
+
+        n, s, ss = 0, 0.0, 0.0
+        for block in self.map_batches(_partial).iter_batches():
+            for bn, bs, bss in block:
+                n += bn
+                s += bs
+                ss += bss
+        return n, s, ss
+
     # -- interchange --
+
+    def to_numpy(self, column=None) -> np.ndarray:
+        """Materialize as one ndarray; `column` picks a field from
+        tabular rows (tensor-extension columns come back as stacked
+        ndarrays — data/tensor_ext.py)."""
+        parts = []
+        for block in self.iter_batches():
+            if column is None and isinstance(block, np.ndarray):
+                parts.append(block)
+            else:
+                rows = block_rows(block)
+                if column is not None:
+                    parts.append(np.asarray([r[column] for r in rows]))
+                else:
+                    parts.append(np.asarray(rows))
+        return np.concatenate(parts) if parts else np.empty(0)
 
     def to_pandas(self):
         import pandas as pd

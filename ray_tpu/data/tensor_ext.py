@@ -1,0 +1,92 @@
+"""Arrow tensor extension: fixed-shape ndarrays as first-class columns.
+
+Reference: python/ray/air/util/tensor_extensions/arrow.py
+(ArrowTensorType / ArrowTensorArray) — lets tabular blocks carry
+image/embedding columns without exploding them to Python lists. Scaled
+implementation: one extension type ("ray_tpu.tensor") whose storage is a
+list array over the flattened elements, with the per-row shape carried
+on the type; zero-copy to/from numpy for contiguous dtypes.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pyarrow as pa
+
+
+class ArrowTensorType(pa.ExtensionType):
+    """Fixed per-row tensor shape; storage = list_(element dtype)."""
+
+    def __init__(self, shape: tuple, value_type: pa.DataType):
+        self.shape = tuple(int(s) for s in shape)
+        super().__init__(pa.list_(value_type), "ray_tpu.tensor")
+
+    def __arrow_ext_serialize__(self) -> bytes:
+        return json.dumps({"shape": list(self.shape)}).encode()
+
+    @classmethod
+    def __arrow_ext_deserialize__(cls, storage_type, serialized):
+        meta = json.loads(serialized.decode())
+        return cls(tuple(meta["shape"]), storage_type.value_type)
+
+    def __arrow_ext_class__(self):
+        return ArrowTensorArray
+
+    def __str__(self):  # shows up in Dataset.schema()
+        return f"tensor{self.shape}<{self.storage_type.value_type}>"
+
+
+class ArrowTensorArray(pa.ExtensionArray):
+    @staticmethod
+    def from_numpy(arr: np.ndarray) -> "ArrowTensorArray":
+        """[N, *shape] ndarray -> extension array of N tensors."""
+        arr = np.ascontiguousarray(arr)
+        if arr.ndim < 2:
+            arr = arr.reshape(len(arr), 1)
+        n = len(arr)
+        per_row = int(np.prod(arr.shape[1:]))
+        values = pa.array(arr.reshape(-1))
+        offsets = pa.array(
+            np.arange(0, (n + 1) * per_row, per_row, dtype=np.int32))
+        storage = pa.ListArray.from_arrays(offsets, values)
+        typ = ArrowTensorType(arr.shape[1:], values.type)
+        return pa.ExtensionArray.from_storage(typ, storage)
+
+    def to_numpy_tensor(self) -> np.ndarray:
+        """[N, *shape] ndarray (zero-copy when the storage is
+        contiguous and offset-free)."""
+        flat = np.asarray(self.storage.values)
+        return flat.reshape(len(self), *self.type.shape)
+
+
+_registered = False
+
+
+def ensure_registered() -> None:
+    global _registered
+    if _registered:
+        return
+    try:
+        pa.register_extension_type(
+            ArrowTensorType((1,), pa.float64()))
+    except pa.ArrowKeyError:  # another import path registered first
+        pass
+    _registered = True
+
+
+ensure_registered()
+
+
+def tensor_table(columns: dict) -> pa.Table:
+    """Build an arrow Table where ndarray-valued columns become tensor
+    extension columns and everything else goes through pa.array."""
+    arrays, names = [], []
+    for name, col in columns.items():
+        if isinstance(col, np.ndarray) and col.ndim >= 2:
+            arrays.append(ArrowTensorArray.from_numpy(col))
+        else:
+            arrays.append(pa.array(col))
+        names.append(name)
+    return pa.Table.from_arrays(arrays, names=names)

@@ -22,10 +22,12 @@ from ray_tpu.tune.tuner import (  # noqa: F401
 from ray_tpu.tune.trainable import Trainable  # noqa: F401
 from ray_tpu.tune.search import (  # noqa: F401
     BasicVariantGenerator,
+    BOHBSearcher,
     Searcher,
     TPESearcher,
 )
 from ray_tpu.tune.schedulers import (  # noqa: F401
+    PB2,
     HyperBandScheduler,
     MedianStoppingRule,
     PopulationBasedTraining,

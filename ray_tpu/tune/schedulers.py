@@ -159,3 +159,141 @@ class PopulationBasedTraining:
             elif isinstance(cur, (int, float)):
                 out[key] = cur * self._rng.choice((0.8, 1.2))
         return out
+
+
+class PB2(PopulationBasedTraining):
+    """PBT with a GP-bandit explore step (reference schedulers/pb2.py,
+    Parker-Holder et al. 2020 "Provably Efficient Online Hyperparameter
+    Optimization with Population-Based Bandits").
+
+    Where PBT jitters a cloned config by random x0.8/x1.2, PB2 fits a
+    Gaussian process over (normalized hyperparams) -> per-interval score
+    improvement and picks the next config by UCB over candidate points —
+    sample-efficient at small population sizes. Native implementation:
+    RBF-kernel GP with fixed hyperparameters (lengthscale in normalized
+    space), exact solve (populations are small), UCB acquisition over
+    random candidates inside the mutation bounds.
+    """
+
+    def __init__(self, *, kappa: float = 1.5, n_candidates: int = 256,
+                 **kw):
+        super().__init__(**kw)
+        self.kappa = kappa
+        self.n_candidates = n_candidates
+        for key in sorted(self.mutations):
+            self._bounds(key)  # fail HERE on unbounded mutations — a
+            # swallowed per-interval error would silently degrade the
+            # GP to plain PBT jitter forever
+        self._configs: dict[str, dict] = {}      # trial -> current config
+        self._prev_score: dict[str, float] = {}  # trial -> score @last interval
+        self._X: list[list[float]] = []          # normalized configs
+        self._y: list[float] = []                # score improvements
+
+    # Tuner hook (tuner.py _launch): PB2 is config-aware
+    def on_trial_config(self, trial_id: str, config: dict) -> None:
+        self._configs[trial_id] = dict(config)
+        self._prev_score.pop(trial_id, None)  # fresh lineage
+
+    # -- normalized coordinates over the mutation bounds --
+
+    def _dims(self) -> list:
+        return sorted(self.mutations)
+
+    def _bounds(self, key):
+        from ray_tpu.tune.tuner import choice, loguniform, uniform
+
+        spec = self.mutations[key]
+        if isinstance(spec, loguniform):
+            return ("log", math.log(spec.low), math.log(spec.high))
+        if isinstance(spec, uniform):
+            return ("lin", spec.low, spec.high)
+        if isinstance(spec, choice):
+            return ("cat", 0, len(spec.options) - 1)
+        if isinstance(spec, (list, tuple)):
+            return ("cat", 0, len(spec) - 1)
+        raise ValueError(f"PB2 needs bounded mutations; {key!r} is "
+                         f"{type(spec).__name__}")
+
+    def _encode(self, config: dict) -> list[float]:
+        x = []
+        for key in self._dims():
+            kind, lo, hi = self._bounds(key)
+            v = config.get(key)
+            if kind == "cat":
+                opts = (self.mutations[key].options
+                        if hasattr(self.mutations[key], "options")
+                        else list(self.mutations[key]))
+                idx = opts.index(v) if v in opts else 0
+                x.append(idx / max(1, len(opts) - 1))
+            else:
+                fv = math.log(v) if kind == "log" else float(v)
+                x.append((fv - lo) / (hi - lo) if hi > lo else 0.5)
+        return x
+
+    def _decode(self, x: list[float]) -> dict:
+        out = {}
+        for key, u in zip(self._dims(), x):
+            kind, lo, hi = self._bounds(key)
+            if kind == "cat":
+                opts = (self.mutations[key].options
+                        if hasattr(self.mutations[key], "options")
+                        else list(self.mutations[key]))
+                out[key] = opts[int(round(u * (len(opts) - 1)))]
+            else:
+                fv = lo + u * (hi - lo)
+                out[key] = math.exp(fv) if kind == "log" else fv
+        return out
+
+    # -- observation collection --
+
+    def on_result(self, trial_id: str, iteration: int, value: float):
+        decision = super().on_result(trial_id, iteration, value)
+        score = value if self.mode == "min" else -value
+        if self.interval > 0 and iteration % self.interval == 0:
+            prev = self._prev_score.get(trial_id)
+            cfg = self._configs.get(trial_id)
+            if prev is not None and cfg is not None:
+                try:
+                    # improvement = how much the score DROPPED this
+                    # interval under this config (min-is-better space)
+                    self._X.append(self._encode(cfg))
+                    self._y.append(prev - score)
+                except ValueError:
+                    pass  # config outside the mutation vocabulary
+            self._prev_score[trial_id] = score
+        return decision
+
+    # -- GP-UCB explore --
+
+    def explore(self, config: dict) -> dict:
+        if len(self._y) < 3:  # cold start: fall back to PBT jitter
+            return super().explore(config)
+        import numpy as np
+
+        X = np.asarray(self._X, dtype=np.float64)
+        y = np.asarray(self._y, dtype=np.float64)
+        y_mu, y_sd = y.mean(), y.std() + 1e-9
+        yn = (y - y_mu) / y_sd
+        ls, sf2, sn2 = 0.3, 1.0, 0.1
+
+        def k(a, b):
+            d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+            return sf2 * np.exp(-0.5 * d2 / ls**2)
+
+        K = k(X, X) + sn2 * np.eye(len(X))
+        L = np.linalg.cholesky(K)
+        alpha = np.linalg.solve(L.T, np.linalg.solve(L, yn))
+        rng = np.random.default_rng(self._rng.randrange(2**31))
+        cand = rng.random((self.n_candidates, X.shape[1]))
+        # keep the donor's point in the pool: UCB should only move away
+        # from it when the model believes in a better region
+        cand[0] = np.asarray(self._encode(config))
+        Ks = k(cand, X)
+        mu = Ks @ alpha
+        v = np.linalg.solve(L, Ks.T)
+        var = np.maximum(1e-12, sf2 - (v**2).sum(0))
+        ucb = mu + self.kappa * np.sqrt(var)
+        best = self._decode([float(u) for u in cand[int(ucb.argmax())]])
+        out = dict(config)
+        out.update(best)
+        return out

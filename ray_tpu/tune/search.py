@@ -203,3 +203,61 @@ class TPESearcher(Searcher):
         return max(opts, key=lambda o: wg[o] / wb[o])
 
 
+
+
+class BOHBSearcher(TPESearcher):
+    """BOHB's model component (reference search/bohb/bohb_search.py +
+    Falkner et al. 2018): TPE-style KDE models kept PER BUDGET, with
+    suggestions drawn from the model of the LARGEST budget that has
+    enough observations — early (cheap, plentiful) results guide search
+    until enough full-budget results exist, then the high-fidelity model
+    takes over. Pair with HyperBandScheduler for the bracket side of
+    BOHB (the reference pairs TuneBOHB with HB-BOHB the same way).
+
+    Observations land per budget via on_trial_complete(result) where
+    result carries `training_iteration` (the budget proxy) — a trial
+    stopped early by a bracket contributes to the low-budget model, a
+    survivor to the high-budget one.
+    """
+
+    def __init__(self, *, min_points_in_model: int | None = None, **kw):
+        super().__init__(**kw)
+        self.min_points = min_points_in_model
+        self._budget_obs: dict[int, list] = {}  # budget -> [(cfg, score)]
+
+    def on_trial_complete(self, trial_id: str,
+                          result: dict | None = None) -> None:
+        if not result or self.metric not in result:
+            return
+        val = float(result[self.metric])
+        score = val if self.mode == "min" else -val
+        cfg = result.get("config")
+        if cfg is None:
+            return
+        budget = int(result.get("training_iteration", 1))
+        self._budget_obs.setdefault(budget, []).append((cfg, score))
+
+    def _model_obs(self) -> list:
+        """Observations of the largest budget with enough points."""
+        need = self.min_points or (len(self._space or {}) + 1)
+        for budget in sorted(self._budget_obs, reverse=True):
+            obs = self._budget_obs[budget]
+            if len(obs) >= max(need, self.n_startup):
+                return obs
+        return []
+
+    def suggest(self, trial_id: str) -> dict | None:
+        assert self._space is not None, "call set_space first"
+        self._count += 1
+        obs = self._model_obs()
+        if not obs:
+            return _sample_config(self._space, self._rng)
+        # reuse the TPE machinery against the chosen budget's model
+        self._obs = obs
+        good, bad = self._split()
+        out = {}
+        for name, dim in self._space.items():
+            gv = [c[name] for c, _ in good]
+            bv = [c[name] for c, _ in bad]
+            out[name] = self._suggest_dim(dim, gv, bv)
+        return out

@@ -406,6 +406,10 @@ class Tuner:
             running[idx] = {"actor": actor, "config": config,
                             "iteration": iteration, "last": None,
                             "ckpt": resume_checkpoint}
+            if sched is not None and hasattr(sched, "on_trial_config"):
+                # config-aware schedulers (PB2's GP needs x for its
+                # (config, reward-delta) observations)
+                sched.on_trial_config(f"trial_{idx:04d}", config)
             trials[idx] = {"config": config, "status": "running",
                            "iteration": iteration, "last": None,
                            "ckpt_path": _ckpt_path(resume_checkpoint)}

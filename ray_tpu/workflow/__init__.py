@@ -9,4 +9,16 @@ resumes where it stopped.
     result = workflow.resume("w1", storage="/path")   # after a crash
 """
 
-from ray_tpu.workflow.execution import resume, run  # noqa: F401
+from ray_tpu.workflow.execution import (  # noqa: F401
+    Continuation,
+    EventListener,
+    FileEventListener,
+    continuation,
+    list_workflows,
+    options,
+    post_event,
+    resume,
+    run,
+    wait,
+    wait_for_event,
+)

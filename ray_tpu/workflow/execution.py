@@ -6,14 +6,31 @@ Completed steps live as pickles under <storage>/<workflow_id>/; execution
 submits only missing steps as remote tasks (reference
 workflow_executor.py + workflow_storage.py, scaled to filesystem
 storage — the reference's default is the same local/NFS layout).
+
+Depth beyond plain run/resume (reference python/ray/workflow/api.py):
+
+* per-step options — ``workflow.options(node, max_retries=…,
+  catch_exceptions=…)`` (reference workflow/common.py WorkflowStepOptions)
+* continuations — a step that RETURNS ``workflow.continuation(dag)``
+  tail-calls into another durable DAG (reference workflow continuation
+  semantics); the continued steps checkpoint under the parent step's path
+* ``workflow.wait(branches, num_returns, timeout_s)`` — run branches
+  concurrently, durable at branch granularity, returns
+  (ready_values, pending_branches) where pending branches feed a later
+  continuation (reference api.py wait)
+* events — ``workflow.wait_for_event(Listener, …)`` is a durable step
+  that blocks until the listener yields; once checkpointed a resume does
+  NOT re-wait (reference event listener protocol + workflow/event.py)
 """
 
 from __future__ import annotations
 
 import hashlib
 import os
-import cloudpickle
+import time
 from typing import Any
+
+import cloudpickle
 
 import ray_tpu
 from ray_tpu.dag.dag_node import DAGNode, InputNode
@@ -21,12 +38,128 @@ from ray_tpu.dag.dag_node import DAGNode, InputNode
 
 def _step_id(node: DAGNode, path: str) -> str:
     name = getattr(node._remote_fn, "__name__", "step")
+    opts = getattr(node, "_wf_options", None) or {}
+    name = opts.get("name") or name
     h = hashlib.blake2b(f"{path}:{name}".encode(), digest_size=8)
     return f"{name}_{h.hexdigest()}"
 
 
+class Continuation:
+    """A step's tail call into another durable DAG (returned from inside
+    a step via ``workflow.continuation(dag)``)."""
+
+    def __init__(self, dag: DAGNode):
+        if not isinstance(dag, DAGNode):
+            raise TypeError("continuation() takes a bound DAG node")
+        self.dag = dag
+
+
+def continuation(dag: DAGNode) -> Continuation:
+    return Continuation(dag)
+
+
+def options(node: DAGNode, *, max_retries: int | None = None,
+            catch_exceptions: bool | None = None,
+            name: str | None = None) -> DAGNode:
+    """Attach workflow-level step options to a bound node.
+
+    max_retries: workflow-driver resubmits ON TOP of the runtime's own
+    task retries. catch_exceptions: the step's durable value becomes
+    (result, None) on success or (None, exception) on failure instead of
+    raising. name: overrides the step-id stem (stable ids across code
+    moves)."""
+    node._wf_options = {
+        k: v for k, v in (("max_retries", max_retries),
+                          ("catch_exceptions", catch_exceptions),
+                          ("name", name)) if v is not None
+    }
+    return node
+
+
+class WaitNode(DAGNode):
+    """Concurrent sub-branches with partial-completion semantics."""
+
+    def __init__(self, branches: list[DAGNode], num_returns: int,
+                 timeout_s: float | None):
+        super().__init__(None, tuple(branches), {})
+        self.num_returns = num_returns
+        self.timeout_s = timeout_s
+
+
+def wait(branches: list[DAGNode], *, num_returns: int = 1,
+         timeout_s: float | None = None) -> WaitNode:
+    """Bind a wait over concurrently-executed branches. Executing it
+    yields (ready_values, pending_branches); pending branches are plain
+    bound nodes — feed them into a later run()/continuation to keep
+    waiting durably."""
+    return WaitNode(list(branches), num_returns, timeout_s)
+
+
+class EventListener:
+    """Subclass + implement poll_for_event() (blocking, returns the
+    event payload). Runs inside a task; must be picklable."""
+
+    def poll_for_event(self):  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class FileEventListener(EventListener):
+    """Waits for a file to exist; its contents are the event payload
+    (the simplest cross-process event channel; post_event writes it)."""
+
+    def __init__(self, path: str, poll_s: float = 0.2):
+        self.path = path
+        self.poll_s = poll_s
+
+    def poll_for_event(self):
+        while not os.path.exists(self.path):
+            time.sleep(self.poll_s)
+        with open(self.path, "rb") as f:
+            data = f.read()
+        try:
+            return cloudpickle.loads(data)
+        except Exception:  # noqa: BLE001 — raw (non-pickle) payload
+            return data
+
+
+def post_event(storage: str, workflow_id: str, key: str, payload) -> None:
+    """Deliver an event a workflow is (or will be) waiting on."""
+    d = os.path.join(storage, workflow_id, "__events")
+    os.makedirs(d, exist_ok=True)
+    tmp = os.path.join(d, key + ".tmp")
+    with open(tmp, "wb") as f:
+        f.write(cloudpickle.dumps(payload))
+    os.replace(tmp, os.path.join(d, key))
+
+
+class _EventNode(DAGNode):
+    def __init__(self, listener_factory, args, kwargs, name):
+        super().__init__(None, args, kwargs)
+        self._listener_factory = listener_factory
+        self._event_name = name
+
+
+def wait_for_event(listener_cls_or_key, *args, **kwargs) -> DAGNode:
+    """Durable event step. Either a listener class
+    (``wait_for_event(MyListener, arg…)``) or a plain string key, which
+    waits on ``post_event(storage, workflow_id, key, payload)``."""
+    if isinstance(listener_cls_or_key, str):
+        key = listener_cls_or_key
+        return _EventNode(None, (), {}, key)
+    return _EventNode(listener_cls_or_key, args, kwargs,
+                      getattr(listener_cls_or_key, "__name__", "event"))
+
+
+@ray_tpu.remote(num_cpus=0)
+def _poll_event_task(listener_blob: bytes):
+    listener = cloudpickle.loads(listener_blob)
+    return listener.poll_for_event()
+
+
 class _Store:
     def __init__(self, storage: str, workflow_id: str):
+        self.storage = storage
+        self.workflow_id = workflow_id
         self.dir = os.path.join(storage, workflow_id)
         os.makedirs(self.dir, exist_ok=True)
 
@@ -54,6 +187,40 @@ class _Store:
         return self.load(sid) if self.has(sid) else None
 
 
+def _run_step(node: DAGNode, sid: str, args: tuple, kwargs: dict,
+              store: _Store, path: str, input_args: tuple,
+              step_timeout_s: float | None):
+    """One durable step: runtime task + workflow-level retry/catch +
+    continuation chasing."""
+    opts = getattr(node, "_wf_options", None) or {}
+    retries_left = int(opts.get("max_retries", 0))
+    catch = bool(opts.get("catch_exceptions", False))
+    while True:
+        try:
+            value = ray_tpu.get(node._remote_fn.remote(*args, **kwargs),
+                                timeout=step_timeout_s)
+            break
+        except Exception as e:  # noqa: BLE001 — step failure policy
+            if retries_left > 0:
+                retries_left -= 1
+                continue
+            if catch:
+                store.save(sid, (None, e))
+                return (None, e)
+            raise
+    # tail call: the step returned a continuation — keep executing
+    # durably under this step's path, and only then persist the final
+    # value as THIS step's result (resume replays nothing)
+    hops = 0
+    while isinstance(value, Continuation):
+        hops += 1
+        value = _execute(value.dag, store, input_args,
+                         f"{path}@cont{hops}", {}, step_timeout_s)
+    value = (value, None) if catch else value
+    store.save(sid, value)
+    return value
+
+
 def _execute(node, store: _Store, input_args: tuple, path: str,
              cache: dict, step_timeout_s: float | None) -> Any:
     if not isinstance(node, DAGNode):
@@ -62,6 +229,70 @@ def _execute(node, store: _Store, input_args: tuple, path: str,
         return input_args[node._index]
     if id(node) in cache:
         return cache[id(node)]
+
+    if isinstance(node, _EventNode):
+        sid = f"event_{node._event_name}_" + hashlib.blake2b(
+            path.encode(), digest_size=8).hexdigest()
+        if store.has(sid):
+            value = store.load(sid)  # resume does NOT re-wait
+        else:
+            if node._listener_factory is None:
+                listener = FileEventListener(os.path.join(
+                    store.dir, "__events", node._event_name))
+            else:
+                largs = tuple(
+                    _execute(a, store, input_args, f"{path}/{i}", cache,
+                             step_timeout_s)
+                    for i, a in enumerate(node._args))
+                listener = node._listener_factory(*largs, **node._kwargs)
+            value = ray_tpu.get(
+                _poll_event_task.remote(cloudpickle.dumps(listener)),
+                timeout=step_timeout_s,
+            )
+            store.save(sid, value)
+        cache[id(node)] = value
+        return value
+
+    if isinstance(node, WaitNode):
+        sid_of = {}
+        missing, ready_vals = [], []
+        for i, br in enumerate(node._args):
+            if not isinstance(br, DAGNode):
+                ready_vals.append(br)
+                continue
+            bsid = _step_id(br, f"{path}/wait{i}")
+            sid_of[i] = bsid
+            if store.has(bsid):
+                ready_vals.append(store.load(bsid))
+            else:
+                missing.append((i, br))
+        if len(ready_vals) >= node.num_returns:
+            # already satisfied (e.g. a resume): do NOT launch the
+            # pending branches — re-running side-effecting work whose
+            # result would be discarded breaks the replays-nothing
+            # contract
+            value = (ready_vals, [br for _, br in missing])
+            cache[id(node)] = value
+            return value
+        # concurrent branches: durable at BRANCH granularity (the branch
+        # graph executes as raw refs; its root result is the checkpoint
+        # unit)
+        refs = [(i, br.execute(*input_args)) for i, br in missing]
+        need = max(0, node.num_returns - len(ready_vals))
+        ready_refs, rest = ray_tpu.wait(
+            [r for _, r in refs], num_returns=need,
+            timeout=node.timeout_s)
+        by_ref = {r: i for i, r in refs}
+        for r in ready_refs:
+            i = by_ref[r]
+            v = ray_tpu.get(r, timeout=step_timeout_s)
+            store.save(sid_of[i], v)
+            ready_vals.append(v)
+        pending = [node._args[by_ref[r]] for r in rest]
+        value = (ready_vals, pending)
+        cache[id(node)] = value
+        return value
+
     sid = _step_id(node, path)
     if store.has(sid):
         value = store.load(sid)
@@ -77,9 +308,8 @@ def _execute(node, store: _Store, input_args: tuple, path: str,
                     step_timeout_s)
         for k, v in node._kwargs.items()
     }
-    value = ray_tpu.get(node._remote_fn.remote(*args, **kwargs),
-                        timeout=step_timeout_s)
-    store.save(sid, value)
+    value = _run_step(node, sid, args, kwargs, store, path, input_args,
+                      step_timeout_s)
     cache[id(node)] = value
     return value
 
@@ -119,3 +349,21 @@ def resume(workflow_id: str, *, storage: str,
     args = store.load_meta("args") or ()
     return run(dag, workflow_id=workflow_id, storage=storage,
                args=tuple(args), step_timeout_s=step_timeout_s)
+
+
+def list_workflows(storage: str) -> list[dict]:
+    """(id, status) of every workflow under `storage` (reference
+    workflow.list_all): SUCCESSFUL once a result meta exists, RESUMABLE
+    otherwise."""
+    out = []
+    if not os.path.isdir(storage):
+        return out
+    for wid in sorted(os.listdir(storage)):
+        d = os.path.join(storage, wid)
+        if not os.path.isdir(d) or not os.path.exists(
+                os.path.join(d, "__args.pkl")):
+            continue
+        status = ("SUCCESSFUL" if os.path.exists(
+            os.path.join(d, "__result.pkl")) else "RESUMABLE")
+        out.append({"workflow_id": wid, "status": status})
+    return out

@@ -1,0 +1,53 @@
+"""Nested-task blocking: workers parked in get() release their pool
+slot AND their granted CPUs (reference NotifyDirectCallTaskBlocked +
+CPU borrow), so parents blocked on children can't wedge the node on
+either axis."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+def test_cpu_holding_parents_dont_deadlock_children():
+    """2-CPU node, two num_cpus=1 parents each blocked on a num_cpus=1
+    child: without blocked-task resource release the children never fit
+    and the cluster hangs forever."""
+    c = Cluster(head_resources={"CPU": 2, "memory": 2 * 2**30})
+    c.connect()
+    try:
+        @ray_tpu.remote(num_cpus=1)
+        def child(x):
+            return x * 10
+
+        @ray_tpu.remote(num_cpus=1)
+        def parent(x):
+            return ray_tpu.get(child.remote(x), timeout=90)
+
+        out = ray_tpu.get([parent.remote(1), parent.remote(2)],
+                          timeout=120)
+        assert out == [10, 20]
+    finally:
+        c.shutdown()
+
+
+def test_recursion_depth_bounded_by_process_ceiling():
+    """Deep blocking recursion must not fork-storm the host: the
+    backfill spawning stops at the hard ceiling (4x pool cap)."""
+    c = Cluster(head_resources={"CPU": 2, "memory": 2 * 2**30})
+    c.connect()
+    try:
+        @ray_tpu.remote(num_cpus=0)
+        def rec(n):
+            if n == 0:
+                return 0
+            return 1 + ray_tpu.get(rec.remote(n - 1), timeout=120)
+
+        depth = 6  # well under the ceiling: must complete
+        assert ray_tpu.get(rec.remote(depth), timeout=120) == depth
+        agent = c.head_agent
+        n_pool = sum(1 for w in agent.workers.values()
+                     if w.actor_id is None)
+        assert n_pool <= 4 * agent._pool_worker_cap()
+    finally:
+        c.shutdown()

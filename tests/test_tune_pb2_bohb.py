@@ -1,0 +1,122 @@
+"""PB2 (GP-bandit PBT) and BOHB (multi-fidelity TPE) — native
+model-based search (reference tune/schedulers/pb2.py,
+tune/search/bohb/bohb_search.py)."""
+
+import math
+import random
+import statistics
+
+from ray_tpu import tune
+from ray_tpu.tune.schedulers import PB2
+
+
+def _drive_pbt_like(sched, *, f, n_trials=6, intervals=8, seed=0):
+    """Minimal PBT population loop: each trial holds an lr; per interval
+    the score is f(lr) + noise; exploit decisions clone+explore exactly
+    like the Tuner does. Returns the best score seen."""
+    rng = random.Random(seed)
+    configs = {f"trial_{i:04d}": {"lr": 10 ** rng.uniform(-5, -1)}
+               for i in range(n_trials)}
+    for t, c in configs.items():
+        sched.on_trial_config(t, c) if hasattr(
+            sched, "on_trial_config") else None
+    best = float("inf")
+    for it in range(1, intervals + 1):
+        for t, cfg in list(configs.items()):
+            score = f(cfg["lr"]) + rng.gauss(0, 0.01)
+            best = min(best, score)
+            decision = sched.on_result(t, it, score)
+            if isinstance(decision, tuple) and decision[0] == "exploit":
+                donor = decision[1]
+                new_cfg = sched.explore(dict(configs[donor]))
+                configs[t] = new_cfg
+                if hasattr(sched, "on_trial_config"):
+                    sched.on_trial_config(t, new_cfg)
+    return best
+
+
+def test_pb2_beats_pbt_on_seeded_quadratic():
+    """Median best score over seeds: PB2's GP-guided exploration finds
+    the optimum lr faster than PBT's random x0.8/x1.2 jitter."""
+
+    def f(lr):
+        return (math.log10(lr) + 3.0) ** 2  # optimum at lr=1e-3
+
+    muts = {"lr": tune.loguniform(1e-5, 1e-1)}
+    pbt_bests, pb2_bests = [], []
+    for seed in range(8):
+        pbt = tune.PopulationBasedTraining(
+            metric="loss", mode="min", perturbation_interval=2,
+            hyperparam_mutations=muts, seed=seed)
+        pbt_bests.append(_drive_pbt_like(pbt, f=f, seed=seed))
+        pb2 = PB2(metric="loss", mode="min", perturbation_interval=2,
+                  hyperparam_mutations=muts, seed=seed)
+        pb2_bests.append(_drive_pbt_like(pb2, f=f, seed=seed))
+    assert statistics.median(pb2_bests) <= statistics.median(pbt_bests), (
+        sorted(pb2_bests), sorted(pbt_bests))
+
+
+def test_pb2_explore_uses_gp_after_warmup():
+    muts = {"lr": tune.loguniform(1e-5, 1e-1)}
+    pb2 = PB2(metric="loss", mode="min", perturbation_interval=1,
+              hyperparam_mutations=muts, seed=0)
+    # feed observations: configs near lr=1e-3 improve a lot, far ones
+    # not at all
+    for i, lr in enumerate([1e-5, 1e-4, 1e-3, 2e-3, 1e-2, 1e-1]):
+        t = f"trial_{i:04d}"
+        pb2.on_trial_config(t, {"lr": lr})
+        improvement = 1.0 - min(1.0, abs(math.log10(lr) + 3.0))
+        pb2.on_result(t, 1, 5.0)              # baseline score
+        pb2.on_result(t, 2, 5.0 - improvement)  # delta observed at t=2
+    out = pb2.explore({"lr": 1e-5})
+    # GP-UCB should move lr toward the productive region, far from the
+    # donor's 1e-5 (plain PBT could only reach 0.8e-5..1.2e-5)
+    assert out["lr"] > 1e-4, out
+
+
+def test_bohb_uses_highest_informative_budget():
+    space = {"x": tune.uniform(-2.0, 2.0)}
+    s = tune.BOHBSearcher(metric="loss", mode="min", n_startup_trials=3,
+                          seed=1, min_points_in_model=3)
+    s.set_space(space)
+    # low-budget model says x≈-1 is good (misleading); high-budget says
+    # x≈+1. With enough high-budget points the model must follow them.
+    rng = random.Random(0)
+    for i in range(12):
+        x = rng.uniform(-2, 2)
+        s.on_trial_complete(f"lo{i}", {
+            "loss": (x + 1.0) ** 2, "config": {"x": x},
+            "training_iteration": 1})
+    for i in range(8):
+        x = rng.uniform(-2, 2)
+        s.on_trial_complete(f"hi{i}", {
+            "loss": (x - 1.0) ** 2, "config": {"x": x},
+            "training_iteration": 9})
+    xs = [s.suggest(f"t{i}")["x"] for i in range(16)]
+    mean_x = sum(xs) / len(xs)
+    assert mean_x > 0.0, xs  # pulled toward the high-budget optimum
+
+
+def test_bohb_beats_random_on_quadratic():
+    def f(x):
+        return (x - 0.3) ** 2
+
+    space = {"x": tune.uniform(-2.0, 2.0)}
+    random_bests, bohb_bests = [], []
+    for seed in range(8):
+        rng = random.Random(seed)
+        random_bests.append(
+            min(f(space["x"].sample(rng)) for _ in range(30)))
+        s = tune.BOHBSearcher(metric="loss", mode="min",
+                              n_startup_trials=4, seed=seed)
+        s.set_space(space)
+        best = float("inf")
+        for i in range(15):
+            cfg = s.suggest(f"t{i}")
+            loss = f(cfg["x"])
+            best = min(best, loss)
+            s.on_trial_complete(f"t{i}", {
+                "loss": loss, "config": cfg, "training_iteration": 5})
+        bohb_bests.append(best)
+    assert statistics.median(bohb_bests) < statistics.median(random_bests), (
+        sorted(bohb_bests), sorted(random_bests))

@@ -64,3 +64,118 @@ def test_workflow_resume_completes_missing_steps(cluster, tmp_path):
     # structural path), but resume of the full dag picks up its own steps
     workflow.run(dag, workflow_id="wf3", storage=str(tmp_path))
     assert workflow.resume("wf3", storage=str(tmp_path)) == 15
+
+
+def test_step_options_retry_and_catch(cluster, tmp_path):
+    attempts = tmp_path / "attempts"
+    attempts.write_text("0")
+
+    @ray_tpu.remote(max_retries=0)
+    def flaky(path, fail_times):
+        n = int(open(path).read())
+        open(path, "w").write(str(n + 1))
+        if n < fail_times:
+            raise RuntimeError(f"attempt {n} fails")
+        return "recovered"
+
+    # workflow-level retries resubmit past runtime failures
+    node = workflow.options(flaky.bind(str(attempts), 2), max_retries=3)
+    out = workflow.run(node, workflow_id="wopt1", storage=str(tmp_path))
+    assert out == "recovered"
+    assert int(attempts.read_text()) == 3
+
+    # catch_exceptions: failure becomes a durable (None, exc) value
+    attempts2 = tmp_path / "attempts2"
+    attempts2.write_text("0")
+    node2 = workflow.options(flaky.bind(str(attempts2), 99),
+                             catch_exceptions=True)
+    val, err = workflow.run(node2, workflow_id="wopt2",
+                            storage=str(tmp_path))
+    assert val is None and isinstance(err, Exception)
+    # and the caught outcome is durable: re-run replays, no new attempts
+    n_before = int(attempts2.read_text())
+    val2, err2 = workflow.run(node2, workflow_id="wopt2",
+                              storage=str(tmp_path))
+    assert val2 is None and isinstance(err2, Exception)
+    assert int(attempts2.read_text()) == n_before
+
+
+def test_continuation_tail_call(cluster, tmp_path):
+    execs = tmp_path / "execs"
+    execs.write_text("")
+
+    @ray_tpu.remote
+    def mark(tag, v):
+        with open(str(execs), "a") as f:
+            f.write(tag + ",")
+        return v
+
+    @ray_tpu.remote
+    def fib_like(path, n, acc):
+        from ray_tpu import workflow as wf
+        with open(path, "a") as f:
+            f.write(f"fib{n},")
+        if n == 0:
+            return acc
+        return wf.continuation(fib_like.bind(path, n - 1, acc + n))
+
+    dag = mark.bind("post", fib_like.bind(str(execs), 3, 0))
+    out = workflow.run(dag, workflow_id="wcont", storage=str(tmp_path))
+    assert out == 6  # 3+2+1
+    first = execs.read_text()
+    assert "fib3," in first and "fib0," in first
+    # durable: replay executes nothing new
+    out2 = workflow.run(dag, workflow_id="wcont", storage=str(tmp_path))
+    assert out2 == 6
+    assert execs.read_text() == first
+
+
+def test_workflow_wait_partial_and_later_completion(cluster, tmp_path):
+    @ray_tpu.remote
+    def fast():
+        return "fast"
+
+    @ray_tpu.remote
+    def slow():
+        import time as _t
+
+        _t.sleep(5)
+        return "slow"
+
+    w = workflow.wait([fast.bind(), slow.bind()], num_returns=1,
+                      timeout_s=30)
+    ready, pending = workflow.run(w, workflow_id="wwait",
+                                  storage=str(tmp_path))
+    assert ready == ["fast"]
+    assert len(pending) == 1
+    # the pending branch runs to completion in a follow-up workflow
+    done = workflow.run(pending[0], workflow_id="wwait2",
+                        storage=str(tmp_path))
+    assert done == "slow"
+
+
+def test_event_step_durable(cluster, tmp_path):
+    import threading
+
+    @ray_tpu.remote
+    def combine(ev, suffix):
+        return f"{ev}-{suffix}"
+
+    dag = combine.bind(workflow.wait_for_event("go"), "done")
+
+    def poster():
+        import time as _t
+
+        _t.sleep(1.0)
+        workflow.post_event(str(tmp_path), "wev", "go", "fired")
+
+    t = threading.Thread(target=poster)
+    t.start()
+    out = workflow.run(dag, workflow_id="wev", storage=str(tmp_path),
+                       step_timeout_s=60)
+    t.join()
+    assert out == "fired-done"
+    # resume does NOT re-wait: no new post needed
+    assert workflow.resume("wev", storage=str(tmp_path)) == "fired-done"
+    assert any(w["workflow_id"] == "wev" and w["status"] == "SUCCESSFUL"
+               for w in workflow.list_workflows(str(tmp_path)))
