@@ -75,8 +75,19 @@ _DEFS: dict[str, Any] = {
     "flash_block_q": 1024,  # v5e-tuned round 3: fewer, bigger grid cells
     "flash_block_k": 1024,  # win — per-cell overhead dominates at T=2048
     # single-pass fwd: q-heads computed per grid cell (1 = off); divides
-    # n_heads, MHA only — amortizes per-cell overhead further
-    "flash_heads_per_block": 1,
+    # n_heads, MHA only — amortizes per-cell overhead further. v5e
+    # round-5 sweep at 350M/T=2048: 4 wins (0.455 MFU vs 0.443 at 1,
+    # 0.447 at 2, 0.442 at 8 — VMEM pressure kills pipelining past 4).
+    "flash_heads_per_block": 4,
+    # fused-backward analog (MHA only, divides n_heads). Off by default:
+    # measured at 350M/T=2048 the bwd's ~3x-larger tile set loses more to
+    # VMEM pressure than the cell-count amortization wins (0.4615 vs
+    # 0.4687 MFU back-to-back); the knob stays for other shapes.
+    "flash_bwd_heads_per_block": 1,
+    # mosaic scoped-VMEM ceiling for the flash kernels (MB). The default
+    # scoped limit is 16MB but v5e physically has 128MB VMEM; multi-head
+    # cells need the headroom for their [bq, s] f32 intermediates.
+    "flash_vmem_limit_mb": 96,
     # -- memory monitor --
     "memory_monitor_interval_s": 2.0,
     "memory_usage_kill_fraction": 0.95,  # memory_monitor.h:52 analog

@@ -40,6 +40,29 @@ _NEG_INF = -1e30
 _LOG2E = 1.4426950408889634
 
 
+def _heads_per_block(flag: str, hq: int, group: int) -> int:
+    """Clamped heads-per-grid-cell for `flag`: must divide hq, MHA only
+    (the kv-group remap inside a multi-head block isn't worth the edge
+    cases — MHA is the bench-critical shape). One helper so the forward
+    and fused-backward eligibility rules can't diverge."""
+    from ray_tpu._private import config as _cfg
+
+    hb = max(1, _cfg.get(flag))
+    while hb > 1 and (hq % hb or group > 1):
+        hb //= 2
+    return hb
+
+
+def _vmem_limit() -> int:
+    """Scoped-VMEM ceiling for mosaic (bytes). The compiler's 16MB default
+    is far under the 128MB a v5e core physically has; the multi-head
+    single-pass forward needs the headroom for its per-head [bq, s] f32
+    score/probability intermediates."""
+    from ray_tpu._private import config as _cfg
+
+    return int(_cfg.get("flash_vmem_limit_mb")) * 1024 * 1024
+
+
 def _causal_mask(s, q_start, k_start, offset):
     """End-aligned causal mask: query row i attends keys <= i + offset."""
     rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + q_start
@@ -207,14 +230,7 @@ def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
     nk = cdiv(s, block_k)
 
     if nk == 1:
-        from ray_tpu._private import config as _cfg
-
-        hb = max(1, _cfg.get("flash_heads_per_block"))
-        # heads-per-cell must divide hq; GQA keeps per-head cells (the
-        # kv-group remap inside a multi-head block isn't worth the edge
-        # cases — MHA is the bench-critical shape)
-        while hb > 1 and (hq % hb or group > 1):
-            hb //= 2
+        hb = _heads_per_block("flash_heads_per_block", hq, group)
         kernel = functools.partial(
             _fwd_kernel_1pass, causal=causal, scale=scale,
             block_q=block_q, offset=s - t, heads_per_block=hb,
@@ -289,6 +305,7 @@ def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
         # the innermost k dim carries scratch state.
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=dims,
+            vmem_limit_bytes=_vmem_limit(),
         ),
         interpret=interpret,
     )(q, k, v)
@@ -414,8 +431,14 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse2_ref, delta_ref,
                       dqp_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
-                      causal, scale, block_q, block_k, offset):
-    """Fused dq/dk/dv backward: grid (b, hq, ik, iq), iq innermost.
+                      causal, scale, block_q, block_k, offset,
+                      heads_per_block=1):
+    """Fused dq/dk/dv backward: grid (b, hq/hb, ik, iq), iq innermost.
+
+    heads_per_block > 1 (MHA only, mirroring the single-pass forward)
+    computes several heads per grid cell — a python loop the compiler
+    unrolls — amortizing the per-cell overhead that binds at these tile
+    counts. dk/dv scratch is [hb*block_k, d] with per-head row bands.
 
     The classic two-kernel split (dq with k inner, dkv with q inner) pays
     for s, p and dp TWICE — 7 MXU dots and 2 softmax recomputes per tile
@@ -432,6 +455,7 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse2_ref, delta_ref,
     ik = pl.program_id(2)
     iq = pl.program_id(3)
     nq = pl.num_programs(3)
+    hb = heads_per_block
 
     @pl.when(iq == 0)
     def _init():
@@ -441,13 +465,13 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse2_ref, delta_ref,
     q_start = iq * block_q
     k_start = ik * block_k
 
-    def _compute(masked: bool):
-        q = q_ref[0, 0]  # [bq, d], input dtype (MXU-native)
-        k = k_ref[0, 0]  # [bk, d]
-        v = v_ref[0, 0]  # [bk, d]
-        do = do_ref[0, 0]  # [bq, d]
-        lse2 = jnp.expand_dims(lse2_ref[0, 0, 0], -1)  # [bq, 1] f32, log2
-        delta = jnp.expand_dims(delta_ref[0, 0, 0], -1)  # [bq, 1] f32
+    def _one_head(h: int, masked: bool):
+        q = q_ref[0, h]  # [bq, d], input dtype (MXU-native)
+        k = k_ref[0, h]  # [bk, d]
+        v = v_ref[0, h]  # [bk, d]
+        do = do_ref[0, h]  # [bq, d]
+        lse2 = jnp.expand_dims(lse2_ref[0, h, 0], -1)  # [bq, 1] f32, log2
+        delta = jnp.expand_dims(delta_ref[0, h, 0], -1)  # [bq, 1] f32
 
         s2 = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
@@ -456,7 +480,8 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse2_ref, delta_ref,
         if masked:
             s2 = _causal_mask(s2, q_start, k_start, offset)
         p = jnp.exp2(s2 - lse2)  # [bq, bk] f32
-        dv_scr[:] += jax.lax.dot_general(
+        lo, hi_ = h * block_k, (h + 1) * block_k
+        dv_scr[lo:hi_] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # p^T @ do -> [bk, d]
@@ -465,14 +490,22 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse2_ref, delta_ref,
             preferred_element_type=jnp.float32,
         )  # [bq, bk]
         ds = (p * (dp - delta) * scale).astype(q.dtype)  # [bq, bk]
-        dk_scr[:] += jax.lax.dot_general(
+        dk_scr[lo:hi_] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # ds^T @ q -> [bk, d]
-        dqp_ref[0, 0, 0] = jax.lax.dot_general(
+        dqp_ref[0, 0, h] = jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         ).astype(dqp_ref.dtype)  # [bq, d] partial
+
+    def _compute(masked: bool):
+        for h in range(hb):
+            _one_head(h, masked)
+
+    def _zero_dqp():
+        for h in range(hb):
+            dqp_ref[0, 0, h] = jnp.zeros_like(dqp_ref[0, 0, h])
 
     live = _block_live(causal, q_start, k_start, block_q, offset)
     if causal:
@@ -484,17 +517,16 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse2_ref, delta_ref,
             lambda: _compute(masked=False)
         )
         # dead tile: its dq partial still must be defined
-        pl.when(jnp.logical_not(live))(
-            lambda: dqp_ref.__setitem__(
-                (0, 0, 0), jnp.zeros_like(dqp_ref[0, 0, 0]))
-        )
+        pl.when(jnp.logical_not(live))(_zero_dqp)
     else:
         pl.when(live)(lambda: _compute(masked=False))
 
     @pl.when(iq == nq - 1)
     def _finalize():
-        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
-        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+        for h in range(hb):
+            lo, hi_ = h * block_k, (h + 1) * block_k
+            dk_ref[0, h] = dk_scr[lo:hi_].astype(dk_ref.dtype)
+            dv_ref[0, h] = dv_scr[lo:hi_].astype(dv_ref.dtype)
 
 
 # Above this many dq partials the fused kernel's [nk, B, H, T, D]
@@ -507,7 +539,10 @@ def _fused_blocks(t: int, s: int, block_q: int, block_k: int):
     place this is computed, so the gate and the kernel can't disagree."""
     bq = min(block_q, t, 1024)
     bk = min(max(block_k, 512), s, 1024)
-    while bq * bk > 1024 * 1024:  # [bq, bk] f32 tiles dominate VMEM
+    # [bq, bk] f32 tiles dominate VMEM (measured: a full-row bk=2048 tile
+    # under the raised scoped limit LOSES ~2% MFU at T=2048 — bigger
+    # tiles starve mosaic's cross-cell pipelining before cell-count wins)
+    while bq * bk > 1024 * 1024:
         bq //= 2
     if t % bq or s % bk or cdiv(s, bk) > _MAX_DQ_PARTIALS:
         return None
@@ -527,35 +562,38 @@ def _flash_bwd_fused(q, k, v, o, lse, do, *, causal, block_q, block_k,
     block_q, block_k = blocks
     nq, nk = cdiv(t, block_q), cdiv(s, block_k)
 
+    hb = _heads_per_block("flash_bwd_heads_per_block", hq, group)
+
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     lse2 = lse * _LOG2E  # natural-log residual -> log2 domain
     lse2_r = lse2[:, :, None, :]
     delta_r = delta[:, :, None, :]
 
     def row_spec(block, index):
-        return pl.BlockSpec((1, 1, 1, block), index)
+        return pl.BlockSpec((1, hb, 1, block), index)
 
     dqp, dk_full, dv_full = pl.pallas_call(
         functools.partial(
             _bwd_fused_kernel, causal=causal, scale=scale,
             block_q=block_q, block_k=block_k, offset=offset,
+            heads_per_block=hb,
         ),
-        grid=(b, hq, nk, nq),
+        grid=(b, hq // hb, nk, nq),
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki, qi: (bi, hi // group, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki, qi: (bi, hi // group, ki, 0)),
-            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, hb, block_q, d), lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, hb, block_k, d), lambda bi, hi, ki, qi: (bi, hi // group, ki, 0)),
+            pl.BlockSpec((1, hb, block_k, d), lambda bi, hi, ki, qi: (bi, hi // group, ki, 0)),
+            pl.BlockSpec((1, hb, block_q, d), lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
             row_spec(block_q, lambda bi, hi, ki, qi: (bi, hi, 0, qi)),
             row_spec(block_q, lambda bi, hi, ki, qi: (bi, hi, 0, qi)),
         ],
         out_specs=[
             pl.BlockSpec(
-                (1, 1, 1, block_q, d),
+                (1, 1, hb, block_q, d),
                 lambda bi, hi, ki, qi: (ki, bi, hi, qi, 0),
             ),
-            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, hb, block_k, d), lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, hb, block_k, d), lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
         ],
         out_shape=[
             # partials ride in the INPUT dtype: f32 inputs keep exact
@@ -566,12 +604,13 @@ def _flash_bwd_fused(q, k, v, o, lse, do, *, causal, block_q, block_k,
             jax.ShapeDtypeStruct((b, hq, s, d), v.dtype),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_k, d), jnp.float32),
-            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((hb * block_k, d), jnp.float32),
+            pltpu.VMEM((hb * block_k, d), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary"),
+            vmem_limit_bytes=_vmem_limit(),
         ),
         interpret=interpret,
     )(q, k, v, do, lse2_r, delta_r)
@@ -658,6 +697,7 @@ def _flash_bwd(q, k, v, o, lse, do, *, causal, block_q, block_k, interpret):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary"),
+            vmem_limit_bytes=_vmem_limit(),
         ),
         interpret=interpret,
     )(q, k, v, do, lse_r, delta_r)
@@ -693,6 +733,7 @@ def _flash_bwd(q, k, v, o, lse, do, *, causal, block_q, block_k, interpret):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary"),
+            vmem_limit_bytes=_vmem_limit(),
         ),
         interpret=interpret,
     )(q, k, v, do, lse_r, delta_r)

@@ -282,3 +282,34 @@ def test_flash_heads_per_block_matches_reference(rng, hb):
         _cfg.set_system_config({"flash_heads_per_block": old})
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=2e-5, rtol=1e-4)
+
+
+@pytest.mark.parametrize("hb", [2, 4])
+def test_flash_bwd_heads_per_block_matches_reference(rng, hb):
+    """flash_bwd_heads_per_block > 1 (multi-head fused-backward cells,
+    MHA only) must produce the same gradients as the per-head layout."""
+    from ray_tpu._private import config as _cfg
+
+    b, t, h, d = 2, 512, 4, 64
+    k1, k2, k3 = jax.random.split(rng, 3)
+    q = jax.random.normal(k1, (b, t, h, d), jnp.float32)
+    k = jax.random.normal(k2, (b, t, h, d), jnp.float32)
+    v = jax.random.normal(k3, (b, t, h, d), jnp.float32)
+
+    def f_ref(q, k, v):
+        return attention_reference(q, k, v, causal=True).sum()
+
+    def f_flash(q, k, v):
+        return flash_attention(q, k, v, causal=True, block_q=256,
+                               block_k=512, interpret=True).sum()
+
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    old = _cfg.get("flash_bwd_heads_per_block")
+    try:
+        _cfg.set_system_config({"flash_bwd_heads_per_block": hb})
+        g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    finally:
+        _cfg.set_system_config({"flash_bwd_heads_per_block": old})
+    for a, b_ in zip(g_ref, g_flash):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=2e-4, rtol=1e-3)
