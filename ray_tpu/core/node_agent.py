@@ -103,6 +103,7 @@ class WorkerHandle:
         self.ready = asyncio.Event()
         self.busy_task: bytes | None = None  # lease/reservation marker
         self.blocked = 0  # depth of in-get parks (worker_blocked fires)
+        self._parked_tid = b""  # task id of the most recent in-get park
         # Queued-path tasks pushed to this worker's exec queue and not
         # yet done: dispatch pipelines up to pool_dispatch_depth of them
         # (reference pipelines lease pushes, direct_task_transport.h:211
@@ -1376,6 +1377,22 @@ class NodeAgent:
             self._signal_worker_free()
             self._free_task_resources(spec)
             await self._notify_task_failed(spec, f"dispatch failed: {e}")
+            return
+        tid = spec["task_id"]
+        while w.blocked and len(w.pool_inflight) > 1:
+            # the worker blocked while this dispatch was in flight: the
+            # blocked-fire's reclaim may have run before our send hit
+            # the wire, leaving this task stranded behind the parked
+            # thread — drain again (idempotent). Bounded retry rather
+            # than a one-shot: RPC handlers dispatch via ensure_future,
+            # so nothing guarantees the worker enqueued our task before
+            # a drain scan ran; retry until the task is reclaimed, done,
+            # or the worker unparks (50ms grain, worker enqueue is µs).
+            await self._reclaim_pipelined(w, w._parked_tid)
+            cur = self.running.get(tid)
+            if cur is None or cur.get("_worker_id") != w.worker_id:
+                break  # reclaimed (requeued) or already completed
+            await asyncio.sleep(0.05)
 
     # -- worker leases (reference direct_task_transport.h:110
     # RequestNewWorkerIfNeeded + lease caching per SchedulingKey): the
@@ -1578,6 +1595,7 @@ class NodeAgent:
         w = self.workers.get(p["worker_id"])
         if w is not None:
             w.blocked += 1
+            w._parked_tid = p.get("task_id") or b""
             spec = self.running.get(p.get("task_id") or b"")
             if spec is not None and spec.get("_granted") \
                     and not spec.get("_blocked_released"):
@@ -1589,12 +1607,49 @@ class NodeAgent:
                 spec["_blocked_released"] = True
             self._signal_worker_free()  # a slot just opened
             self._kick_dispatch()
+            await self._reclaim_pipelined(w, p.get("task_id") or b"")
         return True
+
+    async def _reclaim_pipelined(self, w, parked_tid: bytes):
+        """Pull the blocked worker's queued-but-unstarted pipelined tasks
+        back into the agent queue. The dispatch guard (`not w.blocked`)
+        can't close the race where a child lands in the window between
+        its parent's submit and the worker_blocked fire: the child would
+        then sit in the exec queue behind a parent parked in get() ON
+        that child — a permanent hang. Drain is cooperative: the worker
+        returns only ids it actually pulled, so nothing double-runs."""
+        cands = [t for t in w.pool_inflight
+                 if t != parked_tid and t in self.running
+                 and not self.running[t].get("_leased")]
+        if not cands or w.client is None or w.client.closed:
+            return
+        try:
+            r = await w.client.call("drain_pending", {"task_ids": cands},
+                                    timeout=5.0)
+        except (rpc.ConnectionLost, rpc.RpcError, OSError,
+                asyncio.TimeoutError):
+            return  # worker died/hung: the reap path fails tasks over
+        for tid in r["task_ids"]:
+            spec = self.running.pop(tid, None)
+            if spec is None:
+                continue
+            w.pool_inflight.discard(tid)
+            self._free_task_resources(spec)
+            spec.pop("_granted", None)
+            spec.pop("_worker_id", None)
+            self.task_queue.append(spec)
+        if r["task_ids"]:
+            if not w.pool_inflight:
+                w.idle_since = time.monotonic()
+            self._signal_worker_free()
+            self._kick_dispatch()
 
     async def rpc_worker_unblocked(self, conn, p):
         w = self.workers.get(p["worker_id"])
         if w is not None and w.blocked > 0:
             w.blocked -= 1
+            if not w.blocked:
+                w._parked_tid = b""
         spec = self.running.get(p.get("task_id") or b"")
         if spec is not None and spec.pop("_blocked_released", None):
             # re-take even if it drives availability negative: the task

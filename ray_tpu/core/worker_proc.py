@@ -231,6 +231,31 @@ class Executor(CoreWorker):
         self._exec_queue.put(("actor_call", call, None))
         return True
 
+    async def rpc_drain_pending(self, conn, p):
+        """Give back queued-but-unstarted tasks whose ids are in
+        p['task_ids'] — the agent reclaims them when this worker blocks
+        in get(): anything stacked behind the parked exec thread would
+        otherwise wait for a parent that is waiting for it (the nested
+        pipelined-dispatch deadlock). Items the exec thread already
+        popped are simply not in the queue and stay untouched; the
+        response lists only what was actually pulled, so agent-side
+        requeue can't double-run a task."""
+        want = set(p["task_ids"])
+        reclaimed, keep = [], []
+        while True:
+            try:
+                item = self._exec_queue.get_nowait()
+            except queue.Empty:
+                break
+            kind, payload, _reply = item
+            if kind == "task" and payload["task_id"] in want:
+                reclaimed.append(payload["task_id"])
+            else:
+                keep.append(item)
+        for item in keep:
+            self._exec_queue.put(item)
+        return {"task_ids": reclaimed}
+
     async def rpc_ping(self, conn, p):
         return "pong"
 

@@ -51,3 +51,42 @@ def test_recursion_depth_bounded_by_process_ceiling():
         assert n_pool <= 4 * agent._pool_worker_cap()
     finally:
         c.shutdown()
+
+
+def test_child_reclaimed_from_blocked_parents_queue():
+    """Pipelined dispatch may stack a child onto its own parent's exec
+    queue in the window between the parent's submit and its
+    worker_blocked fire landing (the guard `not w.blocked` races the
+    notification). The parent then parks in get() on a child that sits
+    behind it on the same single exec thread — a permanent hang unless
+    the agent reclaims the blocked worker's unstarted queue. Pool cap 1
+    + a pre-get sleep makes the race deterministic: the child can ONLY
+    pipeline onto the parent's worker."""
+    from ray_tpu._private import config as _cfg
+
+    old = {k: _cfg.get(k) for k in ("max_pool_workers_per_node",
+                                    "worker_lease_enabled")}
+    _cfg.set_system_config({"max_pool_workers_per_node": 1,
+                            "worker_lease_enabled": False})
+    c = Cluster(head_resources={"CPU": 2, "memory": 2 * 2**30})
+    c.connect()
+    try:
+        @ray_tpu.remote(num_cpus=0)
+        def child():
+            return 42
+
+        @ray_tpu.remote(num_cpus=0)
+        def parent():
+            import time
+            ref = child.remote()
+            # let the child's dispatch land in THIS worker's exec queue
+            # while we are busy-but-not-yet-blocked
+            time.sleep(0.8)
+            return ray_tpu.get(ref, timeout=60)
+
+        assert ray_tpu.get(parent.remote(), timeout=90) == 42
+    finally:
+        try:
+            c.shutdown()
+        finally:
+            _cfg.set_system_config(old)
