@@ -88,6 +88,10 @@ _DEFS: dict[str, Any] = {
     # scoped limit is 16MB but v5e physically has 128MB VMEM; multi-head
     # cells need the headroom for their [bq, s] f32 intermediates.
     "flash_vmem_limit_mb": 96,
+    # full TaskSpec schema re-walk at the executor (specs arrive from the
+    # already-validating local agent / owner build; see
+    # task_spec.from_wire_trusted) — off on the hot path by default
+    "revalidate_at_executor": False,
     # -- memory monitor --
     "memory_monitor_interval_s": 2.0,
     "memory_usage_kill_fraction": 0.95,  # memory_monitor.h:52 analog

@@ -19,6 +19,27 @@ import threading
 
 _ID_SIZE = 16
 
+# Buffered entropy: os.urandom costs ~20µs per call (a getrandom syscall),
+# which the submit hot path pays once per task id; refilling a 16KB pool
+# amortizes it ~1000x. Fork safety: the pool is keyed by pid so children
+# never replay the parent's bytes.
+_rand_lock = threading.Lock()
+_rand_buf = b""
+_rand_off = 0
+_rand_pid = -1
+
+
+def random_bytes(n: int) -> bytes:
+    global _rand_buf, _rand_off, _rand_pid
+    with _rand_lock:
+        if _rand_pid != os.getpid() or _rand_off + n > len(_rand_buf):
+            _rand_buf = os.urandom(max(16384, n))
+            _rand_off = 0
+            _rand_pid = os.getpid()
+        out = _rand_buf[_rand_off:_rand_off + n]
+        _rand_off += n
+        return out
+
 
 class BaseID:
     """Immutable binary id with hex repr."""
@@ -33,7 +54,7 @@ class BaseID:
 
     @classmethod
     def from_random(cls):
-        return cls(os.urandom(_ID_SIZE))
+        return cls(random_bytes(_ID_SIZE))
 
     @classmethod
     def from_hex(cls, hex_str: str):

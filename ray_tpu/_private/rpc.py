@@ -232,6 +232,9 @@ class AsyncRpcClient:
         self._push_handlers: dict[str, Callable[[Any], None]] = {}
         self._read_task: asyncio.Task | None = None
         self.closed = False
+        # coalesced fire() outbox: packed frames flushed in one
+        # writer.write per loop tick
+        self._fire_out: list[bytes] = []
 
     async def connect(self, retries: int = 30, delay: float = 0.1):
         last = None
@@ -303,6 +306,32 @@ class AsyncRpcClient:
             raise ConnectionLost("closed")
         _write_frame(self._writer, [ONEWAY, method, payload])
         await self._writer.drain()
+
+    def fire(self, method: str, payload: Any = None):
+        """Coalesced one-way (io-loop context only): frames buffer and a
+        call_soon flushes them in ONE writer.write per loop tick —
+        asyncio writes straight through to a send() syscall per write
+        when its buffer is empty, which dominates per-task dispatch
+        bursts. Write failures surface via the read-loop disconnect
+        machinery, not here."""
+        if self.closed or self._writer is None:
+            raise ConnectionLost("closed")
+        body = pack([ONEWAY, method, payload])
+        if len(body) > MAX_FRAME:
+            raise RpcError(f"frame of {len(body)} bytes exceeds limit")
+        self._fire_out.append(_LEN.pack(len(body)) + body)
+        if len(self._fire_out) == 1:
+            asyncio.get_running_loop().call_soon(self._flush_fires)
+
+    def _flush_fires(self):
+        chunks = self._fire_out
+        self._fire_out = []
+        try:
+            if not chunks or self.closed or self._writer is None:
+                return
+            self._writer.write(b"".join(chunks))
+        except (ConnectionError, RuntimeError, OSError):
+            pass  # read-loop disconnect machinery owns this failure
 
     async def close(self):
         self.closed = True
@@ -464,12 +493,13 @@ class SyncRpcClient:
             return 0
 
     def _drain_one(self, method, payload):  # io thread only
-        cli = self.client
+        # delegate to the async client's coalescer (one writer.write per
+        # loop tick); fire semantics swallow write-path errors — the
+        # disconnect machinery owns those failures
         try:
-            if cli.closed or cli._writer is None:
-                return
-            _write_frame(cli._writer, [ONEWAY, method, payload])
-        except (ConnectionError, RpcError, RuntimeError, OSError):
+            self.client.fire(method, payload)
+        except (ConnectionLost, ConnectionError, RpcError, RuntimeError,
+                OSError):
             pass
 
     def _drain_fires(self):  # io thread only

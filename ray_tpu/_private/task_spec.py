@@ -198,6 +198,35 @@ class _SpecBase(dict):
         _validate(payload, cls._SCHEMA, cls._KIND)
         return cls(payload)
 
+    @classmethod
+    def from_wire_trusted(cls, payload):
+        """Ingestion from an already-validating hop (the local agent
+        validated at submit_task_batch; owner direct pushes validated at
+        build): check only the routing fields the error path needs, so a
+        malformed spec can still be poisoned back to its owner, and skip
+        the full per-field schema walk — it costs ~3x per task on the
+        submit hot path when every hop revalidates
+        (RAY_TPU_REVALIDATE_AT_EXECUTOR=1 restores the full check)."""
+        from ray_tpu._private import config as _config
+
+        if _config.get("revalidate_at_executor"):
+            return cls.from_wire(payload)
+        if not isinstance(payload, dict):
+            raise InvalidTaskSpec(
+                f"{cls._KIND}: expected dict, got {type(payload).__name__}")
+        for f in ("task_id", "actor_id"):
+            if f in cls._SCHEMA and cls._SCHEMA[f][0] \
+                    and not _is_bytes(payload.get(f)):
+                raise InvalidTaskSpec(f"{cls._KIND}: field {f!r} missing "
+                                      f"or not bytes")
+        # a malformed owner can't be poisoned BACK (the error push needs
+        # owner.addr/port) — without this check the submitter's get()
+        # would hang instead of raising
+        if "owner" in cls._SCHEMA and not _is_owner(payload.get("owner")):
+            raise InvalidTaskSpec(f"{cls._KIND}: field 'owner' missing "
+                                  f"or malformed")
+        return cls(payload)
+
     def validate(self):
         _validate(self, self._SCHEMA, self._KIND)
         return self

@@ -35,7 +35,9 @@ def reset(token) -> None:
 
 
 def new_trace_id() -> str:
-    return os.urandom(8).hex()
+    from ray_tpu._private.ids import random_bytes
+
+    return random_bytes(8).hex()
 
 
 def for_submit() -> dict:

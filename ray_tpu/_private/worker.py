@@ -147,6 +147,9 @@ class CoreWorker:
         # expired-busy lease is replaced under the same scheduling key
         self._lease_tasks: dict[bytes, tuple] = {}
         self._lease_lock = threading.Lock()
+        # buffered lease_tasks_started notifications (one frame per burst)
+        self._lease_started_buf: list[dict] = []
+        self._lease_started_lock = threading.Lock()
         # (task_id, retries_left) -> ts: per-attempt failure dedup
         self._failing_tasks: dict[tuple, float] = {}
         self._lock = threading.Lock()
@@ -1317,8 +1320,11 @@ class CoreWorker:
             return False
         # async: let the agent track the leased task so its worker-death
         # notification path covers direct pushes too (slim spec: the
-        # agent only needs identity/owner/shape for failover + cancel)
-        self.agent.fire("lease_task_started", {
+        # agent only needs identity/owner/shape for failover + cancel).
+        # Buffered: one lease_tasks_started frame per burst — the agent
+        # loop's per-frame dispatch is the multi-owner throughput
+        # ceiling, so started-tracking must not cost a frame per task.
+        self._buffer_lease_started({
             "lease_id": lease["lease_id"],
             "spec": {k: push[k] for k in
                      ("task_id", "job_id", "name", "resources", "owner",
@@ -1328,6 +1334,23 @@ class CoreWorker:
         # agents' task_located notifies entirely)
         self._task_nodes[tid] = self.node_id
         return True
+
+    def _buffer_lease_started(self, item: dict):
+        with self._lease_started_lock:
+            self._lease_started_buf.append(item)
+            if len(self._lease_started_buf) > 1:
+                return  # a flush is already scheduled for this burst
+        try:
+            self.io.loop.call_soon_threadsafe(self._flush_lease_started)
+        except RuntimeError:  # loop closed mid-shutdown
+            pass
+
+    def _flush_lease_started(self):  # io loop
+        with self._lease_started_lock:
+            items = self._lease_started_buf
+            self._lease_started_buf = []
+        if items:
+            self.agent.fire("lease_tasks_started", {"items": items})
 
     def _start_pending_pump(self):  # io loop
         import asyncio
@@ -1452,13 +1475,15 @@ class CoreWorker:
             )
         for s in drain:
             self._enqueue_submit(s)
-        for tid in orphans:
-            threading.Thread(
-                target=self._handle_task_failed,
-                args=({"task_id": tid, "reason": "lease revoked",
-                       "retriable": True},),
-                daemon=True,
-            ).start()
+        if orphans:
+            def _failover(tids=orphans):
+                for tid in tids:
+                    self._handle_task_failed(
+                        {"task_id": tid, "reason": "lease revoked",
+                         "retriable": True})
+            # one thread for the whole revocation: a reclaim that caught
+            # a deep pipeline would otherwise fork a thread per task
+            threading.Thread(target=_failover, daemon=True).start()
         return True
 
     def _on_lease_task_done(self, task_id: bytes, failed: bool):

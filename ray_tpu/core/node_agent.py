@@ -807,6 +807,12 @@ class NodeAgent:
             await asyncio.sleep(0.2)
             now = time.monotonic()
             idle_reclaim = cfg.get("worker_lease_idle_reclaim_s")
+            if self.task_queue or getattr(self, "_pop_waiters", 0) > 0:
+                # queued tasks are waiting on pool room: momentarily-idle
+                # leases must hand their workers back sooner than 1.5s
+                # (0.5s, not lower: reclaiming leases that are merely
+                # between refill bursts churns revocation failovers)
+                idle_reclaim = min(idle_reclaim, 0.5)
             for lease_id, lease in list(self.leases.items()):
                 if now > lease["expires"]:
                     if lease.get("active"):
@@ -1367,7 +1373,9 @@ class NodeAgent:
         self.running[spec["task_id"]] = spec
         spec["_worker_id"] = w.worker_id
         try:
-            await w.client.oneway(
+            # coalesced fire: dispatch bursts cost one send() per loop
+            # tick instead of one per task
+            w.client.fire(
                 "execute_task",
                 {k: v for k, v in spec.items() if not k.startswith("_")},
             )
@@ -1417,6 +1425,23 @@ class NodeAgent:
     async def rpc_lease_worker(self, conn, p):
         need = p.get("resources", {})
         refusal = {"spillable": self._shape_spillable(need)}
+        if self.task_queue or getattr(self, "_pop_waiters", 0) > 0:
+            # Queued work dispatches first: lease grants + their
+            # background spawns otherwise consume every pool slot and a
+            # single queued task starves until the lease traffic
+            # quiesces (observed: one queued num_cpus=0 task waited 4s
+            # in _pop_worker behind 299 lease pushes, gating its whole
+            # batch). Owners fall back to their existing leases
+            # (depth-10 pipelining) or queued submission.
+            return refusal
+        cap = self._pool_worker_cap()
+        # leases never monopolize the pool: the queued-dispatch path
+        # keeps a slice of worker slots it can claim without waiting for
+        # lease traffic to quiesce. Tiny pools (cap < 4) reserve nothing
+        # — a 1-slot reserve there would disable leasing outright.
+        reserve = max(1, cap // 8) if cap >= 4 else 0
+        if len(self.leases) >= cap - reserve:
+            return refusal
         if not self._fits(need, self.resources_available):
             return refusal  # busy: owner falls back to queued submission
         if self._actor_reservations and not self._fits_with_reservations(
@@ -1475,6 +1500,14 @@ class NodeAgent:
 
     async def rpc_return_lease(self, conn, p):
         return self._release_lease(p["lease_id"])
+
+    async def rpc_lease_tasks_started(self, conn, p):
+        """Batched lease_task_started (owners buffer per burst: the
+        per-frame dispatch cost on this loop is the multi-owner
+        throughput ceiling)."""
+        for item in p["items"]:
+            await self.rpc_lease_task_started(conn, item)
+        return True
 
     async def rpc_lease_task_started(self, conn, p):
         """Owner pushed a task to its leased worker: track it so the

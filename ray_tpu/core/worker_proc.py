@@ -158,7 +158,7 @@ class Executor(CoreWorker):
         # execution error path push a RayTaskError to the owner and
         # report done to the agent.
         try:
-            spec = task_spec.TaskSpec.from_wire(spec)
+            spec = task_spec.TaskSpec.from_wire_trusted(spec)
         except task_spec.InvalidTaskSpec as e:
             spec = _poison_spec(spec, e)
             if spec is None:
@@ -185,7 +185,7 @@ class Executor(CoreWorker):
         import inspect
 
         try:
-            call = task_spec.ActorTaskSpec.from_wire(call)
+            call = task_spec.ActorTaskSpec.from_wire_trusted(call)
         except task_spec.InvalidTaskSpec as e:
             # same poisoning as rpc_execute_task: this is a fire target,
             # so raising would strand the caller's return refs forever
