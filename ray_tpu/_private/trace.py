@@ -53,9 +53,12 @@ def for_submit() -> dict:
 
 def enter_spec(spec: dict):
     """Executor-side: enter the spec's trace scope (span = own task id).
-    Returns the reset token (None when the spec carries no trace)."""
+    Always sets the contextvar and returns a reset token: a trace-LESS
+    spec (poisoned/legacy) must clear the scope, or a pool worker's exec
+    thread would leak the PREVIOUS task's (trace_id, span) into this
+    task's nested submissions and profile spans."""
     tr = spec.get("trace")
     if not tr:
-        return None
+        return _ctx.set(None)
     return set_current(tr.get("trace_id") or new_trace_id(),
                        spec["task_id"].hex())

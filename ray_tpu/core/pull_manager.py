@@ -140,11 +140,22 @@ class PullScheduler:
             if oid in self._active:
                 self._active[oid] = int(nbytes)
 
+        deadline = req["deadline"]  # snapshot: pull_fn reads it once
         try:
-            ok = await self._pull_fn(oid, req["deadline"], reserve)
+            ok = await self._pull_fn(oid, deadline, reserve)
         except Exception:  # noqa: BLE001 — a failed transfer fails the
             logger.exception("pull of %s failed", oid.hex()[:12])
             ok = False
+        if not ok and req["deadline"] > deadline \
+                and self._reqs.get(oid) is req:
+            # a co-waiter extended the deadline AFTER this attempt
+            # started (duplicate request with a longer timeout): the
+            # attempt ran against the stale deadline, so requeue for
+            # another try instead of resolving a premature False
+            self._active.pop(oid, None)
+            req["queued"] = True
+            self._push(oid, req["pri"])
+            return
         self._finish(oid, bool(ok))
 
     def _finish(self, oid: bytes, ok: bool):

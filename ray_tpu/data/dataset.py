@@ -154,49 +154,6 @@ def _prefetched(refs: list, depth: int) -> Iterator[Any]:
         stop.set()
 
 
-def _actor_pool_map(fn_blob, size: int, refs: list,
-                    timeout_s: float = 600.0, meter=None) -> list:
-    """Run one stage over all blocks on a pool of `size` map actors,
-    preserving order (reference ActorPoolMapOperator). With a
-    BudgetMeter, submission is byte-metered admission instead of an
-    all-upfront flood (per-operator budgets,
-    streaming_executor_state.py analog)."""
-    import time as _time
-
-    actors = [_MapActor.remote(fn_blob) for _ in builtins.range(size)]
-    try:
-        out: list = [None] * len(refs)
-        # round-robin assignment; the runtime's per-actor ordered queues
-        # keep each actor sequential
-        for i, r in enumerate(refs):
-            out[i] = actors[i % size].apply.remote(r)
-            if meter is not None:
-                meter.admit(out[i])
-        # all results must exist BEFORE the pool tears down: killing an
-        # actor with queued work would leave never-resolving refs in the
-        # dataset cache. Progress-based deadline: stall, not total time.
-        pending = list(out)
-        last_progress = _time.monotonic()
-        while pending:
-            ready, pending = ray_tpu.wait(
-                pending, num_returns=len(pending), timeout=10.0)
-            if ready:
-                last_progress = _time.monotonic()
-            elif _time.monotonic() - last_progress > timeout_s:
-                raise TimeoutError(
-                    f"actor-pool map stalled: {len(pending)} blocks made "
-                    f"no progress in {timeout_s}s")
-        if meter is not None:
-            meter.drain()
-        return out
-    finally:
-        for a in actors:
-            try:
-                ray_tpu.kill(a)
-            except Exception:  # noqa: BLE001
-                pass
-
-
 @ray_tpu.remote(num_cpus=0)
 def _count_rows(block) -> int:
     """Remote row-count probe (limit pushdown): the count travels, the

@@ -178,14 +178,16 @@ def _ref_nbytes(ref) -> int:
 
 
 class BudgetMeter:
-    """Shared byte-metered admission (streaming_executor_state.py's
-    per-operator budgets, centralized): every stage asks admit() before
-    launching a unit of work; over-budget submission waits for in-flight
-    outputs to complete and counts their observed sizes.
+    """Byte-metered admission (streaming_executor_state.py's
+    per-operator budgets): every stage asks admit() before launching a
+    unit of work; over-budget submission waits for in-flight outputs to
+    complete and counts their observed sizes.
 
-    With byte_budget=None only the in-flight window applies and drain()
-    is a no-op — unbudgeted pipelines keep the pre-planner behavior of
-    chaining stage N+1 tasks on stage N's pending refs."""
+    execute() gives each operator its OWN meter with a slice of the
+    dataset byte budget, so concurrently-running stages bound their
+    TOTAL footprint without sharing one in-flight window (chained
+    downstream refs would otherwise displace runnable upstream work).
+    With byte_budget=None only the in-flight window applies."""
 
     def __init__(self, byte_budget: int | None,
                  max_in_flight: int = DEFAULT_INFLIGHT):
@@ -193,6 +195,7 @@ class BudgetMeter:
         self.max_in_flight = max_in_flight
         self.in_flight: list = []
         self.avg = [0.0, 0]  # observed (total_bytes, n)
+        self.completions = 0  # resolved refs seen (sized or not)
 
     def _est(self) -> float:
         if self.avg[1] == 0:
@@ -204,9 +207,21 @@ class BudgetMeter:
             return True
         if self.byte_budget is None:
             return False
+        if self.avg[1] == 0:
+            if self.completions >= 2:
+                # refs resolve but their sizes are unobservable
+                # (inline-entry bookkeeping unavailable): learning will
+                # never converge — fall back to the in-flight window
+                # rather than pinning the pipeline at 2 forever
+                return False
+            # no observation yet: a blind first window could blow the
+            # budget before the meter learns (huge first blocks) —
+            # admit a 2-wide learn window, then size from observations
+            return len(self.in_flight) >= 2
         return self._est() * (len(self.in_flight) + 1) > self.byte_budget
 
     def observe(self, ref):
+        self.completions += 1
         n = _ref_nbytes(ref)
         if n:
             self.avg[0] += n
@@ -241,25 +256,12 @@ class BudgetMeter:
                                 int(self.byte_budget // self._est())))
 
 
-def execute(plan: LogicalPlan, *, byte_budget: int | None = None,
-            max_in_flight: int = DEFAULT_INFLIGHT) -> list:
-    """Run an optimized plan to materialized block refs. One BudgetMeter
-    paces every stage; intermediate refs drop as stages consume them so
-    distributed GC can reclaim them."""
+def _read_stream(read: Read, first_maps: list, meter: "BudgetMeter"):
+    """Source operator: yields block refs AS LAUNCHED (pending), pacing
+    launches through the shared meter and honoring the limit-pushdown
+    early-stop hint via remote row-count probes."""
     from ray_tpu.data import dataset as D
 
-    meter = BudgetMeter(byte_budget, max_in_flight)
-    read = plan.ops[0]
-    assert isinstance(read, Read), plan.ops
-    ops = plan.ops[1:]
-
-    # the first fused-map segment runs fused WITH lazy sources
-    first_maps: list = []
-    if ops and isinstance(ops[0], FusedMap):
-        first_maps = ops[0].fn_blobs
-        ops = ops[1:]
-
-    refs: list = []
     rows_seen = 0
     count_refs: list = []
     for unit in read.units:
@@ -281,7 +283,7 @@ def execute(plan: LogicalPlan, *, byte_budget: int | None = None,
             for c in done:
                 rows_seen += ray_tpu.get(c, timeout=60)
             if rows_seen >= read.limit_rows:
-                break
+                return
         if read.lazy:
             r = D._source_and_map_fused.remote(unit, first_maps)
         elif first_maps:
@@ -290,48 +292,140 @@ def execute(plan: LogicalPlan, *, byte_budget: int | None = None,
             r = unit
         if read.lazy or first_maps:
             meter.admit(r)
-        refs.append(r)
         if read.limit_rows is not None:
             count_refs.append(D._count_rows.remote(r))
-    meter.drain()
+        yield r
+
+
+def _fused_map_stream(fn_blobs: list, upstream, meter: "BudgetMeter"):
+    """Task-map operator: pulls upstream refs as the downstream demands
+    output, chaining each launched task on its (possibly still pending)
+    input — map N+1 runs the moment block N's producer finishes,
+    regardless of its siblings (no stage barrier)."""
+    from ray_tpu.data import dataset as D
+
+    for r in upstream:
+        o = D._map_block_fused.remote(fn_blobs, r)
+        meter.admit(o)
+        yield o
+
+
+def _actor_pool_stream(fn_blob, size: int, upstream,
+                       meter: "BudgetMeter | None"):
+    """Actor-pool operator: feeds blocks to the pool as upstream yields
+    them (round-robin; per-actor ordered queues keep each sequential)
+    and yields output refs immediately so downstream stages overlap the
+    pool. The pool tears down only after every output resolves — killing
+    an actor with queued work would leave never-resolving refs."""
+    import time as _time
+
+    from ray_tpu.data.dataset import _MapActor
+
+    actors = [_MapActor.remote(fn_blob) for _ in range(size)]
+    out: list = []
+    try:
+        for i, r in enumerate(upstream):
+            o = actors[i % size].apply.remote(r)
+            if meter is not None:
+                meter.admit(o)
+            out.append(o)
+            yield o
+        # progress-based stall deadline, not total-time (blocks may be
+        # slow but moving)
+        pending = list(out)
+        last_progress = _time.monotonic()
+        while pending:
+            ready, pending = ray_tpu.wait(
+                pending, num_returns=len(pending), timeout=10.0)
+            if ready:
+                last_progress = _time.monotonic()
+            elif _time.monotonic() - last_progress > 600.0:
+                raise TimeoutError(
+                    f"actor-pool map stalled: {len(pending)} blocks made "
+                    f"no progress in 600s")
+    finally:
+        for a in actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def execute(plan: LogicalPlan, *, byte_budget: int | None = None,
+            max_in_flight: int = DEFAULT_INFLIGHT) -> list:
+    """Run an optimized plan to block refs (possibly still pending —
+    callers get/wait lazily).
+
+    Pull-based streaming execution (reference streaming_executor.py:48 +
+    streaming_executor_state.py operator topology, collapsed onto the
+    driver): every operator is a generator pulling from its upstream, so
+    launches flow block-by-block through the whole chain and an
+    operator's tasks chain directly on pending upstream refs — a shuffle
+    map-side overlaps the upstream map stage, and one slow block never
+    idles its siblings. Each operator paces launches through its OWN
+    BudgetMeter holding a slice of the dataset byte budget (reference
+    per-operator budgets): stages run concurrently, so a shared window
+    would let chained-but-idle downstream refs displace runnable
+    upstream work. Intermediate refs drop as stages consume them so
+    distributed GC can reclaim them."""
+    read = plan.ops[0]
+    assert isinstance(read, Read), plan.ops
+    ops = plan.ops[1:]
+
+    # the first fused-map segment runs fused WITH lazy sources
+    first_maps: list = []
+    if ops and isinstance(ops[0], FusedMap):
+        first_maps = ops[0].fn_blobs
+        ops = ops[1:]
+
+    # one budget slice per admitting operator (the read+fused-maps
+    # segment, each later map/pool stage, each exchange)
+    n_admitting = 1 + sum(
+        1 for op in ops
+        if isinstance(op, (FusedMap, Exchange))
+        or (isinstance(op, MapBatches) and op.actor_pool))
+    slice_budget = (None if byte_budget is None
+                    else max(1, byte_budget // n_admitting))
+
+    def new_meter():
+        return BudgetMeter(slice_budget, max_in_flight)
+
+    stream = _read_stream(read, first_maps, new_meter())
 
     for op in ops:
         if isinstance(op, FusedMap):
-            nxt = []
-            for r in refs:
-                o = D._map_block_fused.remote(op.fn_blobs, r)
-                meter.admit(o)
-                nxt.append(o)
-            refs = nxt
-            meter.drain()
+            stream = _fused_map_stream(op.fn_blobs, stream, new_meter())
         elif isinstance(op, MapBatches) and op.actor_pool:
-            # unbudgeted pools keep the old flood-submit behavior; a
-            # budgeted pool's window must at least cover the pool or
-            # actors sit idle
+            # a budgeted pool's window must at least cover the pool or
+            # actors sit idle; unbudgeted pools submit unmetered
+            pm = None
             if byte_budget is not None:
-                meter.max_in_flight = max(meter.max_in_flight,
-                                          2 * op.actor_pool)
-            refs = D._actor_pool_map(
-                op.fn_blob, op.actor_pool, refs,
-                meter=meter if byte_budget is not None else None)
+                pm = new_meter()
+                pm.max_in_flight = max(pm.max_in_flight,
+                                       2 * op.actor_pool)
+            stream = _actor_pool_stream(
+                op.fn_blob, op.actor_pool, stream, pm)
         elif isinstance(op, LimitRows):
-            refs = D._limit_refs(refs, op.n)
+            from ray_tpu.data import dataset as D
+
+            # exact-limit enforcement materializes row counts: exhaust
+            # the (lazy) upstream launches, then trim
+            stream = iter(D._limit_refs(list(stream), op.n))
         elif isinstance(op, Exchange):
             from ray_tpu.data import shuffle as S
 
-            sm = meter if byte_budget is not None else None
+            sm = new_meter() if byte_budget is not None else None
+            refs = list(stream)  # collects LAUNCHED refs; no completion
+            # barrier — the exchange's map-side tasks chain on them
             if op.kind == "sort":
-                key, descending, nb = op.args
-                refs = S.sort_blocks(refs, key, descending, nb, meter=sm)
+                refs = S.sort_blocks(refs, *op.args, meter=sm)
             elif op.kind == "random_shuffle":
-                seed, nb = op.args
-                refs = S.shuffle_blocks(refs, seed, nb, meter=sm)
+                refs = S.shuffle_blocks(refs, *op.args, meter=sm)
             elif op.kind == "groupby":
-                key, agg, nb = op.args
-                refs = S.groupby_blocks(refs, key, agg, nb, meter=sm)
+                refs = S.groupby_blocks(refs, *op.args, meter=sm)
             else:  # pragma: no cover
                 raise ValueError(op.kind)
-            meter.drain()
+            stream = iter(refs)
         else:  # pragma: no cover
             raise ValueError(f"unknown op {op!r}")
-    return refs
+    return list(stream)

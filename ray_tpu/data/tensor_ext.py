@@ -47,6 +47,14 @@ class ArrowTensorArray(pa.ExtensionArray):
             arr = arr.reshape(len(arr), 1)
         n = len(arr)
         per_row = int(np.prod(arr.shape[1:]))
+        if n * per_row > np.iinfo(np.int32).max:
+            # int32 list offsets overflow past 2^31 flattened elements
+            # (~1M rows of 2048-float embeddings) — silently negative
+            # offsets corrupt the ListArray; fail loudly instead
+            raise ValueError(
+                f"tensor block too large for int32 list offsets "
+                f"({n} rows x {per_row} elements = {n * per_row}); "
+                f"split the block (smaller parallelism per block)")
         values = pa.array(arr.reshape(-1))
         offsets = pa.array(
             np.arange(0, (n + 1) * per_row, per_row, dtype=np.int32))

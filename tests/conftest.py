@@ -32,6 +32,53 @@ assert jax.default_backend() == "cpu", (
 )
 
 
+@pytest.fixture(autouse=True)
+def _hang_watchdog():
+    """Convert silent suite wedges into diagnosed failures: if any single
+    test runs >15min, faulthandler dumps EVERY thread's stack and the
+    process exits — a monolithic `pytest tests/` run must never sit
+    stalled for an hour with idle leaked workers (observed in r4: a
+    cross-file hang wedged the suite >44min with zero output)."""
+    import faulthandler
+
+    faulthandler.dump_traceback_later(900, exit=True)
+    yield
+    faulthandler.cancel_dump_traceback_later()
+
+
+def _kill_orphan_workers():
+    """Reap ray_tpu worker processes that outlived their cluster: ones
+    reparented to init (their spawning agent/head died) or still parented
+    to this pytest process after module teardown. Leaked workers hold
+    ports/sockets and wedge later modules' clusters."""
+    import signal
+
+    me = os.getpid()
+    for pid_s in os.listdir("/proc"):
+        if not pid_s.isdigit():
+            continue
+        pid = int(pid_s)
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().replace(b"\0", b" ")
+            if b"ray_tpu.core.worker_proc" not in cmd:
+                continue
+            with open(f"/proc/{pid}/stat") as f:
+                ppid = int(f.read().split()[3])
+            if ppid in (me, 1):
+                os.kill(pid, signal.SIGKILL)
+        except (OSError, ValueError, IndexError):
+            continue
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _reap_leaked_workers():
+    """Cross-file process hygiene (instantiated before, finalized after,
+    every module-scoped cluster fixture)."""
+    yield
+    _kill_orphan_workers()
+
+
 @pytest.fixture(scope="session")
 def devices8():
     devs = jax.devices()
