@@ -39,6 +39,12 @@ _DEFS: dict[str, Any] = {
     # soft cap on non-actor worker processes per node; 0 = auto
     # (max(4, 2*CPU)). See NodeAgent._pool_worker_cap.
     "max_pool_workers_per_node": 0,
+    # concurrent worker STARTUPS per node (fork -> registered); 0 = auto
+    # (max(2, host cpus)). Reference maximum_startup_concurrency
+    # (worker_pool.h): unbounded concurrent spawns thrash the host's
+    # cores with interpreter starts until every one misses the register
+    # timeout — 50 concurrent actor creations on a 1-core box all failed.
+    "worker_startup_concurrency": 0,
     # direct-task lease caching (direct_task_transport.h:110 analog)
     "worker_lease_ttl_s": 10.0,
     "worker_lease_enabled": True,

@@ -366,9 +366,38 @@ class NodeAgent:
 
     # ---------------- worker pool ----------------
 
-    async def _spawn_worker(self, job_id: bytes | None,
-                            holds_tpu: bool = False,
-                            runtime_env: dict | None = None) -> WorkerHandle:
+    @property
+    def _spawn_gate(self) -> asyncio.Semaphore:
+        """Bounds concurrent worker startups (fork → registered) —
+        reference worker_pool.h maximum_startup_concurrency. Unbounded
+        concurrent interpreter starts thrash the host until every spawn
+        misses its register timeout (observed: 50 concurrent actor
+        creations on a 1-core box all timed out at 60s)."""
+        gate = getattr(self, "_spawn_gate_sem", None)
+        if gate is None:
+            n = cfg.get("worker_startup_concurrency") or max(
+                2, os.cpu_count() or 1)
+            gate = self._spawn_gate_sem = asyncio.Semaphore(int(n))
+        return gate
+
+    async def _spawn_worker_registered(
+            self, job_id: bytes | None, holds_tpu: bool = False,
+            runtime_env: dict | None = None, *,
+            reserve: bool = False, recheck_pool_cap: bool = False,
+            gate_deadline: float | None = None) -> WorkerHandle | None:
+        """Spawn AND wait for registration, holding a startup slot from
+        fork to registered. Env materialization (package fetch, pip
+        plugin installs — possibly minutes) runs BEFORE acquiring the
+        gate so slow installs never serialize unrelated startups.
+
+        recheck_pool_cap: re-evaluate the pool cap AFTER acquiring the
+        gate — spawns parked at the gate are invisible to callers' cap
+        checks, so a burst would otherwise overshoot; returns None when
+        the cap filled while waiting. gate_deadline (monotonic): bound
+        on slot acquisition — past it PoolSaturated propagates so a
+        caller's granted resources don't sit pinned behind a wedged
+        gate. On register timeout the worker is reaped (a dead handle
+        would pin a cap slot forever) and TimeoutError propagates."""
         worker_id = os.urandom(16)
         env = dict(os.environ)
         env.update({
@@ -379,27 +408,76 @@ class NodeAgent:
             "RAY_TPU_WORKER_ID": worker_id.hex(),
             "RAY_TPU_SESSION": self.session_id,
         })
-        # runtime_env (reference _private/runtime_env/, scaled):
-        # env_vars merge into the process env; working_dir becomes the cwd;
-        # py_modules prepend to PYTHONPATH. Workers are keyed by the env
-        # hash, so an env mismatch forces a fresh process (worker_pool.h
-        # runtime-env-keyed pools).
-        cwd = None
         pkg_uris: list[str] = []
-        try:
-            return await self._spawn_with_env(
-                worker_id, env, cwd, pkg_uris, runtime_env, job_id,
-                holds_tpu)
-        except BaseException:
-            # a failed spawn (missing package blob, plugin create error,
-            # exec failure) must release the URI refcounts already
-            # acquired, or the cache dirs are pinned forever
+
+        def _release_uris():
+            # a failed spawn must release the URI refcounts already
+            # acquired, or the cache dirs are pinned forever (once the
+            # handle exists, _kill_worker/_on_worker_death own this)
             for uri in pkg_uris:
                 self.pkg_cache.release(uri)
-            raise
 
-    async def _spawn_with_env(self, worker_id, env, cwd, pkg_uris,
-                              runtime_env, job_id, holds_tpu):
+        try:
+            py_exe, cwd = await self._materialize_env(
+                env, pkg_uris, runtime_env)
+            if gate_deadline is not None:
+                try:
+                    await asyncio.wait_for(
+                        self._spawn_gate.acquire(),
+                        timeout=max(0.05,
+                                    gate_deadline - time.monotonic()))
+                except asyncio.TimeoutError:
+                    raise self.PoolSaturated(
+                        "worker startup gate saturated") from None
+            else:
+                await self._spawn_gate.acquire()
+        except BaseException:
+            _release_uris()
+            raise
+        try:
+            if recheck_pool_cap:
+                pool_ws = [x for x in self.workers.values()
+                           if x.actor_id is None]
+                n = sum(1 for x in pool_ws if not x.blocked)
+                if (n >= self._pool_worker_cap()
+                        or len(pool_ws) >= 4 * self._pool_worker_cap()):
+                    _release_uris()
+                    return None
+            try:
+                w = self._fork_worker(worker_id, py_exe, env, cwd,
+                                      pkg_uris, job_id, holds_tpu,
+                                      runtime_env)
+            except BaseException:
+                _release_uris()
+                raise
+            if reserve:
+                # an unreserved idle worker would be claimed by another
+                # waiter the moment `ready` fires
+                w.busy_task = self._RESERVED
+            try:
+                await asyncio.wait_for(
+                    w.ready.wait(),
+                    timeout=cfg.get("worker_register_timeout_s"),
+                )
+            except (asyncio.TimeoutError, asyncio.CancelledError):
+                self._kill_worker(w)
+                raise
+            return w
+        finally:
+            self._spawn_gate.release()
+
+    async def _materialize_env(self, env: dict, pkg_uris: list,
+                               runtime_env: dict | None):
+        """Resolve a runtime_env into (py_executable, cwd), mutating
+        `env` and appending acquired cache URIs to `pkg_uris`.
+
+        Reference _private/runtime_env/, scaled: env_vars merge into the
+        process env; working_dir becomes the cwd; py_modules prepend to
+        PYTHONPATH; plugin keys (pip envs, custom plugins) may swap the
+        interpreter. Workers are keyed by the env hash, so an env
+        mismatch forces a fresh process (worker_pool.h runtime-env-keyed
+        pools)."""
+        cwd = None
         if runtime_env:
             from ray_tpu._private.runtime_env import PKG_NS, PKG_SCHEME
 
@@ -451,6 +529,15 @@ class NodeAgent:
             pkg_uris.extend(
                 await rep.apply_plugins(runtime_env, ctx, self.pkg_cache))
             py_exe, cwd = ctx.py_executable, ctx.cwd
+        return py_exe, cwd
+
+    def _fork_worker(self, worker_id: bytes, py_exe: str, env: dict,
+                     cwd, pkg_uris: list, job_id: bytes | None,
+                     holds_tpu: bool,
+                     runtime_env: dict | None) -> WorkerHandle:
+        """Fork the worker process and register its handle (synchronous:
+        the handle is in self.workers before any await, so cap counts
+        stay accurate for the next gate holder)."""
         if job_id:
             env["RAY_TPU_JOB_ID"] = job_id.hex()
         proc = subprocess.Popen(
@@ -462,7 +549,7 @@ class NodeAgent:
         handle.job_id = job_id
         handle.holds_tpu = holds_tpu
         handle.env_hash = _env_hash(runtime_env)
-        handle.pkg_uris = pkg_uris  # acquired in _resolve
+        handle.pkg_uris = pkg_uris  # acquired in _materialize_env
         self.workers[worker_id] = handle
         asyncio.ensure_future(self._drain_worker_logs(handle))
         return handle
@@ -721,38 +808,29 @@ class NodeAgent:
                     # retry (pending pump) grants once it registers
                     async def _bg_spawn():
                         try:
-                            # re-check at RUN time: several refusals can
-                            # queue spawns before any executes — only the
-                            # ones still under the cap may fork
-                            pool_ws = [w for w in self.workers.values()
-                                       if w.actor_id is None]
-                            n = sum(1 for w in pool_ws if not w.blocked)
-                            if (n >= self._pool_worker_cap()
-                                    or len(pool_ws)
-                                    >= 4 * self._pool_worker_cap()):
-                                return
-                            await self._spawn_worker(
-                                job_id, holds_tpu, runtime_env)
+                            # the cap re-check runs INSIDE the startup
+                            # gate (recheck_pool_cap): several refusals
+                            # can park spawns at the gate before any
+                            # forks, and a pre-gate check would not see
+                            # them — only spawns still under the cap at
+                            # their turn may fork.
+                            await self._spawn_worker_registered(
+                                job_id, holds_tpu, runtime_env,
+                                recheck_pool_cap=True,
+                                gate_deadline=time.monotonic() + cfg.get(
+                                    "worker_register_timeout_s"))
+                        except (asyncio.TimeoutError, self.PoolSaturated):
+                            pass  # cap/gate filled; the queue path covers
                         except Exception as e:  # noqa: BLE001
                             logger.warning("background spawn failed: %s", e)
 
                     asyncio.ensure_future(_bg_spawn())
                     return None
-                w = await self._spawn_worker(job_id, holds_tpu, runtime_env)
-                # reserve: rpc_register_executor fires the free event the
-                # moment `ready` is set, and an unreserved idle worker
-                # would be claimed by a waiter while we're still awaiting
-                w.busy_task = self._RESERVED
-                try:
-                    await asyncio.wait_for(
-                        w.ready.wait(),
-                        timeout=cfg.get("worker_register_timeout_s"),
-                    )
-                except asyncio.TimeoutError:
-                    # never registered (hung import/connect): reap it or
-                    # the dead handle pins a cap slot forever
-                    self._kill_worker(w)
-                    raise
+                w = await self._spawn_worker_registered(
+                    job_id, holds_tpu, runtime_env, reserve=True,
+                    recheck_pool_cap=True, gate_deadline=deadline)
+                if w is None:
+                    continue  # cap filled while parked at the gate
                 return w
             if not wait:
                 return None
@@ -934,13 +1012,35 @@ class NodeAgent:
         for r, v in need.items():
             pool[r] = pool.get(r, 0.0) + v
 
-    def _task_pool(self, spec: dict) -> dict | None:
-        """Resource pool a task draws from: a PG bundle or the node pool."""
+    def _task_pool(self, spec: dict, pin: bool = False) -> dict | None:
+        """Resource pool a task draws from: a PG bundle or the node pool.
+
+        bundle_index < 0 means "any bundle of the PG" (reference
+        bundle_index=-1): the fitting local bundle is chosen fresh each
+        call, and PINNED onto the spec only when `pin=True` — dispatch
+        pins at GRANT time (immediately before _take, no await between)
+        so the grant and the eventual free draw from the same pool,
+        while a requeued task stays free to land on whichever bundle
+        has room next scan."""
         pgid = spec.get("pg_id")
         if pgid:
-            key = (pgid, spec.get("bundle_index", 0))
-            pool = self.bundle_available.get(key)
-            return pool  # None → bundle not on this node
+            idx = spec.get("bundle_index", 0)
+            if idx is None or idx < 0:
+                need = spec.get("resources", {})
+                fallback = None
+                for (g, i), pool in self.bundle_available.items():
+                    if g != pgid:
+                        continue
+                    if self._fits(need, pool):
+                        if pin:
+                            spec["bundle_index"] = i
+                            spec["_any_bundle"] = True
+                        return pool
+                    fallback = pool
+                # full bundles: return one anyway so dispatch waits on
+                # capacity rather than treating the PG as absent
+                return fallback
+            return self.bundle_available.get((pgid, idx))
         return self.resources_available
 
     def _free_task_resources(self, spec: dict):
@@ -949,6 +1049,10 @@ class NodeAgent:
             if pool is not None:
                 self._give(spec.get("resources", {}), pool)
             spec["_granted"] = False
+            if spec.pop("_any_bundle", None):
+                # the pin was a grant-time choice, not a user constraint:
+                # a requeued task is free to land on any bundle next scan
+                spec["bundle_index"] = -1
 
     def _release(self, r, v, bundle_key=None):
         pool = (self.bundle_available.get(bundle_key)
@@ -1314,6 +1418,10 @@ class NodeAgent:
                 stalled += 1
                 continue
             room -= 1
+            if spec.get("pg_id") and (spec.get("bundle_index", 0) or 0) < 0:
+                # pin the any-bundle choice at GRANT time (no await since
+                # the scan above, so the fitting bundle is unchanged)
+                pool = self._task_pool(spec, pin=True)
             self._take(need, pool)
             spec["_granted"] = True
             stalled = 0
@@ -1841,14 +1949,17 @@ class NodeAgent:
     async def _start_actor_async(self, p: dict, need: dict,
                                  bundle_key=None):
         try:
-            w = await self._spawn_worker(
-                p.get("job_id"), holds_tpu=need.get("TPU", 0) > 0,
-                runtime_env=p.get("runtime_env"),
-            )
-            await asyncio.wait_for(
-                w.ready.wait(),
-                timeout=cfg.get("worker_register_timeout_s"),
-            )
+            try:
+                w = await self._spawn_worker_registered(
+                    p.get("job_id"), holds_tpu=need.get("TPU", 0) > 0,
+                    runtime_env=p.get("runtime_env"), reserve=True,
+                )
+            except asyncio.TimeoutError:
+                raise rpc.RpcError(
+                    "actor worker failed to register within "
+                    f"{cfg.get('worker_register_timeout_s')}s "
+                    "(startup timeout)") from None
+            w.busy_task = None  # reservation consumed
             w.actor_id = p["actor_id"]
             w.actor_resources = need
             w.actor_bundle = bundle_key

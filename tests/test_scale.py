@@ -1,0 +1,142 @@
+"""Scale envelope — the in-suite miniature of the reference's release
+benchmarks (reference release/benchmarks/README.md: 1M+ queued tasks on a
+node, 10k+ concurrent tasks, 40k actors, 1k placement groups).
+
+Sizes are CI-scaled: this box is often a single core, so the full
+reference scale is expressed as rates and zero-failure invariants over
+a 100k-task drain, a many-actor lifecycle at bounded startup
+concurrency, and 200 placement groups. Set RAY_TPU_SCALE_ACTORS to
+raise the actor count (e.g. 1000 on a many-core box).
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(head_resources={"CPU": 8, "memory": 8 * 2**30})
+    c.connect()
+    yield c
+    c.shutdown()
+
+
+def test_100k_queued_task_drain(cluster):
+    """100k num_cpus=0 tasks queued and drained with no failures and no
+    degradation: the second half must drain at a comparable rate to the
+    first (a head/agent that degrades with queue depth — O(n^2) scans,
+    unbounded buffers — fails this)."""
+    @ray_tpu.remote(num_cpus=0)
+    def noop():
+        return 1
+
+    ray_tpu.get([noop.remote() for _ in range(64)], timeout=120)  # warm
+
+    n = 100_000
+    t0 = time.perf_counter()
+    refs = [noop.remote() for _ in range(n)]
+    t_submit = time.perf_counter() - t0
+
+    half = n // 2
+    out1 = ray_tpu.get(refs[:half], timeout=600)
+    t_half = time.perf_counter() - t0
+    out2 = ray_tpu.get(refs[half:], timeout=600)
+    t_all = time.perf_counter() - t0
+
+    assert sum(out1) + sum(out2) == n
+    rate1 = half / t_half
+    rate2 = half / max(t_all - t_half, 1e-6)
+    # NOTE: refs are drained in submission order, so by the time the
+    # first half resolves much of the second half has already executed;
+    # rate2 reflects residual drain and must not collapse
+    assert rate2 > 0.25 * rate1, (
+        f"drain degraded: first half {rate1:.0f}/s, "
+        f"second half {rate2:.0f}/s")
+    assert n / t_all > 1_000, f"overall drain {n / t_all:.0f}/s"
+    # agent fully quiesced: nothing queued or tracked as running
+    agent = cluster.head_agent
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and (
+            agent.task_queue or agent.running):
+        time.sleep(0.5)
+    assert not agent.task_queue
+    assert not agent.running
+    print(f"submit {n / t_submit:.0f}/s drain {n / t_all:.0f}/s")
+
+
+def test_many_actor_lifecycle(cluster):
+    """Concurrent actor creation at scale: every creation must succeed
+    (startup-concurrency gating — unbounded concurrent interpreter
+    starts once made ALL of 50 concurrent creations miss the register
+    timeout on a 1-core box), every call answer, every kill reap."""
+    n_total = int(os.environ.get("RAY_TPU_SCALE_ACTORS", "100"))
+    wave = 50
+
+    @ray_tpu.remote(num_cpus=0)
+    class Member:
+        def __init__(self):
+            self._n = 0
+
+        def bump(self):
+            self._n += 1
+            return self._n
+
+    created = 0
+    for start in range(0, n_total, wave):
+        k = min(wave, n_total - start)
+        actors = [Member.remote() for _ in range(k)]
+        out = ray_tpu.get([a.bump.remote() for a in actors], timeout=600)
+        assert out == [1] * k
+        for a in actors:
+            ray_tpu.kill(a)
+        created += k
+    assert created == n_total
+
+    # all actor workers reaped — no process accumulation across waves
+    agent = cluster.head_agent
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        live = [w for w in agent.workers.values()
+                if w.actor_id is not None and w.proc.poll() is None]
+        if not live:
+            break
+        time.sleep(0.5)
+    assert not live, f"{len(live)} actor workers survived kill"
+
+
+def test_200_placement_groups(cluster):
+    """200 PGs created, readied, exercised and removed; resources return
+    to the pool exactly (leaked bundle reservations fail the final
+    capacity check)."""
+    @ray_tpu.remote(num_cpus=0)
+    def where():
+        return 1
+
+    agent = cluster.head_agent
+    avail_before = dict(agent.resources_available)
+
+    pgs = []
+    for i in range(200):
+        pg = ray_tpu.placement_group([{"CPU": 0.01}], strategy="PACK")
+        pgs.append(pg)
+    for pg in pgs:
+        assert pg.ready(timeout=120)
+    # run a task inside every 10th bundle to prove they're schedulable
+    refs = [where.options(placement_group=pg).remote()
+            for pg in pgs[::10]]
+    assert sum(ray_tpu.get(refs, timeout=300)) == len(pgs[::10])
+    for pg in pgs:
+        ray_tpu.remove_placement_group(pg)
+
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if agent.resources_available.get("CPU") == avail_before.get("CPU"):
+            break
+        time.sleep(0.5)
+    assert agent.resources_available.get("CPU") == \
+        avail_before.get("CPU"), "PG removal leaked CPU reservations"
