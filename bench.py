@@ -198,9 +198,185 @@ def bench_decode(size: str, batch: int, prompt_len: int, new_tokens: int,
     }
 
 
+def bench_decode_engine(size: str, *, slots: int = 8,
+                        prompt_len: int = 128, new_tokens: int = 128,
+                        n_requests: int = 32,
+                        chunk_tokens: int = 32) -> dict:
+    """Continuous-batching ENGINE throughput (decode_engine.py driven
+    directly, ideal arrivals): the ceiling the serve path approaches
+    once HTTP/actor host overhead is excluded."""
+    import numpy as np
+
+    from ray_tpu.models.decode_engine import RaggedDecoder
+
+    cfg = llama.llama2_size(size)
+    cfg = llama.LlamaConfig(**{
+        **cfg.__dict__, "vocab_size": 32128,
+        "max_seq_len": prompt_len + new_tokens + 32,
+        "dtype": "bfloat16", "remat": False,
+    })
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    eng = RaggedDecoder(params, cfg, slots=slots,
+                        max_len=prompt_len + new_tokens + 32,
+                        chunk_tokens=chunk_tokens,
+                        prompt_buckets=(prompt_len,))
+    rng = np.random.RandomState(0)
+
+    def req():
+        return rng.randint(1, 30000, prompt_len).astype(np.int32)
+
+    sid = eng.submit(req(), chunk_tokens)  # compile prefill + chunk
+    _retry_compile(eng.drain)
+    eng.pop_finished(sid)
+
+    sids = [eng.submit(req(), new_tokens) for _ in range(n_requests)]
+    t0 = time.perf_counter()
+    eng.drain()
+    dt = time.perf_counter() - t0
+    total = sum(len(eng.finished[s].tokens) for s in sids
+                if s in eng.finished)
+    return {
+        "engine_tokens_per_sec": round(total / dt, 1),
+        "slots": slots, "chunk_tokens": chunk_tokens,
+        "prompt_len": prompt_len, "new_tokens": new_tokens,
+        "n_requests": n_requests,
+    }
+
+
+def bench_decode_serve(size: str, *, slots: int = 8,
+                       prompt_len: int = 128, new_tokens: int = 128,
+                       n_requests: int = 32, concurrency: int = 16,
+                       chunk_tokens: int = 32) -> dict:
+    """E2E SERVING decode: the 1B model behind a Serve deployment with
+    chunked continuous batching (serve/llm.py + models/decode_engine.py),
+    measured through the HTTP proxy — concurrent requests share one slot
+    batch, new streams admitted as slots free. Reports aggregate HTTP
+    tokens/s plus TTFT and chunk-normalized per-token latency
+    percentiles (tokens arrive per chunk; each positive inter-stamp gap
+    is divided by the tokens it delivered)."""
+    import http.client
+    import random
+    import threading
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.api import Deployment
+    from ray_tpu.serve.llm import LLMServer
+
+    ray_tpu.init(num_cpus=4, object_store_memory=512 * 1024 * 1024)
+    try:
+        dep = Deployment(
+            LLMServer, max_concurrent_queries=max(16, 2 * slots),
+            resources={"CPU": 0}, route_prefix="/llm")
+        serve.run(dep, name="llm", init_kwargs={
+            "model_size": size, "slots": slots,
+            "max_len": prompt_len + new_tokens + 32,
+            "chunk_tokens": chunk_tokens,
+            "prompt_buckets": (prompt_len,),
+        })
+        host, port = serve.start_http_proxy()
+
+        def post(path, body):
+            conn = http.client.HTTPConnection(host, port, timeout=590)
+            try:
+                conn.request("POST", path, json.dumps(body),
+                             {"Content-Type": "application/json"})
+                r = conn.getresponse()
+                return r.status, json.loads(r.read() or b"null")
+            finally:
+                conn.close()
+
+        # wait for the proxy to learn the route + the replica to warm
+        # (first request compiles prefill + decode chunk)
+        rnd = random.Random(0)
+        warm = {"prompt_ids": [rnd.randrange(1, 30000)
+                               for _ in range(prompt_len)],
+                "max_tokens": chunk_tokens}
+        deadline = time.time() + 600
+        while time.time() < deadline:
+            try:
+                status, _ = post("/llm", warm)
+                if status == 200:
+                    break
+            except OSError:
+                pass
+            time.sleep(1.0)
+
+        results: list[dict | None] = [None] * n_requests
+        errors: list[str] = []
+
+        def one(i):
+            body = {"prompt_ids": [rnd.randrange(1, 30000)
+                                   for _ in range(prompt_len)],
+                    "max_tokens": new_tokens}
+            try:
+                status, data = post("/llm", body)
+                if status == 200:
+                    results[i] = data
+                else:
+                    errors.append(f"http {status}")
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+
+        t0 = time.perf_counter()
+        threads: list[threading.Thread] = []
+        sem = threading.Semaphore(concurrency)
+
+        def worker(i):
+            with sem:
+                one(i)
+
+        for i in range(n_requests):
+            th = threading.Thread(target=worker, args=(i,))
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join()
+        dt = time.perf_counter() - t0
+
+        done = [r for r in results if r]
+        total_tokens = sum(len(r["tokens"]) for r in done)
+        ttfts, per_tok = [], []
+        for r in done:
+            stamps = r["token_times_s"]
+            ttfts.append(stamps[0] - r["submitted_s"])
+            gaps = np.diff(np.asarray(stamps))
+            pos = gaps[gaps > 0]
+            if len(pos):
+                per_tok.extend(pos / chunk_tokens)
+        out = {
+            "serve_tokens_per_sec": round(total_tokens / dt, 1),
+            "n_ok": len(done), "n_err": len(errors),
+            "concurrency": concurrency, "slots": slots,
+            "chunk_tokens": chunk_tokens,
+            "prompt_len": prompt_len, "new_tokens": new_tokens,
+        }
+        # empty on total failure: the error report IS the result then
+        if ttfts:
+            out["ttft_p50_s"] = round(float(np.percentile(ttfts, 50)), 3)
+            out["ttft_p99_s"] = round(float(np.percentile(ttfts, 99)), 3)
+        if per_tok:
+            out["per_token_p50_ms"] = round(
+                1000 * float(np.percentile(per_tok, 50)), 2)
+            out["per_token_p99_ms"] = round(
+                1000 * float(np.percentile(per_tok, 99)), 2)
+        if errors:
+            out["first_error"] = errors[0][:200]
+        return out
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        ray_tpu.shutdown()
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--only", choices=["350m", "1b", "decode"], default=None)
+    ap.add_argument("--only", choices=["350m", "1b", "decode", "serve"],
+                    default=None)
     args = ap.parse_args()
 
     if args.only == "350m":
@@ -214,6 +390,9 @@ def main():
         return
     if args.only == "decode":
         print(json.dumps(bench_decode("1b", 8, 128, 128)))
+        return
+    if args.only == "serve":
+        print(json.dumps(bench_decode_serve("1b")))
         return
 
     # bf16 grads: the optimizer's update math stays f32 (masters are f32);
@@ -239,6 +418,16 @@ def main():
         extra["decode_1b"] = bench_decode("1b", 8, 128, 128)
     except Exception as e:  # noqa: BLE001
         extra["decode_1b"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    try:
+        extra["decode_engine_1b"] = bench_decode_engine("1b")
+    except Exception as e:  # noqa: BLE001
+        extra["decode_engine_1b"] = {
+            "error": f"{type(e).__name__}: {e}"[:300]}
+    try:
+        extra["decode_serve_1b"] = bench_decode_serve("1b")
+    except Exception as e:  # noqa: BLE001
+        extra["decode_serve_1b"] = {
+            "error": f"{type(e).__name__}: {e}"[:300]}
 
     result = {
         "metric": "llama350m_train_tokens_per_sec_per_chip",

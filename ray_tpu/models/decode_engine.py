@@ -1,0 +1,294 @@
+"""Chunked continuous-batching decode engine (ragged KV cache).
+
+The reference serves LLMs through vLLM-style external engines (its Serve
+LLM examples, release_tests.yaml OPT-30B inference); this is the
+framework-native TPU equivalent: a fixed SLOT batch over a static-shape
+ragged cache — per-slot positions ([B] int32, unlike llama.py's
+scalar-pos cache, so every slot decodes at its own offset — new streams
+admit into free slots the moment one finishes, instead of waiting for
+the whole batch (static batching's tail waste).
+
+TPU/tunnel-shaped: decoding advances in CHUNKS of `chunk_tokens` steps
+inside one jit (lax.scan), so the per-dispatch latency (severe over the
+axon relay: ~5-15ms) is paid once per chunk, not per token. Admission
+happens at chunk boundaries — continuous batching at chunk granularity.
+Prefill runs per stream at a bucketed prompt length (one compile per
+bucket) into a temp slot-1 cache, then scatters into the slot's rows.
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import time
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models import llama
+from ray_tpu.models.llama import LlamaConfig
+
+
+def init_ragged_cache(cfg: LlamaConfig, slots: int, max_len: int) -> dict:
+    shape = (cfg.n_layers, slots, max_len, cfg.n_kv_heads, cfg.head_dim)
+    cdt = cfg.compute_dtype
+    return {
+        "k": jnp.zeros(shape, cdt),
+        "v": jnp.zeros(shape, cdt),
+        "pos": jnp.zeros((slots,), jnp.int32),  # per-slot filled length
+    }
+
+
+def _layer_decode_ragged(cfg: LlamaConfig, h, p, sin, cos, ck, cv, pos):
+    """One-token decode layer with PER-SLOT positions. h: [B, 1, D];
+    ck/cv: [B, S, Hkv, D]; pos: [B]. Writes each slot's k/v at its own
+    offset (scatter) and masks attention to k_pos <= pos per slot."""
+    from ray_tpu.ops.attention import _repeat_kv
+
+    b = h.shape[0]
+    hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    cdt = cfg.compute_dtype
+    s = ck.shape[1]
+
+    q, k, v = llama._qkv(cfg, p, h, sin, cos)  # [B, 1, H*, hd]
+    rows = jnp.arange(b)
+    ck = ck.at[rows, pos].set(k[:, 0])
+    cv = cv.at[rows, pos].set(v[:, 0])
+
+    kk = _repeat_kv(ck, hq // hkv)
+    vv = _repeat_kv(cv, hq // hkv)
+    logits = jnp.einsum(
+        "bthd,bshd->bhts", q, kk, preferred_element_type=jnp.float32
+    ) * (hd ** -0.5)
+    k_pos = jnp.arange(s, dtype=jnp.int32)[None, :]  # [1, S]
+    live = k_pos <= pos[:, None]  # [B, S] — each slot sees its prefix
+    logits = jnp.where(live[:, None, None, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(cdt)
+    o = jnp.einsum(
+        "bhts,bshd->bthd", probs, vv, preferred_element_type=jnp.float32
+    ).astype(cdt)
+    h = llama._attn_out_and_mlp(cfg, p, h, o)
+    return h, ck, cv
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "chunk"),
+                   donate_argnames=("cache", "tok"))
+def decode_chunk(params, cache, tok, active, cfg: LlamaConfig,
+                 chunk: int):
+    """Advance every ACTIVE slot `chunk` greedy tokens inside one jit.
+
+    tok: [B] current token per slot; active: [B] bool. Inactive slots
+    re-write garbage at their frozen pos (invisible: their mask never
+    advances; a later prefill overwrites). Returns ([B, chunk] tokens,
+    new cache, [B] last token)."""
+    cdt = cfg.compute_dtype
+    w_out = (
+        params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    ).astype(cdt)
+
+    def one_step(carry, _):
+        t, k, v, pos = carry
+        sin, cos = llama.rotary_embedding(
+            pos[:, None], cfg.head_dim, cfg.rope_theta)
+        h = params["embed"].astype(cdt)[t[:, None]]  # [B, 1, D]
+
+        def body(h_, xs):
+            p_, ck, cv = xs
+            h_, ck, cv = _layer_decode_ragged(
+                cfg, h_, p_, sin, cos, ck, cv, pos)
+            return h_, (ck, cv)
+
+        h, (k, v) = jax.lax.scan(body, h, (params["layers"], k, v))
+        h = llama.rms_norm(h, params["final_norm"], cfg.rms_eps)
+        logits = (h[:, 0] @ w_out).astype(jnp.float32)  # [B, V]
+        nxt = jnp.argmax(logits, axis=-1).astype(t.dtype)
+        nxt = jnp.where(active, nxt, t)  # frozen slots hold their token
+        pos = pos + active.astype(pos.dtype)
+        return (nxt, k, v, pos), nxt
+
+    (last, k, v, pos), toks = jax.lax.scan(
+        one_step, (tok, cache["k"], cache["v"], cache["pos"]),
+        None, length=chunk)
+    return jnp.moveaxis(toks, 0, 1), {"k": k, "v": v, "pos": pos}, last
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",),
+                   donate_argnames=("cache", "cur_tok"))
+def _prefill_batch_into_slots(params, prompts, true_lens, slots,
+                              cache, cur_tok, cfg: LlamaConfig):
+    """Prefill a BATCH of streams ([F, P] RIGHT-padded tokens, one
+    shared static bucket P) into their slots of the shared ragged cache
+    — prefills, k/v scatters, pos and first-token updates all in ONE
+    dispatch: over the axon tunnel each separate device call costs a
+    full fixed round-trip (~0.1-0.2s), which dominated admission when
+    every stream prefilled individually. Unused rows carry an
+    OUT-OF-RANGE slot index; mode='drop' makes their scatters no-ops.
+    Returns (new cache, new cur_tok, [F] first greedy tokens).
+
+    Right-padding is safe without a pad mask: causal attention means
+    real tokens (a prefix) never see the pad garbage, the first token
+    samples from the TRUE last prompt position, and each later decode
+    step overwrites a pad cache row at its position before the growing
+    per-slot mask can expose it."""
+    f = prompts.shape[0]
+    slot_len = cache["k"].shape[2]
+    tmp = llama.init_cache(cfg, f, slot_len)
+    logits, tmp = llama.forward_with_cache(params, prompts, cfg, tmp)
+    toks0 = jnp.argmax(
+        logits[jnp.arange(f), true_lens - 1], axis=-1).astype(jnp.int32)
+    # tmp k/v: [L, F, S, Hkv, D] -> scatter rows onto the slot axis
+    cache = {
+        "k": cache["k"].at[:, slots].set(tmp["k"], mode="drop"),
+        "v": cache["v"].at[:, slots].set(tmp["v"], mode="drop"),
+        "pos": cache["pos"].at[slots].set(true_lens, mode="drop"),
+    }
+    return cache, cur_tok.at[slots].set(toks0, mode="drop"), toks0
+
+
+@dataclass
+class _Stream:
+    sid: int
+    prompt: np.ndarray
+    max_new: int
+    tokens: list = field(default_factory=list)
+    token_times: list = field(default_factory=list)  # perf_counter stamps
+    submitted: float = 0.0
+    done: bool = False
+
+
+class RaggedDecoder:
+    """The engine: fixed slot batch + chunked continuous batching.
+
+    submit() enqueues; pump() admits queued streams into free slots
+    (prefill) and advances one chunk; finished streams free their slots
+    immediately — the next queued stream rides the same chunk cadence.
+    Thread-unsafe by design: ONE pump owner (the serve replica's loop
+    thread) drives it; submit/result queues are the boundary."""
+
+    def __init__(self, params, cfg: LlamaConfig, *, slots: int = 8,
+                 max_len: int = 512, chunk_tokens: int = 32,
+                 prompt_buckets: tuple = (32, 64, 128, 256)):
+        self.params = params
+        self.cfg = cfg
+        self.slots = slots
+        self.max_len = max_len
+        self.chunk = chunk_tokens
+        self.buckets = tuple(sorted(prompt_buckets))
+        self.cache = init_ragged_cache(cfg, slots, max_len)
+        self.cur_tok = jnp.zeros((slots,), jnp.int32)
+        self.slot_stream: list[_Stream | None] = [None] * slots
+        self.queue: collections.deque[_Stream] = collections.deque()
+        self._next_sid = 0
+        self.finished: dict[int, _Stream] = {}
+        # (stream, device tok0) fetched with the next chunk's device_get
+        self._pending_first: list = []
+
+    # -- submission boundary --
+
+    def submit(self, prompt_tokens, max_new: int) -> int:
+        """Validates HERE (caller's thread) so a bad request raises at
+        the submitter, never inside the pump loop."""
+        prompt = np.asarray(prompt_tokens, np.int32)
+        self._bucket(len(prompt))  # raises if no bucket fits
+        # clamp generation to the slot's cache capacity: past max_len
+        # the k/v scatters drop and tokens would come from a silently
+        # truncated attention window
+        room = self.max_len - len(prompt) - 1
+        if room < 1:
+            raise ValueError(
+                f"prompt of {len(prompt)} tokens leaves no decode room "
+                f"in a max_len={self.max_len} cache")
+        s = _Stream(self._next_sid, prompt, min(max_new, room),
+                    submitted=time.perf_counter())
+        self._next_sid += 1
+        self.queue.append(s)
+        return s.sid
+
+    def pop_finished(self, sid: int) -> _Stream | None:
+        return self.finished.pop(sid, None)
+
+    # -- engine internals --
+
+    def _bucket(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"prompt of {n} tokens exceeds the largest "
+                         f"bucket {self.buckets[-1]}")
+
+    def _admit(self):
+        free = [i for i, s in enumerate(self.slot_stream) if s is None]
+        grabbed: list[tuple[int, _Stream]] = []
+        while free and self.queue:
+            grabbed.append((free.pop(), self.queue.popleft()))
+        if not grabbed:
+            return
+        by_bucket: dict[int, list] = {}
+        for slot, s in grabbed:
+            by_bucket.setdefault(
+                self._bucket(len(s.prompt)), []).append((slot, s))
+        f = self.slots  # static prefill width: one compile per bucket
+        for pb, entries in by_bucket.items():
+            prompts = np.zeros((f, pb), np.int32)
+            lens = np.ones((f,), np.int32)
+            slots_arr = np.full((f,), f + 1024, np.int32)  # OOB: dropped
+            for i, (slot, s) in enumerate(entries):
+                n = len(s.prompt)
+                prompts[i, :n] = s.prompt  # right-pad
+                lens[i] = n
+                slots_arr[i] = slot
+            self.cache, self.cur_tok, toks0 = _prefill_batch_into_slots(
+                self.params, jnp.asarray(prompts), jnp.asarray(lens),
+                jnp.asarray(slots_arr), self.cache, self.cur_tok,
+                self.cfg)
+            # NO host sync here: first tokens ride the next chunk's
+            # single device_get (a per-admission sync costs a full
+            # dispatch round-trip over the tunnel)
+            for i, (slot, s) in enumerate(entries):
+                self._pending_first.append((s, toks0[i]))
+                self.slot_stream[slot] = s
+
+    def pump(self) -> int:
+        """Admit + advance one chunk; returns number of active slots.
+
+        Exactly ONE device→host sync per chunk: tokens and per-slot pos
+        fetch together. Over a high-RTT dispatch path (the axon tunnel,
+        ~10-20ms/round-trip) any per-slot scalar read here would cost
+        more than the chunk's compute."""
+        self._admit()
+        active_mask = np.array(
+            [st is not None for st in self.slot_stream])
+        if not active_mask.any():
+            return 0
+        toks, self.cache, self.cur_tok = decode_chunk(
+            self.params, self.cache, self.cur_tok,
+            active_mask, self.cfg, self.chunk)
+        firsts, self._pending_first = self._pending_first, []
+        toks, pos_np, first_toks = jax.device_get(
+            (toks, self.cache["pos"], [t for _, t in firsts]))
+        t_now = time.perf_counter()
+        for (s, _), t0 in zip(firsts, first_toks):
+            s.tokens.append(int(t0))
+            s.token_times.append(t_now)
+        for slot, s in enumerate(self.slot_stream):
+            if s is None:
+                continue
+            take = min(self.chunk, s.max_new - len(s.tokens))
+            s.tokens.extend(int(t) for t in toks[slot, :take])
+            s.token_times.extend([t_now] * take)
+            if len(s.tokens) >= s.max_new \
+                    or int(pos_np[slot]) >= self.max_len - 1:
+                s.done = True
+                self.finished[s.sid] = s
+                self.slot_stream[slot] = None  # slot freed THIS chunk
+        return int(active_mask.sum())
+
+    def drain(self, deadline_s: float = 600.0) -> None:
+        t0 = time.monotonic()
+        while (self.queue or any(s is not None
+                                 for s in self.slot_stream)):
+            if time.monotonic() - t0 > deadline_s:
+                raise TimeoutError("decode drain exceeded deadline")
+            self.pump()

@@ -1,0 +1,102 @@
+"""APPO: asynchronous PPO on the IMPALA actor-learner machinery.
+
+Reference: rllib/algorithms/appo/appo.py:1 — IMPALA's architecture
+(async env-runners, learner consumes whichever batch lands first,
+per-runner weight refresh) with PPO's clipped surrogate objective over
+importance-corrected advantages and a TARGET network whose values
+bootstrap the V-trace targets (decoupling the regression target from
+the fast-moving online critic).
+
+TPU-first: the whole update — V-trace reverse scan, clipped surrogate,
+optimizer — is one jitted function inherited from the IMPALA runner
+pipeline; only `_build_update` and the target-refresh cadence differ.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ray_tpu.rl.impala import IMPALA, IMPALAConfig
+
+
+@dataclass
+class APPOConfig(IMPALAConfig):
+    clip: float = 0.2
+    # learner steps between target-network refreshes (reference
+    # appo.py target_update_frequency)
+    target_update_freq: int = 8
+
+    def build(self) -> "APPO":
+        return APPO(self)
+
+
+class APPO(IMPALA):
+    def __init__(self, config: APPOConfig):
+        super().__init__(config)
+        import jax
+        import jax.numpy as jnp
+
+        # target network: value bootstrap source (reference
+        # appo_torch_policy's target model)
+        self.target_params = jax.tree_util.tree_map(
+            jnp.copy, self.params)
+        self._steps_since_target = 0
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rl.vtrace import vtrace
+
+        cfg = self.config
+
+        def _loss(params, target, batch):
+            logp, values, logp_all = self._policy_logp_values(
+                params, batch)
+            # value targets bootstrap from the TARGET network: the
+            # regression target must not chase the online critic
+            _, target_values, _ = self._policy_logp_values(target, batch)
+            vs, adv = vtrace(
+                batch["logp"], jax.lax.stop_gradient(logp),
+                batch["rewards"], target_values,
+                batch["last_values"], batch["dones"],
+                gamma=cfg.gamma, lam=cfg.vtrace_lam,
+                rho_bar=cfg.rho_bar, c_bar=cfg.c_bar,
+            )
+            # PPO clipped surrogate against the BEHAVIOUR policy's logp
+            # (the batch was sampled under slightly stale weights; the
+            # clip bounds how far the update exploits that gap)
+            ratio = jnp.exp(logp - batch["logp"])
+            pg = -jnp.mean(jnp.minimum(
+                ratio * adv,
+                jnp.clip(ratio, 1 - cfg.clip, 1 + cfg.clip) * adv,
+            ))
+            vf = jnp.mean((values - vs) ** 2)
+            ent = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, -1))
+            total = pg + cfg.vf_coeff * vf - cfg.entropy_coeff * ent
+            return total, {"policy_loss": pg, "vf_loss": vf,
+                           "entropy": ent, "total_loss": total,
+                           "mean_ratio": jnp.mean(ratio)}
+
+        def _update(params, target, opt_state, batch):
+            (_, metrics), grads = jax.value_and_grad(
+                _loss, has_aux=True)(params, target, batch)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, metrics
+
+        return _update
+
+    def _apply_batch(self, jb) -> dict:
+        import jax
+        import jax.numpy as jnp
+
+        self.params, self.opt_state, metrics = self._update(
+            self.params, self.target_params, self.opt_state, jb)
+        self._steps_since_target += 1
+        if self._steps_since_target >= self.config.target_update_freq:
+            self.target_params = jax.tree_util.tree_map(
+                jnp.copy, self.params)
+            self._steps_since_target = 0
+        return metrics

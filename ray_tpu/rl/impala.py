@@ -40,6 +40,11 @@ class IMPALAConfig:
     c_bar: float = 1.0
     vf_coeff: float = 0.5
     entropy_coeff: float = 0.01
+    # an RLModule (rl_module.py); None -> DiscretePolicyModule. Must be
+    # runner-compatible: VectorEnvRunner forwards with the MLP policy
+    # nets, so only DiscretePolicyModule param trees can be pushed to
+    # runners (build() enforces this).
+    module: object | None = None
 
     def build(self) -> "IMPALA":
         return IMPALA(self)
@@ -50,28 +55,73 @@ class IMPALA:
         import jax
         import optax
 
-        from ray_tpu.rl import models
-        from ray_tpu.rl.vtrace import vtrace
+        from ray_tpu.rl.rl_module import DiscretePolicyModule
 
         assert config.env_creator is not None
         self.config = config
         cfg = config
-        self.params = models.init_policy(
-            jax.random.PRNGKey(0), cfg.obs_dim, cfg.n_actions)
+        if cfg.module is not None and not isinstance(
+                cfg.module, DiscretePolicyModule):
+            raise ValueError(
+                "IMPALA/APPO push the learner's weights to "
+                "VectorEnvRunner, which samples with the MLP policy "
+                "nets — config.module must be a DiscretePolicyModule "
+                f"(got {type(cfg.module).__name__})")
+        self.module = cfg.module or DiscretePolicyModule(
+            cfg.obs_dim, cfg.n_actions)
+        self.params = self.module.init(jax.random.PRNGKey(0))
         self.opt = optax.adam(cfg.lr)
         self.opt_state = self.opt.init(self.params)
+        self._update = jax.jit(self._build_update())
+
+        blob = serialization.pack_callable(cfg.env_creator)
+        self.runners = [
+            VectorEnvRunner.remote(
+                blob, cfg.obs_dim, cfg.n_actions,
+                num_envs=cfg.num_envs_per_runner, seed=i)
+            for i in range(cfg.num_env_runners)
+        ]
+        w = jax.device_get(self.params)
+        ray_tpu.get([r.set_weights.remote(w) for r in self.runners],
+                    timeout=120)
+        # the async pipeline: one sample request ALWAYS in flight per
+        # runner (reference impala.py's aggregation of async sample reqs);
+        # wait() returns the identical ref objects, so identity keys work
+        self._inflight = {
+            r.sample.remote(cfg.rollout_steps): r for r in self.runners
+        }
+        self.iteration = 0
+
+    def _policy_logp_values(self, params, batch):
+        """[T, N] logp of taken actions, values, and full log-softmax —
+        shared by the IMPALA and APPO losses (module contract)."""
+        import jax
+        import jax.numpy as jnp
+
+        t, n = batch["actions"].shape
+        flat_obs = batch["obs"].reshape(t * n, -1)
+        out = self.module.forward_train(params, flat_obs)
+        logits = out["logits"].reshape(t, n, -1)
+        values = out["vf"].reshape(t, n)
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+        return logp, values, logp_all
+
+    def _build_update(self):
+        """Return the jitted (params, opt_state, batch) -> update fn.
+        APPO overrides this seam with its clipped off-policy loss."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rl.vtrace import vtrace
+
+        cfg = self.config
 
         def _loss(params, batch):
-            import jax.numpy as jnp
-
-            t, n, d = batch["obs"].shape
-            flat_obs = batch["obs"].reshape(t * n, d)
-            logits, values = models.forward(params, flat_obs)
-            logits = logits.reshape(t, n, -1)
-            values = values.reshape(t, n)
-            logp_all = jax.nn.log_softmax(logits)
-            logp = jnp.take_along_axis(
-                logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+            logp, values, logp_all = self._policy_logp_values(
+                params, batch)
             vs, adv = vtrace(
                 batch["logp"], logp, batch["rewards"], values,
                 batch["last_values"], batch["dones"],
@@ -92,25 +142,13 @@ class IMPALA:
             params = optax.apply_updates(params, updates)
             return params, opt_state, metrics
 
-        self._update = jax.jit(_update)
+        return _update
 
-        blob = serialization.pack_callable(cfg.env_creator)
-        self.runners = [
-            VectorEnvRunner.remote(
-                blob, cfg.obs_dim, cfg.n_actions,
-                num_envs=cfg.num_envs_per_runner, seed=i)
-            for i in range(cfg.num_env_runners)
-        ]
-        w = jax.device_get(self.params)
-        ray_tpu.get([r.set_weights.remote(w) for r in self.runners],
-                    timeout=120)
-        # the async pipeline: one sample request ALWAYS in flight per
-        # runner (reference impala.py's aggregation of async sample reqs);
-        # wait() returns the identical ref objects, so identity keys work
-        self._inflight = {
-            r.sample.remote(cfg.rollout_steps): r for r in self.runners
-        }
-        self.iteration = 0
+    def _apply_batch(self, jb) -> dict:
+        """Apply one landed sample batch (APPO overrides: target net)."""
+        self.params, self.opt_state, metrics = self._update(
+            self.params, self.opt_state, jb)
+        return metrics
 
     def train(self) -> dict:
         """Consume batches as they land for one learner round
@@ -133,8 +171,7 @@ class IMPALA:
             batch = ray_tpu.get(ref, timeout=120)
             ep_means.append(batch.pop("episode_return_mean"))
             jb = {k: jnp.asarray(v) for k, v in batch.items()}
-            self.params, self.opt_state, metrics = self._update(
-                self.params, self.opt_state, jb)
+            metrics = self._apply_batch(jb)
             # refresh ONLY this runner, then immediately re-arm it:
             # sampling continues under the fresh (or slightly stale for
             # others) policy — V-trace absorbs the lag

@@ -16,8 +16,6 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from ray_tpu.rl import models
-
 
 def compute_gae(rewards, values, dones, last_value, *, gamma=0.99,
                 lam=0.95):
@@ -45,13 +43,20 @@ def normalize_advantages(batch: dict) -> dict:
 
 
 class Learner:
-    """Owns params + optimizer state; update() is jitted once."""
+    """Owns params + optimizer state; update() is jitted once.
+
+    `module` is any RLModule (rl_module.py — reference rl_module.py:1):
+    the loss below consumes only its forward_train contract, so the
+    same Learner trains the MLP default, the conv VisionPolicyModule,
+    or a user-defined module unchanged."""
 
     def __init__(self, obs_dim: int, n_actions: int, *, lr=3e-4,
-                 clip=0.2, vf_coeff=0.5, entropy_coeff=0.01, seed=0):
-        self.params = models.init_policy(
-            jax.random.PRNGKey(seed), obs_dim, n_actions
-        )
+                 clip=0.2, vf_coeff=0.5, entropy_coeff=0.01, seed=0,
+                 module=None):
+        from ray_tpu.rl.rl_module import DiscretePolicyModule
+
+        self.module = module or DiscretePolicyModule(obs_dim, n_actions)
+        self.params = self.module.init(jax.random.PRNGKey(seed))
         self.opt = optax.adam(lr)
         self.opt_state = self.opt.init(self.params)
         self.clip = clip
@@ -61,7 +66,8 @@ class Learner:
         self._update = jax.jit(self._update_fn)
 
     def _loss(self, params, batch):
-        logits, value = models.forward(params, batch["obs"])
+        out = self.module.forward_train(params, batch["obs"])
+        logits, value = out["logits"], out["vf"]
         logp_all = jax.nn.log_softmax(logits)
         logp = jnp.take_along_axis(
             logp_all, batch["actions"][:, None], axis=1

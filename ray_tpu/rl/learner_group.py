@@ -21,6 +21,38 @@ import ray_tpu
 from ray_tpu.collective import CollectiveActorMixin, create_collective_group
 
 
+def allreduce_grads_rowmean(grads, n_rows: int, group_name: str):
+    """Row-weighted mean of a gradient pytree across a collective group,
+    packed as ONE contiguous vector (one collective per step, not one
+    per parameter).
+
+    Each replica's gradient is a mean over its (possibly unequal) shard;
+    weighting by row count makes the result equal the mean over the
+    UNION — the full-batch gradient. The row count rides as the vector's
+    last element, so one allreduce carries both. Shared by the PPO and
+    SAC learner actors."""
+    import jax
+
+    from ray_tpu import collective
+
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    flat = np.concatenate(
+        [np.asarray(x, np.float32).ravel() for x in leaves]
+        + [np.float32([1.0])])
+    flat[:-1] *= n_rows
+    flat[-1] = n_rows
+    summed = np.asarray(
+        collective.allreduce(flat, group_name=group_name))
+    total_rows = summed[-1]
+    summed = summed[:-1] / total_rows
+    out, off = [], 0
+    for x in leaves:
+        size = int(np.prod(x.shape)) if x.shape else 1
+        out.append(summed[off:off + size].reshape(x.shape))
+        off += size
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 @ray_tpu.remote(num_cpus=1)
 class LearnerActor(CollectiveActorMixin):
     """One learner replica (reference learner_group.py worker)."""
@@ -61,33 +93,7 @@ class LearnerActor(CollectiveActorMixin):
                        grad_hook=hook)
 
     def _allreduce_mean(self, grads, n_rows: int):
-        """Row-weighted mean across replicas, packed as ONE vector.
-
-        Each replica's gradient is a mean over its (possibly unequal)
-        shard minibatch; weighting by row count makes the result equal
-        the mean over the UNION — the full-batch gradient. The row count
-        rides as the vector's last element, so one allreduce carries
-        both."""
-        import jax
-
-        from ray_tpu import collective
-
-        leaves, treedef = jax.tree_util.tree_flatten(grads)
-        flat = np.concatenate(
-            [np.asarray(x, np.float32).ravel() for x in leaves]
-            + [np.float32([1.0])])
-        flat[:-1] *= n_rows
-        flat[-1] = n_rows
-        summed = np.asarray(
-            collective.allreduce(flat, group_name=self._group))
-        total_rows = summed[-1]
-        summed = summed[:-1] / total_rows
-        out, off = [], 0
-        for x in leaves:
-            size = int(np.prod(x.shape)) if x.shape else 1
-            out.append(summed[off:off + size].reshape(x.shape))
-            off += size
-        return jax.tree_util.tree_unflatten(treedef, out)
+        return allreduce_grads_rowmean(grads, n_rows, self._group)
 
     def get_weights(self):
         return self.learner.get_weights()
@@ -133,6 +139,10 @@ class LearnerGroup:
 
         batch = normalize_advantages(batch)  # once, BEFORE sharding
         n = len(batch["obs"])
+        if n < self.num_learners:
+            raise ValueError(
+                f"batch of {n} rows cannot shard across "
+                f"{self.num_learners} learners")
         shards = np.array_split(np.arange(n), self.num_learners)
         refs = []
         for shard, actor in zip(shards, self.learners):
@@ -150,6 +160,122 @@ class LearnerGroup:
     def set_weights(self, params):
         ray_tpu.get([a.set_weights.remote(params) for a in self.learners],
                     timeout=120)
+
+    def shutdown(self):
+        for a in self.learners:
+            try:
+                ray_tpu.kill(a)
+            except Exception:  # noqa: BLE001
+                pass
+
+
+@ray_tpu.remote(num_cpus=1)
+class SACLearnerActor(CollectiveActorMixin):
+    """One SAC learner replica (continuous control; rl/sac.py)."""
+
+    def __init__(self, obs_dim: int, action_dim: int, seed: int = 0,
+                 **learner_kwargs):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from ray_tpu.rl.sac import SACLearner
+
+        self.learner = SACLearner(obs_dim, action_dim, seed=seed,
+                                  **learner_kwargs)
+        self._group: str | None = None
+        self._world = 1
+
+    def join_group(self, world_size: int, rank: int, group_name: str):
+        self._group = group_name
+        self._world = world_size
+        self._rank = rank
+        return True
+
+    def update_shard(self, batch: dict) -> dict:
+        """One SAC step on THIS replica's shard. The driver generated
+        the reparameterization noise on the FULL batch and sliced it
+        with the rows (sac.py sample_action_with_noise), so the
+        row-weighted allreduced gradient equals the full-batch gradient
+        and every replica applies the identical update."""
+        hook = None
+        if self._group is not None and self._world > 1:
+            def hook(grads, n_rows):
+                return allreduce_grads_rowmean(grads, n_rows, self._group)
+        return {k: float(v)
+                for k, v in self.learner.update(batch,
+                                                grad_hook=hook).items()}
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def act_deterministic(self, obs):
+        import numpy as np_
+
+        return np_.asarray(self.learner.act(obs, None, deterministic=True))
+
+
+class SACLearnerGroup:
+    """Distributed SAC learning (the continuous-control LearnerGroup —
+    reference learner_group.py:61 with SACLearner replicas). Noise is
+    drawn ONCE per update on the driver and sharded with the batch rows,
+    making the N-replica update equal the single-learner update on the
+    full batch (parity test in tests/test_rl_sac.py)."""
+
+    _seq = 0
+
+    def __init__(self, obs_dim: int, action_dim: int, *,
+                 num_learners: int = 2, seed: int = 0, **learner_kwargs):
+        import jax
+
+        SACLearnerGroup._seq += 1
+        self.num_learners = num_learners
+        self.action_dim = action_dim
+        self._key = jax.random.PRNGKey(seed + 1)
+        self.learners = [
+            SACLearnerActor.remote(obs_dim, action_dim, seed=seed,
+                                   **learner_kwargs)
+            for _ in range(num_learners)
+        ]
+        if num_learners > 1:
+            group = f"sac_learner_group_{SACLearnerGroup._seq}"
+            create_collective_group(
+                self.learners, num_learners,
+                list(range(num_learners)), group_name=group)
+            ray_tpu.get(
+                [a.join_group.remote(num_learners, r, group)
+                 for r, a in enumerate(self.learners)],
+                timeout=120,
+            )
+
+    def update(self, batch: dict) -> dict:
+        """Draw full-batch noise, shard rows + noise, run the lockstep
+        distributed step."""
+        import jax
+
+        n = len(batch["obs"])
+        if n < self.num_learners:
+            # an empty shard's mean-loss is NaN and the row-weighted
+            # allreduce (NaN * 0) would poison every replica's weights
+            raise ValueError(
+                f"batch of {n} rows cannot shard across "
+                f"{self.num_learners} learners")
+        batch = dict(batch)
+        if "noise_pi" not in batch:  # caller-provided noise wins (tests)
+            self._key, ka, kt = jax.random.split(self._key, 3)
+            batch["noise_pi"] = np.asarray(
+                jax.random.normal(ka, (n, self.action_dim)))
+            batch["noise_next"] = np.asarray(
+                jax.random.normal(kt, (n, self.action_dim)))
+        shards = np.array_split(np.arange(n), self.num_learners)
+        refs = []
+        for shard, actor in zip(shards, self.learners):
+            sub = {k: np.asarray(batch[k])[shard] for k in batch}
+            refs.append(actor.update_shard.remote(sub))
+        return ray_tpu.get(refs, timeout=600)[0]
+
+    def get_weights(self):
+        return ray_tpu.get(self.learners[0].get_weights.remote(),
+                           timeout=120)
 
     def shutdown(self):
         for a in self.learners:

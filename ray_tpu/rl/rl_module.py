@@ -1,0 +1,135 @@
+"""RLModule: the framework-agnostic policy-module abstraction.
+
+Reference: rllib/core/rl_module/rl_module.py:1 — a module declares
+`forward_train` / `forward_inference` / `forward_exploration` and the
+algorithm's Learner owns the loss, so one network definition serves
+every algorithm. TPU-first redesign: a module is a thin namespace of
+PURE jittable functions over a params pytree (init/forward_*), not a
+stateful framework object — params stay explicit, the functions close
+over only static shape config, so the same module instance can be
+jitted into a single-process Learner, shipped to LearnerGroup actors,
+or traced under a sharded mesh without any wrapper (the reference
+needs TorchDDPRLModule etc. per framework; here SPMD is just jit).
+
+Contract: `forward_train(params, obs) -> {"logits": [B, A], "vf": [B]}`
+for discrete-policy modules; SAC-style continuous modules expose their
+own heads (see sac.py — actor/critic trees with actor_dist/q_value).
+`forward_inference` is the greedy action; `forward_exploration`
+samples and returns (action, logp) for rollout collection.
+
+VisionPolicyModule is the conv-policy analog of the reference's
+rllib/models/torch/visionnet.py:1 — NHWC layout (TPU-native conv
+layout; XLA maps NHWC conv + relu onto the MXU without transposes).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rl import models
+
+
+class RLModule:
+    """Abstract module spec. Subclasses hold only STATIC config (shapes,
+    hidden sizes) — all state lives in the params pytree."""
+
+    def init(self, key):
+        raise NotImplementedError
+
+    def forward_train(self, params, obs) -> dict:
+        raise NotImplementedError
+
+    def forward_inference(self, params, obs):
+        out = self.forward_train(params, obs)
+        return jnp.argmax(out["logits"], axis=-1)
+
+    def forward_exploration(self, params, obs, key):
+        out = self.forward_train(params, obs)
+        logits = out["logits"]
+        act = jax.random.categorical(key, logits)
+        logp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits), act[:, None], axis=1)[:, 0]
+        return act, logp
+
+
+class DiscretePolicyModule(RLModule):
+    """MLP torso + categorical policy + value head — the default module
+    for PPO / IMPALA / APPO (wraps the nets in models.py)."""
+
+    def __init__(self, obs_dim: int, n_actions: int, hidden: int = 64):
+        self.obs_dim = obs_dim
+        self.n_actions = n_actions
+        self.hidden = hidden
+
+    def init(self, key):
+        return models.init_policy(key, self.obs_dim, self.n_actions,
+                                  hidden=self.hidden)
+
+    def forward_train(self, params, obs) -> dict:
+        logits, vf = models.forward(params, obs)
+        return {"logits": logits, "vf": vf}
+
+
+class VisionPolicyModule(RLModule):
+    """Conv policy for image observations (reference visionnet.py:1):
+    two stride-2 3x3 convs -> dense torso -> logits/value heads.
+    obs is [B, H, W, C] float; NHWC/HWIO are the TPU conv layouts."""
+
+    def __init__(self, obs_shape: tuple, n_actions: int,
+                 channels: tuple = (16, 32), hidden: int = 128):
+        assert len(obs_shape) == 3, "VisionPolicyModule wants [H, W, C]"
+        self.obs_shape = tuple(obs_shape)
+        self.n_actions = n_actions
+        self.channels = tuple(channels)
+        self.hidden = hidden
+
+    def _flat_dim(self) -> int:
+        h, w, _ = self.obs_shape
+        for _c in self.channels:
+            h = (h + 1) // 2  # stride-2 SAME conv
+            w = (w + 1) // 2
+        return h * w * self.channels[-1]
+
+    def init(self, key):
+        ks = jax.random.split(key, len(self.channels) + 3)
+        params = {}
+        cin = self.obs_shape[-1]
+        for i, cout in enumerate(self.channels):
+            # HWIO filter layout; fan-in scaled init
+            params[f"conv{i}"] = {
+                "w": jax.random.normal(
+                    ks[i], (3, 3, cin, cout), jnp.float32
+                ) / jnp.sqrt(9 * cin),
+                "b": jnp.zeros((cout,), jnp.float32),
+            }
+            cin = cout
+
+        def dense(k, i, o):
+            return {
+                "w": jax.random.normal(k, (i, o), jnp.float32)
+                / jnp.sqrt(i),
+                "b": jnp.zeros((o,), jnp.float32),
+            }
+
+        params["torso"] = dense(ks[-3], self._flat_dim(), self.hidden)
+        params["pi"] = dense(ks[-2], self.hidden, self.n_actions)
+        params["vf"] = dense(ks[-1], self.hidden, 1)
+        return params
+
+    def forward_train(self, params, obs) -> dict:
+        x = obs.astype(jnp.float32)
+        if x.ndim == 2:  # flattened rows (e.g. riding a [B, D] batch)
+            x = x.reshape(-1, *self.obs_shape)
+        for i in range(len(self.channels)):
+            p = params[f"conv{i}"]
+            x = jax.lax.conv_general_dilated(
+                x, p["w"], window_strides=(2, 2), padding="SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            ) + p["b"]
+            x = jax.nn.relu(x)
+        x = x.reshape(x.shape[0], -1)
+        h = jnp.tanh(x @ params["torso"]["w"] + params["torso"]["b"])
+        logits = h @ params["pi"]["w"] + params["pi"]["b"]
+        vf = (h @ params["vf"]["w"] + params["vf"]["b"])[:, 0]
+        return {"logits": logits, "vf": vf}

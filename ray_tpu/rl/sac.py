@@ -81,12 +81,15 @@ def actor_dist(actor, obs):
     return mu, log_std
 
 
-def sample_action(actor, obs, key, action_scale: float):
-    """Reparameterized tanh-Gaussian sample: (action [B, A] in
-    [-scale, scale], log-prob [B] with the tanh/scale Jacobian folded in)."""
+def sample_action_with_noise(actor, obs, noise, action_scale: float):
+    """Reparameterized tanh-Gaussian with CALLER-provided unit normals
+    ([B, A]): (action [B, A] in [-scale, scale], log-prob [B] with the
+    tanh/scale Jacobian folded in). Noise rides the batch so a sharded
+    SACLearnerGroup slices per-row noise with the rows — the allreduced
+    gradient then equals the full-batch gradient exactly."""
     mu, log_std = actor_dist(actor, obs)
     std = jnp.exp(log_std)
-    u = mu + std * jax.random.normal(key, mu.shape)
+    u = mu + std * noise
     a = jnp.tanh(u)
     # N(u; mu, std) log-density minus log|d tanh/du| minus log(scale)
     logp = (
@@ -94,6 +97,13 @@ def sample_action(actor, obs, key, action_scale: float):
         - jnp.log(1.0 - a ** 2 + 1e-6) - jnp.log(action_scale)
     ).sum(axis=-1)
     return a * action_scale, logp
+
+
+def sample_action(actor, obs, key, action_scale: float):
+    """Key-driven convenience wrapper over sample_action_with_noise."""
+    mu, _ = actor_dist(actor, obs)
+    return sample_action_with_noise(
+        actor, obs, jax.random.normal(key, mu.shape), action_scale)
 
 
 def q_value(q, obs, act):
@@ -108,7 +118,10 @@ class SACLearner:
     one optax chain each over masked subtrees would be equivalent; kept
     explicit for readability). `grad_fn`/`apply_grads` form the
     LearnerGroup seam: gradients over the WHOLE params tree computed on a
-    shard can be allreduced before apply (see SACLearnerGroup)."""
+    shard are allreduced before apply by SACLearnerGroup
+    (rl/learner_group.py), whose sharded update is gradient-identical to
+    this single-process learner because the reparameterization noise
+    rides the batch rows."""
 
     def __init__(self, obs_dim: int, action_dim: int, *,
                  action_scale: float = 1.0, lr: float = 3e-4,
@@ -149,14 +162,19 @@ class SACLearner:
 
     # -- losses --
 
-    def _loss(self, params, target, batch, key):
-        ka, kt = jax.random.split(key)
+    def _loss(self, params, target, batch):
+        # reparameterization noise arrives IN the batch ("noise_pi" /
+        # "noise_next", [B, A] unit normals): sharded learners slice it
+        # with the rows, so the group's row-weighted-mean gradient is
+        # bit-for-bit the full-batch gradient (update() synthesizes the
+        # noise when the caller didn't)
         obs, act = batch["obs"], batch["actions"]
         alpha = jnp.exp(params["log_alpha"])
 
         # critic: y = r + gamma (1-d) [min Q_tgt(s', a') - alpha logp(a')]
-        a_next, logp_next = sample_action(
-            params["actor"], batch["next_obs"], kt, self.action_scale
+        a_next, logp_next = sample_action_with_noise(
+            params["actor"], batch["next_obs"], batch["noise_next"],
+            self.action_scale
         )
         q_next = jnp.minimum(
             q_value(target["q1"], batch["next_obs"], a_next),
@@ -173,8 +191,8 @@ class SACLearner:
 
         # actor: alpha logp - min Q, through the reparameterized sample;
         # stop-grad the critics so the actor term cannot train them
-        a_pi, logp_pi = sample_action(
-            params["actor"], obs, ka, self.action_scale
+        a_pi, logp_pi = sample_action_with_noise(
+            params["actor"], obs, batch["noise_pi"], self.action_scale
         )
         q_pi = jnp.minimum(
             q_value(jax.lax.stop_gradient(params["q1"]), obs, a_pi),
@@ -198,10 +216,10 @@ class SACLearner:
             "entropy": -jnp.mean(logp_pi),
         }
 
-    def _grad_fn(self, params, target, batch, key):
+    def _grad_fn(self, params, target, batch):
         (_, metrics), grads = jax.value_and_grad(
             self._loss, has_aux=True
-        )(params, target, batch, key)
+        )(params, target, batch)
         return grads, metrics
 
     def _apply_fn(self, params, target, opt_state, grads):
@@ -219,8 +237,24 @@ class SACLearner:
         self._key, k = jax.random.split(self._key)
         return k
 
-    def grad_fn(self, batch: dict, key) -> tuple:
-        return self._grad(self.params, self.target, batch, key)
+    def with_noise(self, batch: dict, key=None) -> dict:
+        """Return a copy of `batch` carrying reparameterization noise
+        (no-op if already present). The group path calls this ONCE on
+        the full batch before sharding."""
+        if "noise_pi" in batch:
+            return batch
+        ka, kt = jax.random.split(
+            self.next_key() if key is None else key)
+        adim = self.params["actor"]["mu"]["b"].shape[0]
+        b = len(batch["obs"])
+        out = dict(batch)
+        out["noise_pi"] = jax.random.normal(ka, (b, adim))
+        out["noise_next"] = jax.random.normal(kt, (b, adim))
+        return out
+
+    def grad_fn(self, batch: dict, key=None) -> tuple:
+        return self._grad(self.params, self.target,
+                          self.with_noise(batch, key))
 
     def apply_grads(self, grads):
         self.params, self.target, self.opt_state = self._apply(
@@ -230,7 +264,7 @@ class SACLearner:
     def update(self, batch: dict, *, grad_hook=None) -> dict:
         """One gradient step; grad_hook(grads, n_rows) -> grads is the
         allreduce seam between gradient and apply."""
-        grads, metrics = self.grad_fn(batch, self.next_key())
+        grads, metrics = self.grad_fn(batch)
         if grad_hook is not None:
             grads = grad_hook(grads, len(batch["obs"]))
         self.apply_grads(grads)

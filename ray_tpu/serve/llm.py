@@ -1,0 +1,130 @@
+"""LLM serving deployment: continuous-batching decode behind Serve.
+
+Reference anchor: the reference's LLM serving examples and its OPT-30B
+inference release test (release_tests.yaml) run decode through Serve
+replicas; this is the TPU-native equivalent — each replica owns a
+RaggedDecoder (models/decode_engine.py: fixed slot batch, chunked
+continuous batching over a ragged KV cache) and a pump thread. Handler
+threads (the replica runs with actor max_concurrency) only enqueue and
+wait; every device step happens on the ONE pump thread, so concurrent
+HTTP requests ride the same slot batch — admission into free slots at
+chunk boundaries, not a new batch per request.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class LLMServer:
+    """Deployable class (wrap with @serve.deployment or Deployment(...)).
+
+    init builds the model on THIS replica's device (TPU when the
+    replica process sees one, else CPU). generate() blocks its handler
+    thread until the stream finishes and returns tokens + per-token
+    latency stamps, so the caller can compute p50/p99."""
+
+    def __init__(self, model_size: str = "tiny", *, slots: int = 8,
+                 max_len: int = 512, chunk_tokens: int = 16,
+                 vocab_size: int = 32128, seed: int = 0,
+                 prompt_buckets: tuple = (32, 64, 128, 256)):
+        import os
+
+        import jax
+
+        if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+            # the image's sitecustomize force-resets jax_platforms in
+            # every process; the env var alone is silently ignored
+            jax.config.update("jax_platforms", "cpu")
+
+        from ray_tpu.models import llama
+        from ray_tpu.models.decode_engine import RaggedDecoder
+
+        if model_size == "tiny":  # test-sized config
+            cfg = llama.LlamaConfig(
+                vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                n_kv_heads=2, d_ff=128, max_seq_len=max_len,
+                dtype="float32", remat=False)
+        else:
+            base = llama.llama2_size(model_size)
+            cfg = llama.LlamaConfig(**{
+                **base.__dict__, "vocab_size": vocab_size,
+                "max_seq_len": max_len, "dtype": "bfloat16",
+                "remat": False,
+            })
+        params = llama.init_params(cfg, jax.random.PRNGKey(seed))
+        self.engine = RaggedDecoder(
+            params, cfg, slots=slots, max_len=max_len,
+            chunk_tokens=chunk_tokens, prompt_buckets=prompt_buckets)
+        self._lock = threading.Lock()
+        self._done_events: dict[int, threading.Event] = {}
+        self._stop = False
+        self._pump_thread = threading.Thread(
+            target=self._pump_loop, daemon=True,
+            name="llm-decode-pump")
+        self._pump_thread.start()
+
+    def _pump_loop(self):
+        # engine state is touched ONLY by this thread; handlers interact
+        # through submit (guarded by the small lock) and the finished
+        # dict (written here BEFORE the event is set, read by the
+        # handler only AFTER it) — the pump never holds a lock across
+        # device work, so submissions land during the chunk wait
+        import logging
+
+        while not self._stop:
+            try:
+                busy = self.engine.pump()
+            except Exception:  # noqa: BLE001 — the pump must survive:
+                # a dead pump thread bricks the replica for every
+                # in-flight and future request (submit-time validation
+                # rejects bad requests; this is the backstop)
+                logging.getLogger(__name__).exception("decode pump error")
+                busy = 0
+            with self._lock:
+                for sid, ev in list(self._done_events.items()):
+                    if sid in self.engine.finished:
+                        ev.set()
+            if not busy:
+                time.sleep(0.005)  # idle: don't spin the device
+
+    def generate(self, prompt_ids: list, max_tokens: int = 64) -> dict:
+        """Blocking single-request API (one handler thread per call;
+        all calls share the slot batch)."""
+        ev = threading.Event()
+        with self._lock:
+            # submit() validates (prompt fits a bucket, room for at
+            # least one token) and raises HERE, in the handler — the
+            # proxy maps it to a per-request 500 instead of the pump
+            # thread dying on it
+            sid = self.engine.submit(prompt_ids, max_tokens)
+            self._done_events[sid] = ev
+        if not ev.wait(timeout=600):
+            raise TimeoutError(f"stream {sid} did not finish in 600s")
+        with self._lock:
+            del self._done_events[sid]
+        s = self.engine.pop_finished(sid)
+        return {
+            "tokens": s.tokens[:max_tokens],
+            "submitted_s": s.submitted,
+            "token_times_s": s.token_times[:max_tokens],
+        }
+
+    def __call__(self, req: dict) -> dict:
+        """HTTP entrypoint (serve http_proxy: POST body -> __call__):
+        {"prompt_ids": [...], "max_tokens": N} -> generate()."""
+        return self.generate(list(req["prompt_ids"]),
+                             int(req.get("max_tokens", 64)))
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "queued": len(self.engine.queue),
+                "active": sum(1 for x in self.engine.slot_stream
+                              if x is not None),
+                "slots": self.engine.slots,
+            }
+
+    def __del__(self):
+        self._stop = True
