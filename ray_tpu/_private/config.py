@@ -32,6 +32,10 @@ _DEFS: dict[str, Any] = {
     # pull admission (pull_manager.py; reference pull_manager.h:52)
     "pull_max_active": 8,
     "pull_admission_watermark": 0.8,
+    # outbound transfer pacing (the pull-based analog of reference
+    # push_manager.h:29 per-peer in-flight chunk windows): bytes of
+    # object chunks one node will serve CONCURRENTLY to one peer
+    "transfer_outbound_window_bytes": 32 * 1024 * 1024,
     # queued-path pipelining: tasks the dispatcher may stack into one
     # pool worker's exec queue when no idle worker matches and the pool
     # is at cap (the queued analog of lease-push pipelining)

@@ -102,6 +102,12 @@ class NodeInfo:
         self.running = 0
         self.store_primaries = 0  # pinned primaries (scale-down gate)
         self.stats: dict = {}  # psutil node stats from the agent
+        self.last_reported: dict | None = None  # raw agent report
+        # view version at this node's last view-visible change (delta
+        # cluster-view sync, reference ray_syncer.h:86 versioned
+        # snapshots: get_cluster_view(since) ships only nodes whose
+        # ver > since)
+        self.ver = 0
         # Head-side placement deductions newer than ~2 heartbeats: applied
         # on top of agent reports so a fresh heartbeat (sent before the
         # agent processed the placement) can't make the head double-book
@@ -117,6 +123,7 @@ class NodeInfo:
 
     def apply_report(self, reported: dict, window_s: float):
         now = time.monotonic()
+        self.last_reported = dict(reported)
         self.recent_deductions = [
             (t, d) for t, d in self.recent_deductions if now - t < window_s
         ]
@@ -125,6 +132,30 @@ class NodeInfo:
             for r, v in d.items():
                 avail[r] = avail.get(r, 0) - v
         self.resources_available = avail
+
+    def expire_deductions(self, window_s: float = 2.0) -> bool:
+        """Prune expired head-side deductions and recompute from the
+        last agent report. Under DELTA heartbeats an unchanged
+        resources_available is never resent, so the per-beat
+        apply_report no longer self-corrects the double-count of a
+        deduction that overlapped the agent's own reduced report —
+        this head-driven recompute is the correction. Returns True if
+        the view changed."""
+        now = time.monotonic()
+        live = [(t, d) for t, d in self.recent_deductions
+                if now - t < window_s]
+        if len(live) == len(self.recent_deductions):
+            return False
+        self.recent_deductions = live
+        if self.last_reported is None:
+            return False
+        before = self.resources_available
+        avail = dict(self.last_reported)
+        for _, d in live:
+            for r, v in d.items():
+                avail[r] = avail.get(r, 0) - v
+        self.resources_available = avail
+        return avail != before
 
     def view(self) -> dict:
         return {
@@ -152,6 +183,7 @@ class ControlPlane:
                  persist_path: str | None = None):
         self.server = RpcServer(host, port)
         self.kv = KvManager()
+        self.view_ver = 0  # cluster-view version (delta sync)
         self.pub = Publisher()
         self.nodes: dict[bytes, NodeInfo] = {}
         self.node_conns: dict[bytes, ServerConn] = {}
@@ -396,6 +428,7 @@ class ControlPlane:
         info = NodeInfo(p["node_id"], p["addr"], p["port"], p["resources"],
                         p.get("labels"))
         self.nodes[p["node_id"]] = info
+        self._bump_view(info)
         self.node_conns[p["node_id"]] = conn
         conn.state["node_id"] = p["node_id"]
         logger.info("node %s registered (%s)", p["node_id"].hex()[:8],
@@ -406,7 +439,16 @@ class ControlPlane:
         self.pub.publish("node_added", info.view())
         return {"nodes": [n.view() for n in self.nodes.values()]}
 
+    def _bump_view(self, node) -> None:
+        """Mark a node's view dirty: delta get_cluster_view ships it."""
+        self.view_ver += 1
+        node.ver = self.view_ver
+
     async def rpc_heartbeat(self, conn, p):
+        """Delta heartbeats (reference ray_syncer.h:86 — versioned
+        deltas, not full snapshots): agents send only fields that
+        CHANGED since their last accepted beat; absent fields keep
+        their previous values. An idle node's beat is just its id."""
         node = self.nodes.get(p["node_id"])
         if node is None:
             return {"unknown": True}  # tell agent to re-register
@@ -417,16 +459,26 @@ class ControlPlane:
             # this, owners resubmit every task routed here forever
             return {"unknown": True}
         node.last_heartbeat = time.monotonic()
-        node.queued = p.get("queued", 0)
-        node.queued_shapes = p.get("queued_shapes", [])
-        node.running = p.get("running", 0)
-        node.store_primaries = p.get("store_primaries", 0)
-        if p.get("stats"):
+        changed = False
+        for key in ("queued", "running", "store_primaries"):
+            if key in p and p[key] != getattr(node, key):
+                setattr(node, key, p[key])
+                changed = True
+        if "queued_shapes" in p and p["queued_shapes"] != \
+                node.queued_shapes:
+            node.queued_shapes = p["queued_shapes"]
+            changed = True
+        if p.get("stats") and p["stats"] != node.stats:
             node.stats = p["stats"]
+            changed = True
         if "resources_available" in p:
+            before = node.resources_available
             node.apply_report(
                 p["resources_available"], window_s=2.0
             )
+            changed = changed or node.resources_available != before
+        if changed:
+            self._bump_view(node)
         return {"ok": True}
 
     def record_event(self, kind: str, message: str, **fields):
@@ -497,7 +549,18 @@ class ControlPlane:
                 "pg_demands": pg_demands}
 
     async def rpc_get_cluster_view(self, conn, p):
-        return {"nodes": [n.view() for n in self.nodes.values()]}
+        """Full view without `since`; with it, only nodes whose ver
+        advanced past the caller's — the cluster-view half of the delta
+        sync. An idle cluster's reply is {"ver", "nodes": []}."""
+        since = p.get("since")
+        if since is None:
+            return {"nodes": [n.view() for n in self.nodes.values()],
+                    "ver": self.view_ver}
+        return {
+            "nodes": [n.view() for n in self.nodes.values()
+                      if n.ver > since],
+            "ver": self.view_ver,
+        }
 
     async def rpc_drain_node(self, conn, p):
         await self._mark_node_dead(p["node_id"], "drained")
@@ -662,6 +725,7 @@ class ControlPlane:
         actor["_from_node_pool"] = from_node_pool
         if from_node_pool:
             node.deduct(need)
+            self._bump_view(node)
         actor["node_id"] = node.node_id
         try:
             await agent.call("start_actor", {
@@ -682,6 +746,7 @@ class ControlPlane:
             if from_node_pool:
                 for r, v in need.items():
                     node.resources_available[r] += v
+                self._bump_view(node)
             actor["node_id"] = None
 
     async def rpc_actor_started(self, conn, p):
@@ -728,6 +793,7 @@ class ControlPlane:
                 node.resources_available[r] = (
                     node.resources_available.get(r, 0) + v
                 )
+            self._bump_view(node)
         actor["node_id"] = None
 
     def _actor_view(self, actor: dict) -> dict:
@@ -839,6 +905,7 @@ class ControlPlane:
             await agent.call("commit_bundle",
                              {"pg_id": pgid, "bundle_index": bidx})
             self.nodes[node_id].deduct(bundles[bidx])
+            self._bump_view(self.nodes[node_id])
         self.pgs[pgid] = {
             "pg_id": pgid, "state": "CREATED", "bundles": bundles,
             "strategy": strategy, "bundle_nodes": plan,
@@ -930,6 +997,7 @@ class ControlPlane:
                     node.resources_available[r] = (
                         node.resources_available.get(r, 0) + v
                     )
+                self._bump_view(node)
         return True
 
     async def rpc_get_pg(self, conn, p):
@@ -1290,6 +1358,8 @@ class ControlPlane:
             )
             now = time.monotonic()
             for node in list(self.nodes.values()):
+                if node.alive and node.expire_deductions():
+                    self._bump_view(node)
                 if node.alive and (
                     now - node.last_heartbeat > self.HEARTBEAT_TIMEOUT_S
                 ):
@@ -1305,6 +1375,7 @@ class ControlPlane:
         if node is None or not node.alive:
             return
         node.alive = False
+        self._bump_view(node)
         logger.warning("node %s dead: %s", node_id.hex()[:8], reason)
         self.record_event("NODE_DEAD",
                           f"node {node_id.hex()[:8]} dead: {reason}",

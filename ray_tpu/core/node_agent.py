@@ -152,6 +152,11 @@ class NodeAgent:
         self.task_queue: deque[dict] = deque()
         self.running: dict[bytes, dict] = {}  # task_id → spec
         self.cluster_view: dict[bytes, dict] = {}
+        # delta-heartbeat protocol state (ray_syncer.h:86 analog)
+        self._hb_sent: dict = {}
+        self._hb_pending: dict = {}
+        self._hb_n = 0
+        self._view_since: int | None = None
         self.bundles: dict[tuple[bytes, int], dict] = {}  # prepared/committed
         self.bundle_available: dict[tuple[bytes, int], dict] = {}
         self._peer_clients: dict[bytes, AsyncRpcClient] = {}
@@ -308,6 +313,54 @@ class NodeAgent:
         logger.info("reconnected to restarted head")
         return True
 
+    def _hb_snapshot(self) -> dict:
+        """Everything a FULL heartbeat would carry (reference load
+        report). Stats are quantized so jitter (cpu %, free memory)
+        doesn't defeat the delta encoding."""
+        stats = self._node_stats()
+        q = dict(stats)
+        if "cpu_percent" in q:
+            q["cpu_percent"] = round(q["cpu_percent"] / 10) * 10
+        if "mem_available" in q:
+            gran = 256 * 1024 * 1024
+            q["mem_available"] = (q["mem_available"] // gran) * gran
+        return {
+            "resources_available": dict(self.resources_available),
+            # demand signal = WAITING work only (running tasks don't
+            # need more nodes); primaries gate scale-down
+            "queued": len(self.task_queue),
+            # demand SHAPES so the autoscaler can bin-pack against
+            # provider node types (resource_demand_scheduler.py analog)
+            "queued_shapes": [
+                spec.get("resources", {"CPU": 1.0})
+                for spec in list(self.task_queue)[:50]
+            ],
+            "running": len(self.running),
+            "store_primaries": len(self.primaries),
+            # reporter-agent analog (reporter_agent.py:266)
+            "stats": q,
+        }
+
+    # every Nth beat resends the full snapshot: self-healing against any
+    # head/agent state divergence the delta protocol can't see
+    _HB_FULL_EVERY = 10
+
+    def _build_heartbeat(self) -> dict:
+        """Delta heartbeat (reference ray_syncer.h:86: versioned deltas,
+        not per-beat snapshots): only fields that changed since the last
+        ACCEPTED beat ride the wire; an idle node sends just its id."""
+        snap = self._hb_snapshot()
+        self._hb_n = getattr(self, "_hb_n", 0) + 1
+        if self._hb_n % self._HB_FULL_EVERY == 0:
+            self._hb_pending = snap
+            return {"node_id": self.node_id, **snap}
+        payload = {"node_id": self.node_id}
+        for k, v in snap.items():
+            if self._hb_sent.get(k) != v:
+                payload[k] = v
+        self._hb_pending = snap
+        return payload
+
     async def _heartbeat_loop(self):
         while not self._dead:
             try:
@@ -315,25 +368,8 @@ class NodeAgent:
                     if not await self._reconnect_head():
                         await asyncio.sleep(1.0)
                         continue
-                reply = await self.head.call("heartbeat", {
-                    "node_id": self.node_id,
-                    "resources_available": self.resources_available,
-                    # demand signal = WAITING work only (running tasks
-                    # don't need more nodes); primaries gate scale-down
-                    "queued": len(self.task_queue),
-                    # demand SHAPES so the autoscaler can bin-pack
-                    # against provider node types (reference
-                    # resource_demand_scheduler.py), capped per beat
-                    "queued_shapes": [
-                        spec.get("resources", {"CPU": 1.0})
-                        for spec in list(self.task_queue)[:50]
-                    ],
-                    "running": len(self.running),
-                    "store_primaries": len(self.primaries),
-                    # reporter-agent analog (reporter_agent.py:266):
-                    # physical node stats for the dashboard/state API
-                    "stats": self._node_stats(),
-                })
+                reply = await self.head.call(
+                    "heartbeat", self._build_heartbeat())
                 if reply.get("unknown"):
                     await self.head.call("register_node", {
                         "node_id": self.node_id, "addr": self.host,
@@ -341,11 +377,23 @@ class NodeAgent:
                         "resources": self.resources_total,
                         "labels": self.labels,
                     })
-                view = await self.head.call("get_cluster_view", {})
+                    # force a FULL beat + full view after (re)register
+                    self._hb_sent = {}
+                    self._view_since = None
+                else:
+                    self._hb_sent = self._hb_pending
+                view = await self.head.call(
+                    "get_cluster_view",
+                    {} if self._view_since is None
+                    else {"since": self._view_since})
                 for v in view["nodes"]:
                     self.cluster_view[v["node_id"]] = v
+                self._view_since = view.get("ver")
             except (rpc.ConnectionLost, rpc.RpcError):
-                pass
+                # the head may have restarted with empty state: next
+                # round re-registers; send full state again
+                self._hb_sent = {}
+                self._view_since = None
             await asyncio.sleep(1.0)
 
     def _node_stats(self) -> dict:
@@ -2034,7 +2082,31 @@ class NodeAgent:
     # ---------------- object manager ----------------
 
     async def rpc_read_object_chunk(self, conn, p):
-        """Peer agents pull objects chunk by chunk (object_manager.cc:633)."""
+        """Peer agents pull objects chunk by chunk (object_manager.cc:633).
+
+        Outbound pacing (the pull-design analog of reference
+        push_manager.h:29's per-peer in-flight windows): before serving
+        another chunk, wait while THIS peer's transport write buffer
+        holds more than transfer_outbound_window_bytes — a slow or
+        flooded receiver backs up its own connection and only its own
+        transfers pace; other peers' connections are independent. The
+        sender's memory per peer stays bounded at window + one chunk."""
+        if conn is not None:
+            window = int(cfg.get("transfer_outbound_window_bytes"))
+            deadline = time.monotonic() + 60.0
+            while (self._conn_write_buffered(conn) > window
+                   and time.monotonic() < deadline):
+                await asyncio.sleep(0.005)
+        return self._read_object_chunk(p)
+
+    @staticmethod
+    def _conn_write_buffered(conn) -> int:
+        try:
+            return conn.writer.transport.get_write_buffer_size()
+        except Exception:  # noqa: BLE001 — transport mid-close
+            return 0
+
+    def _read_object_chunk(self, p):
         oid, offset = p["object_id"], p["offset"]
         buf = self.store.get(oid)
         if buf is None:
@@ -2093,7 +2165,10 @@ class NodeAgent:
                     info["spilled"].split("//", 1)[1].split("/", 1)[0]
                 )
                 if spill_node == self.node_id:
-                    await self.rpc_restore_object(None, {"object_id": oid})
+                    # already under this oid's admission slot: restore
+                    # directly (re-entering the scheduler would dedup
+                    # onto our own future and deadlock)
+                    await self._restore_from_disk(oid)
                 else:
                     cli = await self._peer_agent(spill_node)
                     if cli is not None:
@@ -2306,8 +2381,41 @@ class NodeAgent:
         return True
 
     async def rpc_restore_object(self, conn, p):
-        """Reload a spilled object into the local store (restore path)."""
+        """Reload a spilled object into the local store, through the
+        pull scheduler at PRI_RESTORE: a restore ALLOCATES store space,
+        so it must queue behind task-arg and get pulls for admission
+        (reference pull_manager.h:52 deprioritizes restores the same
+        way) instead of allocating unconditionally under pressure."""
         oid = p["object_id"]
+        if self.store.contains(oid):
+            return True
+        if self.spilled_files.get(oid) is None:
+            return False
+        if self._pull_sched is None:
+            self._pull_sched = pull_manager.PullScheduler(
+                self._pull_object, self.store,
+                max_active=cfg.get("pull_max_active"),
+                watermark=cfg.get("pull_admission_watermark"))
+        return bool(await asyncio.shield(self._pull_sched.request(
+            oid, pull_manager.PRI_RESTORE,
+            timeout=p.get("timeout", 60.0),
+            pull_fn=self._restore_pull)))
+
+    async def _restore_pull(self, oid: bytes, deadline: float,
+                            reserve=lambda n: None) -> bool:
+        """PullScheduler transfer fn for restores: local disk, not a
+        peer. reserve() reports the file size so admission accounts the
+        incoming bytes before the store allocation happens."""
+        path = self.spilled_files.get(oid)
+        if path is not None:
+            try:
+                reserve(os.path.getsize(path))
+            except OSError:
+                pass
+        return await self._restore_from_disk(oid)
+
+    async def _restore_from_disk(self, oid: bytes) -> bool:
+        """The actual spill-file -> store reload."""
         if self.store.contains(oid):
             return True
         path = self.spilled_files.get(oid)

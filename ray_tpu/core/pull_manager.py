@@ -52,10 +52,16 @@ class PullScheduler:
     # ---- public ----
 
     def request(self, oid: bytes, priority: int,
-                timeout: float) -> asyncio.Future:
+                timeout: float, pull_fn=None) -> asyncio.Future:
         """Queue (or join) a pull; returns a future resolving to bool.
         A hotter duplicate escalates the queued entry's priority —
-        a task-arg request must not wait behind a speculative restore."""
+        a task-arg request must not wait behind a speculative restore.
+
+        pull_fn overrides the scheduler's default transfer for THIS
+        object (e.g. a spill RESTORE reads from local disk instead of
+        pulling a remote copy) — restores thereby share the same
+        priority/admission machinery the reference design gives them
+        (pull_manager.h:52 bundle priorities)."""
         now = time.monotonic()
         req = self._reqs.get(oid)
         if req is not None:
@@ -67,7 +73,8 @@ class PullScheduler:
             return req["fut"]
         fut = asyncio.get_running_loop().create_future()
         self._reqs[oid] = {"pri": priority, "fut": fut,
-                           "deadline": now + timeout, "queued": True}
+                           "deadline": now + timeout, "queued": True,
+                           "fn": pull_fn or self._pull_fn}
         self._push(oid, priority)
         self._ensure_pump()
         return fut
@@ -142,7 +149,7 @@ class PullScheduler:
 
         deadline = req["deadline"]  # snapshot: pull_fn reads it once
         try:
-            ok = await self._pull_fn(oid, deadline, reserve)
+            ok = await req.get("fn", self._pull_fn)(oid, deadline, reserve)
         except Exception:  # noqa: BLE001 — a failed transfer fails the
             logger.exception("pull of %s failed", oid.hex()[:12])
             ok = False

@@ -53,8 +53,64 @@ def allreduce_grads_rowmean(grads, n_rows: int, group_name: str):
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
+class _GroupMemberMixin:
+    """join_group bookkeeping shared by the PPO and SAC learner actors."""
+
+    def join_group(self, world_size: int, rank: int, group_name: str):
+        # create_collective_group drives __ray_tpu_init_collective__;
+        # this records which group the update loop allreduces over
+        self._group = group_name
+        self._world = world_size
+        self._rank = rank
+        return True
+
+
+class _LearnerGroupBase:
+    """Driver-side group scaffolding shared by LearnerGroup (PPO) and
+    SACLearnerGroup: collective bootstrap, shard-size guard, weights,
+    teardown (reference learner_group.py:61)."""
+
+    _seq = 0
+    _GROUP_PREFIX = "learner_group"
+
+    def _bootstrap(self, actors: list, num_learners: int) -> None:
+        type(self)._seq += 1
+        self.num_learners = num_learners
+        self.learners = actors
+        if num_learners > 1:
+            group = f"{self._GROUP_PREFIX}_{type(self)._seq}"
+            create_collective_group(
+                actors, num_learners, list(range(num_learners)),
+                group_name=group)
+            ray_tpu.get(
+                [a.join_group.remote(num_learners, r, group)
+                 for r, a in enumerate(actors)],
+                timeout=120,
+            )
+
+    def _check_shardable(self, n: int) -> None:
+        if n < self.num_learners:
+            # an empty shard's mean-loss is NaN and the row-weighted
+            # allreduce (NaN * 0) would poison every replica's weights
+            raise ValueError(
+                f"batch of {n} rows cannot shard across "
+                f"{self.num_learners} learners")
+
+    def get_weights(self):
+        return ray_tpu.get(self.learners[0].get_weights.remote(),
+                           timeout=120)
+
+    def shutdown(self):
+        for a in self.learners:
+            try:
+                ray_tpu.kill(a)
+            except Exception:  # noqa: BLE001
+                pass
+
+
+
 @ray_tpu.remote(num_cpus=1)
-class LearnerActor(CollectiveActorMixin):
+class LearnerActor(_GroupMemberMixin, CollectiveActorMixin):
     """One learner replica (reference learner_group.py worker)."""
 
     def __init__(self, obs_dim: int, n_actions: int, seed: int = 0,
@@ -68,14 +124,6 @@ class LearnerActor(CollectiveActorMixin):
                                **learner_kwargs)
         self._group: str | None = None
         self._world = 1
-
-    def join_group(self, world_size: int, rank: int, group_name: str):
-        # create_collective_group drives __ray_tpu_init_collective__; this
-        # records which group the update loop should allreduce over
-        self._group = group_name
-        self._world = world_size
-        self._rank = rank
-        return True
 
     def update_shard(self, batch: dict, *, minibatches: int = 4,
                      epochs: int = 4, shuffle_seed: int = 0) -> dict:
@@ -106,30 +154,16 @@ class LearnerActor(CollectiveActorMixin):
         return True
 
 
-class LearnerGroup:
+class LearnerGroup(_LearnerGroupBase):
     """Driver-side facade (reference learner_group.py:61)."""
-
-    _seq = 0
 
     def __init__(self, obs_dim: int, n_actions: int, *,
                  num_learners: int = 2, seed: int = 0, **learner_kwargs):
-        LearnerGroup._seq += 1
-        self.num_learners = num_learners
-        self.learners = [
-            LearnerActor.remote(obs_dim, n_actions, seed=seed,
-                                **learner_kwargs)
-            for _ in range(num_learners)
-        ]
-        if num_learners > 1:
-            group = f"learner_group_{LearnerGroup._seq}"
-            create_collective_group(
-                self.learners, num_learners,
-                list(range(num_learners)), group_name=group)
-            ray_tpu.get(
-                [a.join_group.remote(num_learners, r, group)
-                 for r, a in enumerate(self.learners)],
-                timeout=120,
-            )
+        self._bootstrap(
+            [LearnerActor.remote(obs_dim, n_actions, seed=seed,
+                                 **learner_kwargs)
+             for _ in range(num_learners)],
+            num_learners)
 
     def update(self, batch: dict, *, minibatches: int = 4,
                epochs: int = 4, shuffle_seed: int = 0) -> dict:
@@ -139,10 +173,7 @@ class LearnerGroup:
 
         batch = normalize_advantages(batch)  # once, BEFORE sharding
         n = len(batch["obs"])
-        if n < self.num_learners:
-            raise ValueError(
-                f"batch of {n} rows cannot shard across "
-                f"{self.num_learners} learners")
+        self._check_shardable(n)
         shards = np.array_split(np.arange(n), self.num_learners)
         refs = []
         for shard, actor in zip(shards, self.learners):
@@ -153,24 +184,13 @@ class LearnerGroup:
         all_metrics = ray_tpu.get(refs, timeout=600)
         return all_metrics[0]
 
-    def get_weights(self):
-        return ray_tpu.get(self.learners[0].get_weights.remote(),
-                           timeout=120)
-
     def set_weights(self, params):
         ray_tpu.get([a.set_weights.remote(params) for a in self.learners],
                     timeout=120)
 
-    def shutdown(self):
-        for a in self.learners:
-            try:
-                ray_tpu.kill(a)
-            except Exception:  # noqa: BLE001
-                pass
-
 
 @ray_tpu.remote(num_cpus=1)
-class SACLearnerActor(CollectiveActorMixin):
+class SACLearnerActor(_GroupMemberMixin, CollectiveActorMixin):
     """One SAC learner replica (continuous control; rl/sac.py)."""
 
     def __init__(self, obs_dim: int, action_dim: int, seed: int = 0,
@@ -184,12 +204,6 @@ class SACLearnerActor(CollectiveActorMixin):
                                   **learner_kwargs)
         self._group: str | None = None
         self._world = 1
-
-    def join_group(self, world_size: int, rank: int, group_name: str):
-        self._group = group_name
-        self._world = world_size
-        self._rank = rank
-        return True
 
     def update_shard(self, batch: dict) -> dict:
         """One SAC step on THIS replica's shard. The driver generated
@@ -214,38 +228,26 @@ class SACLearnerActor(CollectiveActorMixin):
         return np_.asarray(self.learner.act(obs, None, deterministic=True))
 
 
-class SACLearnerGroup:
+class SACLearnerGroup(_LearnerGroupBase):
     """Distributed SAC learning (the continuous-control LearnerGroup —
     reference learner_group.py:61 with SACLearner replicas). Noise is
     drawn ONCE per update on the driver and sharded with the batch rows,
     making the N-replica update equal the single-learner update on the
     full batch (parity test in tests/test_rl_sac.py)."""
 
-    _seq = 0
+    _GROUP_PREFIX = "sac_learner_group"
 
     def __init__(self, obs_dim: int, action_dim: int, *,
                  num_learners: int = 2, seed: int = 0, **learner_kwargs):
         import jax
 
-        SACLearnerGroup._seq += 1
-        self.num_learners = num_learners
         self.action_dim = action_dim
         self._key = jax.random.PRNGKey(seed + 1)
-        self.learners = [
-            SACLearnerActor.remote(obs_dim, action_dim, seed=seed,
-                                   **learner_kwargs)
-            for _ in range(num_learners)
-        ]
-        if num_learners > 1:
-            group = f"sac_learner_group_{SACLearnerGroup._seq}"
-            create_collective_group(
-                self.learners, num_learners,
-                list(range(num_learners)), group_name=group)
-            ray_tpu.get(
-                [a.join_group.remote(num_learners, r, group)
-                 for r, a in enumerate(self.learners)],
-                timeout=120,
-            )
+        self._bootstrap(
+            [SACLearnerActor.remote(obs_dim, action_dim, seed=seed,
+                                    **learner_kwargs)
+             for _ in range(num_learners)],
+            num_learners)
 
     def update(self, batch: dict) -> dict:
         """Draw full-batch noise, shard rows + noise, run the lockstep
@@ -253,12 +255,7 @@ class SACLearnerGroup:
         import jax
 
         n = len(batch["obs"])
-        if n < self.num_learners:
-            # an empty shard's mean-loss is NaN and the row-weighted
-            # allreduce (NaN * 0) would poison every replica's weights
-            raise ValueError(
-                f"batch of {n} rows cannot shard across "
-                f"{self.num_learners} learners")
+        self._check_shardable(n)
         batch = dict(batch)
         if "noise_pi" not in batch:  # caller-provided noise wins (tests)
             self._key, ka, kt = jax.random.split(self._key, 3)
@@ -272,14 +269,3 @@ class SACLearnerGroup:
             sub = {k: np.asarray(batch[k])[shard] for k in batch}
             refs.append(actor.update_shard.remote(sub))
         return ray_tpu.get(refs, timeout=600)[0]
-
-    def get_weights(self):
-        return ray_tpu.get(self.learners[0].get_weights.remote(),
-                           timeout=120)
-
-    def shutdown(self):
-        for a in self.learners:
-            try:
-                ray_tpu.kill(a)
-            except Exception:  # noqa: BLE001
-                pass

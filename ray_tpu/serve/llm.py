@@ -86,6 +86,11 @@ class LLMServer:
                 for sid, ev in list(self._done_events.items()):
                     if sid in self.engine.finished:
                         ev.set()
+                for sid in list(self.engine.finished):
+                    if sid not in self._done_events:
+                        # abandoned (handler timed out): don't pin the
+                        # stream's tokens forever
+                        self.engine.finished.pop(sid, None)
             if not busy:
                 time.sleep(0.005)  # idle: don't spin the device
 
@@ -100,11 +105,17 @@ class LLMServer:
             # thread dying on it
             sid = self.engine.submit(prompt_ids, max_tokens)
             self._done_events[sid] = ev
-        if not ev.wait(timeout=600):
-            raise TimeoutError(f"stream {sid} did not finish in 600s")
-        with self._lock:
-            del self._done_events[sid]
-        s = self.engine.pop_finished(sid)
+        try:
+            if not ev.wait(timeout=600):
+                raise TimeoutError(
+                    f"stream {sid} did not finish in 600s")
+            s = self.engine.pop_finished(sid)
+        finally:
+            # timeout path too: a leaked event entry is rescanned every
+            # pump tick; the pump purges finished streams with no
+            # registered waiter (abandoned by a timed-out handler)
+            with self._lock:
+                self._done_events.pop(sid, None)
         return {
             "tokens": s.tokens[:max_tokens],
             "submitted_s": s.submitted,

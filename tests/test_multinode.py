@@ -288,3 +288,52 @@ def test_tpu_slice_gang_placement():
         ray_tpu.remove_placement_group(pg)
     finally:
         c.shutdown()
+
+
+def test_delta_heartbeat_payload_shrinks_when_idle():
+    """Delta resource sync (reference ray_syncer.h:86): once a node's
+    state stops changing, its heartbeat carries only its id and the
+    cluster-view reply carries no nodes — >10x smaller on the wire than
+    the full snapshot protocol."""
+    from ray_tpu._private.rpc import pack
+
+    c = Cluster(head_resources={"CPU": 2, "memory": 2 * 2**30})
+    for _ in range(4):
+        c.add_node(resources={"CPU": 2, "memory": 2 * 2**30})
+    c.connect()
+    try:
+        agent = c.head_agent
+        # let a few beats flow so _hb_sent converges
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            time.sleep(1.0)
+            delta = agent._build_heartbeat()
+            if set(delta) == {"node_id"}:
+                break
+        full = {"node_id": agent.node_id, **agent._hb_snapshot()}
+        assert set(delta) == {"node_id"}, delta.keys()
+        assert len(pack(full)) > 5 * len(pack(delta)), (
+            len(pack(full)), len(pack(delta)))
+
+        # view delta: an idle 5-node cluster ships ZERO node dicts
+        cp = c.cp
+        full_view = c.io.run(cp.rpc_get_cluster_view(None, {}))
+        assert len(full_view["nodes"]) == 5
+        delta_view = c.io.run(cp.rpc_get_cluster_view(
+            None, {"since": full_view["ver"]}))
+        assert delta_view["nodes"] == []
+        # the per-beat PROTOCOL (heartbeat up + view down) drops >10x
+        full_bytes = len(pack(full)) + len(pack(full_view))
+        delta_bytes = len(pack(delta)) + len(pack(delta_view))
+        assert full_bytes > 10 * delta_bytes, (full_bytes, delta_bytes)
+
+        # a change on one node ships exactly that node
+        agent2 = c.agents[1]
+        c.io.run(cp.rpc_heartbeat(None, {
+            "node_id": agent2.node_id, "queued": 7}))
+        after = c.io.run(cp.rpc_get_cluster_view(
+            None, {"since": full_view["ver"]}))
+        assert [n["node_id"] for n in after["nodes"]] == [agent2.node_id]
+        assert after["nodes"][0]["queued"] == 7
+    finally:
+        c.shutdown()

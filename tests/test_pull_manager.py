@@ -175,3 +175,114 @@ def test_pulls_exceeding_capacity_make_progress():
         assert second.store.used_bytes() <= cap
     finally:
         c.shutdown()
+
+
+def test_per_request_pull_fn_override():
+    """A restore rides the same scheduler with its OWN transfer fn
+    (disk reload, not a peer pull) — the per-request override the
+    PRI_RESTORE routing in node_agent uses."""
+    ran = []
+
+    async def main():
+        async def default_pull(oid, deadline, reserve):
+            ran.append(("default", oid))
+            return True
+
+        async def restore_pull(oid, deadline, reserve):
+            ran.append(("restore", oid))
+            return True
+
+        s = pm.PullScheduler(default_pull, FakeStore(), max_active=2)
+        f1 = s.request(b"a", pm.PRI_GET, 10)
+        f2 = s.request(b"b", pm.PRI_RESTORE, 10, pull_fn=restore_pull)
+        assert await f1 and await f2
+
+    _run(main())
+    assert ("default", b"a") in ran
+    assert ("restore", b"b") in ran
+
+
+def test_task_arg_preempts_restore_under_saturated_store():
+    """The r4 gap: restores must enter admission at PRI_RESTORE and a
+    task-arg pull queued LATER must activate first once a slot frees
+    (the class the reference deprioritizes, pull_manager.h:52)."""
+    order = []
+
+    async def main():
+        gate = asyncio.Event()
+
+        async def pull(oid, deadline, reserve):
+            order.append(oid)
+            if oid == b"hold":
+                await gate.wait()
+            return True
+
+        s = pm.PullScheduler(pull, FakeStore(), max_active=1)
+        hold = s.request(b"hold", pm.PRI_GET, 10)
+        await asyncio.sleep(0.05)
+        restore = s.request(b"spilled", pm.PRI_RESTORE, 10)
+        await asyncio.sleep(0.02)
+        # queued AFTER the restore, must run BEFORE it
+        task_arg = s.request(b"dep", pm.PRI_TASK_ARG, 10)
+        await asyncio.sleep(0.02)
+        gate.set()
+        assert await hold and await task_arg and await restore
+
+    _run(main())
+    assert order == [b"hold", b"dep", b"spilled"]
+
+
+def test_outbound_transfer_pacing_backpressure():
+    """Sender-side window (reference push_manager.h:29 analog): chunk
+    serving to a peer whose connection write buffer is over the window
+    WAITS until the buffer recedes; an unblocked peer serves
+    immediately."""
+    from ray_tpu._private import config as _cfg
+
+    c = Cluster(head_resources={"CPU": 2, "memory": 2 * 2**30})
+    c.connect()
+    try:
+        agent = c.head_agent
+        window = int(_cfg.get("transfer_outbound_window_bytes"))
+
+        class FakeTransport:
+            def __init__(self):
+                self.buffered = 0
+
+            def get_write_buffer_size(self):
+                return self.buffered
+
+        class FakeWriter:
+            def __init__(self, t):
+                self.transport = t
+
+        class FakeConn:
+            def __init__(self, t):
+                self.writer = FakeWriter(t)
+                self.peer = ("10.0.0.9", 1234)
+
+        slow = FakeTransport()
+        slow.buffered = window + 1  # receiver backed up
+        fast = FakeTransport()
+
+        agent._read_object_chunk = lambda p: {"served": True}
+
+        async def scenario():
+            t0 = time.monotonic()
+            fast_r = await agent.rpc_read_object_chunk(
+                FakeConn(fast), {"object_id": b"x" * 16, "offset": 0})
+            fast_dt = time.monotonic() - t0
+
+            blocked = asyncio.ensure_future(agent.rpc_read_object_chunk(
+                FakeConn(slow), {"object_id": b"x" * 16, "offset": 0}))
+            await asyncio.sleep(0.1)
+            assert not blocked.done()  # paced while the buffer is high
+            slow.buffered = 0          # receiver drained
+            slow_r = await asyncio.wait_for(blocked, timeout=5)
+            return fast_r, fast_dt, slow_r
+
+        fast_r, fast_dt, slow_r = c.io.run(scenario(), timeout=60)
+        assert fast_r == {"served": True} and slow_r == {"served": True}
+        assert fast_dt < 0.05  # unblocked peer never waits
+    finally:
+        c.shutdown()
