@@ -33,15 +33,18 @@ run_one() {
   fi
   # halt_on_error so a report fails the run loudly; abort_on_error=0
   # keeps the exit code (66) parseable
+  local t0=$(date +%s)
   if TSAN_OPTIONS="halt_on_error=1 exitcode=66" \
      ASAN_OPTIONS="halt_on_error=1 exitcode=66 detect_leaks=1" \
      UBSAN_OPTIONS="halt_on_error=1" \
      timeout 600 "$bin" >"$log" 2>&1; then
-    echo "OK $tag"
-    results+=("{\"target\": \"$tag\", \"status\": \"clean\"}")
+    local dt=$(( $(date +%s) - t0 ))
+    echo "OK $tag (${dt}s)"
+    results+=("{\"target\": \"$tag\", \"status\": \"clean\", \"seconds\": $dt}")
   else
+    local dt=$(( $(date +%s) - t0 ))
     echo "SANITIZER FAIL $tag"; tail -50 "$log"; fail=1
-    results+=("{\"target\": \"$tag\", \"status\": \"failed\"}")
+    results+=("{\"target\": \"$tag\", \"status\": \"failed\", \"seconds\": $dt}")
   fi
 }
 

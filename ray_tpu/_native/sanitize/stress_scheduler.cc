@@ -7,6 +7,7 @@
 
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <mutex>
 #include <thread>
@@ -28,7 +29,10 @@ int sched_pick(void*, const char*, const char**, const double*, int, double,
 namespace {
 
 constexpr int kThreads = 4;
-constexpr int kIters = 20000;
+const int kIters = [] {
+  const char* s = std::getenv("SAN_SCHED_ITERS");
+  return s ? std::atoi(s) : 400000;
+}();
 constexpr int kNodes = 12;
 
 std::mutex gil;  // the API's real-world mutual exclusion

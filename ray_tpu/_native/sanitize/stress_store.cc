@@ -38,14 +38,29 @@ uint8_t* store_base_ptr(void*);
 uint64_t store_list(void*, uint8_t*, uint64_t);
 }
 
+#include <cstdlib>
+
 namespace {
 
 constexpr int kThreads = 8;
-constexpr int kIters = 4000;
 constexpr int kIds = 128;          // shared pool -> heavy contention
 constexpr uint64_t kHeap = 4 << 20;  // small heap -> eviction pressure
 
+// iteration scale: env-overridable so the gate runs MINUTES of
+// contention by default (SAN_STORE_ITERS to tune; sanitizer slowdown
+// multiplies wall time ~5-15x)
+int iters_scale() {
+  const char* s = std::getenv("SAN_STORE_ITERS");
+  int v = s ? std::atoi(s) : 400000;
+  // floor: the phase-B/C round counts divide by 10/5, and the
+  // create_fail backstop needs phase B to actually run
+  return v < 100 ? 100 : v;
+}
+
 std::atomic<uint64_t> mismatches{0};
+std::atomic<uint64_t> create_ok{0};
+std::atomic<uint64_t> create_fail{0};
+std::atomic<uint64_t> aborts{0};
 
 void fill_id(uint8_t* id, int k) {
   std::memset(id, 0, 16);
@@ -62,6 +77,7 @@ uint64_t xorshift(uint64_t* s) {
 void worker(void* store, int tno) {
   uint64_t rng = 0x9e3779b97f4a7c15ULL * (tno + 1);
   uint8_t id[16];
+  const int kIters = iters_scale();
   for (int i = 0; i < kIters; i++) {
     int k = (int)(xorshift(&rng) % kIds);
     fill_id(id, k);
@@ -117,6 +133,97 @@ void worker(void* store, int tno) {
   }
 }
 
+// PHASE B — allocation backpressure: near-heap-sized objects so most
+// creates FAIL under contention; callers run the real client retry
+// pattern (explicit evict, retry create) while peers keep sealed
+// objects referenced. Exercises create-failure paths, evict_locked
+// racing live get/release refcounts, and the free-list coalescer under
+// constant splits of the largest block.
+void pressure_worker(void* store, int tno) {
+  uint64_t rng = 0xD1B54A32D192ED03ULL * (tno + 1);
+  uint8_t id[16];
+  const int rounds = iters_scale() / 10;
+  for (int i = 0; i < rounds; i++) {
+    int k = 1000 + tno * rounds + i;  // unique ids: pure alloc churn
+    fill_id(id, k);
+    uint64_t sz = (kHeap / 4) + (xorshift(&rng) % (kHeap / 8));
+    uint64_t doff = 0, moff = 0;
+    int ok = -1;
+    for (int attempt = 0; attempt < 4 && ok != 0; attempt++) {
+      ok = store_create(store, id, sz, 16, &doff, &moff);
+      if (ok != 0) {
+        create_fail.fetch_add(1);
+        store_evict(store, sz);  // the caller-driven pressure valve
+      }
+    }
+    if (ok == 0) {
+      create_ok.fetch_add(1);
+      uint8_t* base = store_base_ptr(store);
+      std::memset(base + doff, (uint8_t)k, 64);  // touch, then decide
+      if (xorshift(&rng) % 3 == 0) {
+        store_abort(store, id);  // writer dies mid-fill under pressure
+        aborts.fetch_add(1);
+      } else {
+        store_seal(store, id);  // seal drops the creator ref
+        // brief read hold so eviction races a live refcount
+        uint64_t d, ds, m, ms;
+        if (store_get(store, id, &d, &ds, &m, &ms) == 0)
+          store_release(store, id);
+        store_delete(store, id);
+      }
+    }
+  }
+}
+
+// PHASE C — abort storm: half the creates abort mid-write while peer
+// threads get/evict the same id pool; an abort leaving a stale table
+// entry or a half-freed block shows as a sanitizer report, a payload
+// mismatch, or a later create landing on a corrupt free list.
+void abort_worker(void* store, int tno) {
+  uint64_t rng = 0x2545F4914F6CDD1DULL * (tno + 1);
+  uint8_t id[16];
+  const int rounds = iters_scale() / 5;
+  for (int i = 0; i < rounds; i++) {
+    int k = (int)(xorshift(&rng) % 32);  // tiny pool: max collision
+    fill_id(id, k);
+    if (tno % 2 == 0) {
+      uint64_t doff = 0, moff = 0;
+      if (store_create(store, id, 4096, 16, &doff, &moff) == 0) {
+        uint8_t* base = store_base_ptr(store);
+        std::memset(base + doff, (uint8_t)(k * 31 + 7), 2048);
+        if (xorshift(&rng) % 2 == 0) {
+          store_abort(store, id);
+          aborts.fetch_add(1);
+        } else {
+          std::memset(base + doff + 2048, (uint8_t)(k * 31 + 7), 2048);
+          store_seal(store, id);
+        }
+      }
+    } else {
+      uint64_t d, ds, m, ms;
+      if (store_get(store, id, &d, &ds, &m, &ms) == 0) {
+        uint8_t* base = store_base_ptr(store);
+        uint8_t fill = (uint8_t)(k * 31 + 7);
+        if (ds && (base[d] != fill || base[d + ds - 1] != fill))
+          mismatches.fetch_add(1);
+        store_release(store, id);
+      }
+      if (xorshift(&rng) % 16 == 0) store_evict(store, 1 << 14);
+      if (xorshift(&rng) % 32 == 0) store_delete(store, id);
+    }
+  }
+}
+
+void run_phase(const char* tag, void* store, void (*fn)(void*, int)) {
+  std::vector<std::thread> ts;
+  for (int t = 0; t < kThreads; t++) ts.emplace_back(fn, store, t);
+  for (auto& t : ts) t.join();
+  std::printf("phase %s done (ok=%llu fail=%llu aborts=%llu)\n", tag,
+              (unsigned long long)create_ok.load(),
+              (unsigned long long)create_fail.load(),
+              (unsigned long long)aborts.load());
+}
+
 }  // namespace
 
 int main() {
@@ -127,15 +234,21 @@ int main() {
     std::fprintf(stderr, "segment create failed\n");
     return 2;
   }
-  std::vector<std::thread> ts;
-  for (int t = 0; t < kThreads; t++) ts.emplace_back(worker, store, t);
-  for (auto& t : ts) t.join();
+  run_phase("mixed-churn", store, worker);
+  run_phase("alloc-pressure", store, pressure_worker);
+  run_phase("abort-storm", store, abort_worker);
   uint64_t bad = mismatches.load();
   store_destroy(store);
   if (bad) {
     std::fprintf(stderr, "payload mismatches: %llu\n",
                  (unsigned long long)bad);
     return 1;
+  }
+  if (create_fail.load() == 0) {
+    std::fprintf(stderr,
+                 "pressure phase never hit allocation failure — the "
+                 "stress is not exercising backpressure\n");
+    return 3;
   }
   std::printf("stress_store OK\n");
   return 0;

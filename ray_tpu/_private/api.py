@@ -441,7 +441,13 @@ class RemoteFunction:
         # function per .remote() dominated bursty submission profiles
         cache = getattr(self, "_func_id_cache", None)
         if cache is None or cache[0] is not w:
-            cache = (w, w.export_function(self._func))
+            # cross-interpreter envs ship SOURCE, not bytecode: a
+            # python_version worker can't execute this minor's code
+            # objects (serialization.pack_callable_source)
+            by_source = bool(
+                (o.get("runtime_env") or {}).get("python_version"))
+            cache = (w, w.export_function(self._func,
+                                          by_source=by_source))
             self._func_id_cache = cache
         res = {"CPU": float(o["num_cpus"]), **o["resources"]}
         if o["num_tpus"]:
@@ -576,6 +582,16 @@ class ActorClass:
     def remote(self, *args, **kwargs) -> ActorHandle:
         w = _get_worker()
         o = self._opts
+        if (o.get("runtime_env") or {}).get("python_version"):
+            # actor class payloads ship as bytecode (cloudpickle); a
+            # cross-minor worker cannot unpickle them — fail at the
+            # submission site with the reason, not on the worker with
+            # a bad-marshal error
+            raise ValueError(
+                "runtime_env 'python_version' is not supported for "
+                "actors: class payloads ship as bytecode, which is "
+                "interpreter-minor-specific (tasks support it via "
+                "source shipping)")
         res = {"CPU": float(o["num_cpus"]), **o["resources"]}
         if o["num_tpus"]:
             res["TPU"] = float(o["num_tpus"])

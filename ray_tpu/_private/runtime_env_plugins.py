@@ -79,6 +79,27 @@ def _config_digest(config) -> str:
     ).hexdigest()
 
 
+def _relink_parent_sites(site_dir: str, extra: tuple = ()) -> None:
+    """Write a .pth in `site_dir` re-linking the agent interpreter's
+    site-packages (plus `extra` dirs): venvs built from a venv parent
+    (this image: /opt/venv over /usr/local) would otherwise not see the
+    parent's packages even with --system-site-packages; venv-installed
+    packages still shadow them (the venv site dir sorts first)."""
+    parent_sites = [p for p in sys.path
+                    if p.rstrip(os.sep).endswith("site-packages")
+                    and os.path.isdir(p)]
+    with open(os.path.join(site_dir, "_parent_site.pth"), "w") as f:
+        f.write("\n".join([*parent_sites, *extra]) + "\n")
+
+
+def _venv_modify_context(dest: str, ctx: "RuntimeEnvContext") -> None:
+    """Point the worker spawn at a materialized venv."""
+    ctx.py_executable = os.path.join(dest, "bin", "python")
+    ctx.env["VIRTUAL_ENV"] = dest
+    ctx.env["PATH"] = (os.path.join(dest, "bin") + os.pathsep
+                       + ctx.env.get("PATH", ""))
+
+
 class PipPlugin(RuntimeEnvPlugin):
     """`runtime_env={"pip": [...]}` → per-hash virtualenv.
 
@@ -124,22 +145,11 @@ class PipPlugin(RuntimeEnvPlugin):
                  tmp],
                 check=True, capture_output=True, timeout=300,
             )
-            # --system-site-packages exposes sys.BASE_prefix's packages;
-            # when the parent interpreter is ITSELF a venv (this image:
-            # /opt/venv over /usr/local) the parent's site-packages are
-            # invisible to the child. A .pth in the new env re-links
-            # every parent site-packages dir — venv-installed packages
-            # still shadow them (site dir sorts first on sys.path).
-            parent_sites = [p for p in sys.path
-                            if p.rstrip(os.sep).endswith("site-packages")
-                            and os.path.isdir(p)]
             site_dir = os.path.join(
                 tmp, "lib",
                 f"python{sys.version_info[0]}.{sys.version_info[1]}",
                 "site-packages")
-            with open(os.path.join(site_dir, "_parent_site.pth"),
-                      "w") as f:
-                f.write("\n".join(parent_sites) + "\n")
+            _relink_parent_sites(site_dir)
             if pkgs:
                 py = os.path.join(tmp, "bin", "python")
                 r = subprocess.run(
@@ -157,13 +167,93 @@ class PipPlugin(RuntimeEnvPlugin):
             shutil.rmtree(tmp, ignore_errors=True)
 
     def modify_context(self, uri, config, dest, ctx) -> None:
-        ctx.py_executable = os.path.join(dest, "bin", "python")
-        ctx.env["VIRTUAL_ENV"] = dest
-        ctx.env["PATH"] = (os.path.join(dest, "bin") + os.pathsep
-                           + ctx.env.get("PATH", ""))
+        _venv_modify_context(dest, ctx)
 
 
-_BUILTIN = [PipPlugin()]
+class PyVersionPlugin(RuntimeEnvPlugin):
+    """`runtime_env={"python_version": "3.11"}` — a full DIFFERENT
+    interpreter per env: the conda-plugin equivalent (reference
+    _private/runtime_env/conda.py:1, which materializes a whole conda
+    env keyed by spec hash). This image is zero-egress with no
+    conda/micromamba binary, so instead of solving an env spec the
+    plugin discovers an installed CPython of the requested minor and
+    builds a cached venv from it; the lifecycle — content-addressed
+    URI, refcounted PackageCache materialization, idle GC, interpreter
+    swap via modify_context — matches the conda plugin's.
+
+    The venv gets (a) a .pth re-linking the driver's site-packages so
+    pure-python deps (incl. msgpack's fallback) import, and (b) its own
+    empty sitecustomize.py shadowing any jax-importing sitecustomize
+    further down sys.path that the other minor can't import. Function
+    payloads for such envs ship as SOURCE (pack_callable_source):
+    bytecode is minor-specific."""
+
+    name = "python_version"
+    priority = 4  # interpreter swap precedes everything else
+
+    _CANDIDATE_DIRS = ("/usr/bin", "/usr/local/bin", "/opt/bin")
+
+    @classmethod
+    def find_interpreter(cls, version: str) -> str | None:
+        exe = shutil.which(f"python{version}")
+        if exe:
+            return exe
+        for d in cls._CANDIDATE_DIRS:
+            p = os.path.join(d, f"python{version}")
+            if os.path.exists(p):
+                return p
+        return None
+
+    @staticmethod
+    def _normalize(config) -> str:
+        v = str(config)
+        parts = v.split(".")
+        if len(parts) != 2 or not all(p.isdigit() for p in parts):
+            raise ValueError(
+                f'python_version must look like "3.11", got {config!r}')
+        return v
+
+    def uri_for(self, config) -> str:
+        return "pyver://" + _config_digest(
+            {"python": self._normalize(config)})
+
+    def create(self, uri: str, config, dest: str) -> None:
+        version = self._normalize(config)
+        exe = self.find_interpreter(version)
+        if exe is None:
+            raise RuntimeError(
+                f"no python{version} interpreter on this node "
+                f"(searched PATH + {', '.join(self._CANDIDATE_DIRS)})")
+        tmp = dest + ".tmp"
+        shutil.rmtree(tmp, ignore_errors=True)
+        try:
+            # --without-pip: zero-egress image; deps come from the
+            # driver site-packages re-link below
+            subprocess.run([exe, "-m", "venv", "--without-pip", tmp],
+                           check=True, capture_output=True, timeout=300)
+            site_dir = os.path.join(tmp, "lib", f"python{version}",
+                                    "site-packages")
+            # the framework itself (workers run -m ray_tpu.core.worker_proc)
+            import ray_tpu as _pkg
+
+            _relink_parent_sites(site_dir, extra=(os.path.dirname(
+                os.path.dirname(os.path.abspath(_pkg.__file__))),))
+            with open(os.path.join(site_dir, "sitecustomize.py"),
+                      "w") as f:
+                f.write(
+                    "# shadows the parent interpreter's sitecustomize:\n"
+                    "# it imports packages built for a different python\n"
+                    "# minor (jax) that this venv's interpreter cannot\n"
+                    "# load\n")
+            os.replace(tmp, dest)
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    def modify_context(self, uri, config, dest, ctx) -> None:
+        _venv_modify_context(dest, ctx)
+
+
+_BUILTIN = [PyVersionPlugin(), PipPlugin()]
 _registry: dict[str, RuntimeEnvPlugin] | None = None
 
 
@@ -206,6 +296,13 @@ async def apply_plugins(runtime_env: dict, ctx: RuntimeEnvContext,
     """Agent-side: run every registered plugin whose key appears in the
     env. Returns the acquired URIs (caller releases them on worker
     death, same as pkg:// URIs)."""
+    if "python_version" in runtime_env and "pip" in runtime_env:
+        # PipPlugin builds its venv from the DRIVER interpreter; running
+        # after PyVersionPlugin it would silently swap the interpreter
+        # back — fail loudly instead of ignoring python_version
+        raise RuntimeError(
+            "runtime_env cannot combine 'python_version' with 'pip': "
+            "pip venvs build from the driver interpreter")
     acquired: list[str] = []
     loop = asyncio.get_running_loop()
     try:

@@ -128,3 +128,67 @@ def unpack_parts(table: bytes, data) -> list:
         parts.append(data[off:off + s])
         off += s
     return parts
+
+
+_SOURCE_FN_KEY = "__ray_tpu_source_fn__"
+
+
+def pack_callable_source(fn) -> list:
+    """Pack a function as SOURCE TEXT instead of bytecode.
+
+    cloudpickle's by-value path ships code objects, which are
+    interpreter-minor-specific — a worker in a cross-version
+    runtime_env ({"python_version": "3.11"}) cannot execute 3.12
+    bytecode. Source recompiles on whatever interpreter runs it.
+
+    Contract: the function must be SELF-CONTAINED — it recompiles into
+    a fresh namespace, so module-level globals (imports, helpers,
+    constants) are NOT available; import inside the body. Closures /
+    driver-state defaults won't survive, and decorator lines are
+    stripped (the worker wants the plain function)."""
+    import inspect
+    import textwrap
+
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except OSError as e:
+        raise ValueError(
+            f"cannot source-pack {getattr(fn, '__name__', fn)!r} for a "
+            "cross-interpreter runtime_env: its source is not on disk "
+            "(interactive/stdin definition). Define the function in a "
+            "module file.") from e
+    lines = src.splitlines()
+    # strip decorators (possibly multi-line): keep from the def on
+    for i, line in enumerate(lines):
+        if line.startswith(("def ", "async def ")):
+            lines = lines[i:]
+            break
+    else:
+        raise ValueError(
+            f"cannot source-pack {fn!r}: no module-level def found "
+            "(lambdas/nested functions can't cross interpreter versions)")
+    return pack_payload({_SOURCE_FN_KEY: "\n".join(lines),
+                         "name": fn.__name__})
+
+
+class _SourceFnGlobals(dict):
+    """Globals for a source-shipped function: turns the inevitable
+    NameError on a module-level global into an actionable message."""
+
+    def __missing__(self, key):
+        raise NameError(
+            f"name {key!r} is not defined — source-shipped functions "
+            "(cross-interpreter runtime_env) recompile without their "
+            "module globals; import/define everything inside the "
+            "function body")
+
+
+def maybe_materialize_source_fn(obj):
+    """Executor-side counterpart of pack_callable_source."""
+    if isinstance(obj, dict) and _SOURCE_FN_KEY in obj:
+        ns = _SourceFnGlobals({"__name__": "<ray_tpu source fn>",
+                               "__builtins__": __builtins__})
+        exec(compile(obj[_SOURCE_FN_KEY], "<ray_tpu source fn>",
+                     "exec"), ns)
+        return ns[obj["name"]]
+    return obj

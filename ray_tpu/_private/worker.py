@@ -560,10 +560,11 @@ class CoreWorker:
 
     # ------------- function export -------------
 
-    def export_function(self, func) -> bytes:
+    def export_function(self, func, by_source: bool = False) -> bytes:
         import hashlib
 
-        blob = serialization.pack_payload(func)
+        blob = (serialization.pack_callable_source(func) if by_source
+                else serialization.pack_payload(func))
         meta, bufs = blob
         h = hashlib.blake2b(digest_size=16)
         h.update(meta)
@@ -606,7 +607,8 @@ class CoreWorker:
                 bufs.append(self.head.call(
                     "kv_get", {"ns": FUNC_NS, "key": func_id + b"/%d" % i}
                 ))
-        fn = serialization.unpack_payload([meta, bufs])
+        fn = serialization.maybe_materialize_source_fn(
+            serialization.unpack_payload([meta, bufs]))
         self._func_cache[func_id] = fn
         return fn
 
