@@ -172,15 +172,22 @@ def pack_callable_source(fn) -> list:
 
 
 class _SourceFnGlobals(dict):
-    """Globals for a source-shipped function: turns the inevitable
-    NameError on a module-level global into an actionable message."""
+    """Globals for a source-shipped function: serves builtins (a
+    dict-subclass __missing__ PREEMPTS the interpreter's own builtins
+    fallback, so len/print/range would otherwise break) and turns a
+    genuinely missing module-level global into an actionable message."""
 
     def __missing__(self, key):
-        raise NameError(
-            f"name {key!r} is not defined — source-shipped functions "
-            "(cross-interpreter runtime_env) recompile without their "
-            "module globals; import/define everything inside the "
-            "function body")
+        import builtins
+
+        try:
+            return getattr(builtins, key)
+        except AttributeError:
+            raise NameError(
+                f"name {key!r} is not defined — source-shipped "
+                "functions (cross-interpreter runtime_env) recompile "
+                "without their module globals; import/define "
+                "everything inside the function body") from None
 
 
 def maybe_materialize_source_fn(obj):

@@ -1,0 +1,175 @@
+"""Autoscaler monitor: the reconcile loop as its OWN process.
+
+Reference: python/ray/autoscaler/_private/monitor.py:126 — the monitor
+is a separate head-node process connected to the GCS, not a thread
+inside it: a wedged provider call or a reconcile crash cannot take the
+head down, the head supervisor restarts it, and its death is visible
+(exit code + log) instead of a silently missing daemon thread.
+
+    python -m ray_tpu.autoscaler.monitor \
+        --head 10.0.0.1:6379 \
+        --provider my_pkg.providers:MyProvider \
+        --config '{"max_workers": 8, "idle_timeout_s": 60}'
+
+MonitorProcess is the head-side supervisor handle: spawn(), restart on
+unexpected death with backoff, stop().
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import logging
+import subprocess
+import sys
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+
+def _build_provider(spec: str, head_addr: str):
+    """provider spec forms:
+    - "module.path:ClassName" (constructed with no args, or with
+      head_address kwarg when the class accepts it)
+    - "gcp_tpu:{json}" — the built-in GCP TPU provider
+    """
+    kind, _, rest = spec.partition(":")
+    if kind == "gcp_tpu":
+        from ray_tpu.autoscaler.gcp import GCPTPUNodeProvider
+
+        cfg = json.loads(rest or "{}")
+        return GCPTPUNodeProvider(
+            project=cfg["project"], zone=cfg["zone"],
+            head_address=cfg.get("head_address", head_addr),
+        )
+    mod, cls = spec.rsplit(":", 1)
+    provider_cls = getattr(importlib.import_module(mod), cls)
+    try:
+        return provider_cls(head_address=head_addr)
+    except TypeError:
+        return provider_cls()
+
+
+def run_monitor(head_addr: str, provider_spec: str,
+                config: dict | None = None) -> int:
+    """Process entrypoint: connect to the head, reconcile until the
+    head goes away (exit 0) or the provider wiring is broken (exit 2)."""
+    from ray_tpu._private import rpc
+    from ray_tpu._private.rpc import EventLoopThread, SyncRpcClient
+    from ray_tpu.autoscaler import Autoscaler, AutoscalerConfig
+
+    host, port = head_addr.rsplit(":", 1)
+    io = EventLoopThread("ray_tpu-monitor")
+    try:
+        head = SyncRpcClient(host, int(port), io, reconnect=True)
+    except rpc.ConnectionLost:
+        logger.error("monitor: cannot reach head at %s", head_addr)
+        return 2
+    try:
+        provider = _build_provider(provider_spec, head_addr)
+    except Exception:
+        logger.exception("monitor: provider %r failed to construct",
+                         provider_spec)
+        return 2
+    cfg = AutoscalerConfig(**(config or {}))
+    scaler = Autoscaler(head, provider, cfg)
+    logger.info("monitor up: head=%s provider=%s", head_addr,
+                provider_spec)
+    misses = 0
+    while True:
+        try:
+            scaler.update()
+            misses = 0
+        except (rpc.ConnectionLost, rpc.RpcError):
+            # head restarting: SyncRpcClient reconnects; a DEAD head
+            # ends the monitor (the supervisor died with it)
+            misses += 1
+            if misses > 30:
+                logger.warning("monitor: head unreachable, exiting")
+                return 0
+        except Exception:  # noqa: BLE001 — keep reconciling
+            logger.exception("monitor: reconcile error")
+        time.sleep(cfg.poll_interval_s)
+
+
+class MonitorProcess:
+    """Head-side supervisor for the monitor subprocess (the reference
+    head starts/restarts its monitor the same way)."""
+
+    RESTART_BACKOFF_S = 2.0
+
+    def __init__(self, head_addr: str, provider_spec: str,
+                 config: dict | None = None):
+        self.head_addr = head_addr
+        self.provider_spec = provider_spec
+        self.config = config or {}
+        self.proc: subprocess.Popen | None = None
+        self.restarts = 0
+        self._stop = threading.Event()
+        self._sup: threading.Thread | None = None
+
+    def _spawn(self) -> subprocess.Popen:
+        return subprocess.Popen([
+            sys.executable, "-m", "ray_tpu.autoscaler.monitor",
+            "--head", self.head_addr,
+            "--provider", self.provider_spec,
+            "--config", json.dumps(self.config),
+        ])
+
+    def start(self) -> None:
+        self.proc = self._spawn()
+
+        def _supervise():
+            while not self._stop.is_set():
+                p = self.proc
+                if p is not None and p.poll() is not None:
+                    if p.returncode in (0, 2):
+                        # clean exit / broken wiring: restarting would
+                        # loop the same failure
+                        logger.warning(
+                            "monitor exited rc=%d; not restarting",
+                            p.returncode)
+                        return
+                    logger.warning(
+                        "monitor died rc=%d; restarting", p.returncode)
+                    self.restarts += 1
+                    time.sleep(self.RESTART_BACKOFF_S)
+                    if not self._stop.is_set():
+                        self.proc = self._spawn()
+                self._stop.wait(1.0)
+
+        self._sup = threading.Thread(target=_supervise, daemon=True,
+                                     name="ray_tpu-monitor-supervisor")
+        self._sup.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._sup is not None:
+            self._sup.join(timeout=5)
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--head", required=True, help="host:port")
+    ap.add_argument("--provider", required=True,
+                    help='"module:Class" or "gcp_tpu:{json}"')
+    ap.add_argument("--config", default="{}",
+                    help="AutoscalerConfig fields as JSON")
+    args = ap.parse_args(argv)
+    return run_monitor(args.head, args.provider,
+                       json.loads(args.config))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,11 +21,16 @@ from ray_tpu.data.dataset import (  # noqa: F401
     range as range_,  # `range` shadows the builtin; both names exported
 )
 from ray_tpu.data.datasource import (  # noqa: F401
+    parse_tf_example,
+    read_binary_files,
     read_csv,
+    read_images,
     read_json,
     read_numpy,
     read_parquet,
+    read_parquet_partitioned,
     read_text,
+    read_tfrecords,
 )
 
 range = range_  # noqa: A001 — mirrors ray.data.range

@@ -55,6 +55,12 @@ def block_rows(block) -> list:
             return block.to_dict("records")
     except ImportError:  # pragma: no cover
         pass
+    if isinstance(block, dict):
+        # column dict ({name: [values]}) — the block shape the
+        # image/binary readers emit
+        keys = list(block)
+        n = len(block[keys[0]]) if keys else 0
+        return [{k: block[k][i] for k in keys} for i in range(n)]
     return list(block)
 
 
@@ -88,4 +94,6 @@ def build_like(proto, rows: list):
         pass
     if isinstance(proto, np.ndarray):
         return np.asarray(rows, dtype=proto.dtype)
+    if isinstance(proto, dict):
+        return {k: [r[k] for r in rows] for k in proto}
     return rows

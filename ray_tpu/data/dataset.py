@@ -154,6 +154,16 @@ def _prefetched(refs: list, depth: int) -> Iterator[Any]:
         stop.set()
 
 
+@ray_tpu.remote(num_cpus=0, num_returns=2)
+def _split_block(block, k: int):
+    """Cut one block at row k -> (head, tail) blocks of the same type
+    (train_test_split's boundary cut; runs where the block lives)."""
+    from ray_tpu.data.block import block_rows, build_like
+
+    rows = block_rows(block)
+    return build_like(block, rows[:k]), build_like(block, rows[k:])
+
+
 @ray_tpu.remote(num_cpus=0)
 def _count_rows(block) -> int:
     """Remote row-count probe (limit pushdown): the count travels, the
@@ -865,6 +875,81 @@ class Dataset:
             )
         return pd.concat(frames, ignore_index=True) if frames else \
             pd.DataFrame()
+
+    def to_arrow(self):
+        """Materialize as one pyarrow Table (reference to_arrow_refs,
+        collapsed driver-side)."""
+        import pyarrow as pa
+
+        tables = []
+        for block in self.iter_batches():
+            if isinstance(block, pa.Table):
+                tables.append(block)
+            else:
+                tables.append(pa.Table.from_pandas(self._as_df(block)))
+        return pa.concat_tables(tables) if tables else pa.table({})
+
+    @staticmethod
+    def _as_df(block):
+        import pandas as pd
+
+        return (block if isinstance(block, pd.DataFrame)
+                else pd.DataFrame(block))
+
+    def take_batch(self, batch_size: int = 20):
+        """First `batch_size` rows as ONE batch (reference take_batch:
+        tabular — DataFrame/Arrow/column-dict blocks — in -> DataFrame
+        out, rows otherwise)."""
+        import pandas as pd
+
+        from ray_tpu.data.block import _arrow_table_type
+
+        rows: list = []
+        tabular = None
+        for block in self.iter_batches():
+            is_tab = isinstance(
+                block, (pd.DataFrame, dict, *(
+                    (_arrow_table_type(),)
+                    if _arrow_table_type() else ())))
+            tabular = is_tab if tabular is None else tabular
+            rows.extend(block_rows(block))
+            if len(rows) >= batch_size:
+                break
+        rows = rows[:batch_size]
+        return pd.DataFrame(rows) if tabular else rows
+
+    def train_test_split(self, test_size: float, *,
+                         shuffle: bool = False, seed: int | None = None
+                         ) -> tuple["Dataset", "Dataset"]:
+        """Row-exact split into (train, test) datasets (reference
+        train_test_split). Block-level: whole blocks are ASSIGNED, only
+        the boundary block is cut by a remote task — nothing
+        materializes on the driver, so datasets larger than driver
+        memory split fine. test_size is a fraction in (0, 1)."""
+        if not 0.0 < test_size < 1.0:
+            raise ValueError(f"test_size must be in (0, 1): {test_size}")
+        ds: "Dataset" = self
+        if shuffle:
+            ds = ds.random_shuffle(seed=seed)
+        blocks = list(ds._blocks)
+        counts = ray_tpu.get(
+            [_count_rows.remote(b) for b in blocks], timeout=600)
+        total = sum(counts)
+        split_at = total - int(total * test_size)
+        train_blocks: list = []
+        test_blocks: list = []
+        acc = 0
+        for b, c in zip(blocks, counts):
+            if acc + c <= split_at:
+                train_blocks.append(b)
+            elif acc >= split_at:
+                test_blocks.append(b)
+            else:
+                head, tail = _split_block.remote(b, split_at - acc)
+                train_blocks.append(head)
+                test_blocks.append(tail)
+            acc += c
+        return Dataset(train_blocks), Dataset(test_blocks)
 
     def iter_torch_batches(self, *, dtype=None):
         """Blocks as torch tensors (reference iter_torch_batches)."""

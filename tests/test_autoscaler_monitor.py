@@ -1,0 +1,122 @@
+"""Autoscaler monitor as a separate PROCESS (reference
+autoscaler/_private/monitor.py:126): scale-up signals flow
+head -> monitor subprocess -> provider, and the supervisor restarts a
+killed monitor."""
+
+import json
+import os
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+PROVIDER_SRC = '''
+import json, os
+
+
+class FileProvider:
+    """Test provider: records create/terminate in a JSON file the test
+    reads (the monitor runs in ANOTHER process, so the file is the
+    observation channel)."""
+
+    def __init__(self, head_address=""):
+        self.path = os.environ["FILEPROV_PATH"]
+
+    def _load(self):
+        try:
+            with open(self.path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {"nodes": [], "creates": 0, "terminates": 0}
+
+    def _save(self, d):
+        with open(self.path, "w") as f:
+            json.dump(d, f)
+
+    def create_node(self, resources, node_type=None):
+        d = self._load()
+        d["creates"] += 1
+        node = {"resources": resources, "id": d["creates"]}
+        d["nodes"].append(node)
+        self._save(d)
+        return node
+
+    def terminate_node(self, node):
+        d = self._load()
+        d["terminates"] += 1
+        d["nodes"] = [n for n in d["nodes"] if n["id"] != node["id"]]
+        self._save(d)
+
+    def non_terminated_nodes(self):
+        return self._load()["nodes"]
+
+    def node_types(self):
+        return None
+'''
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster(head_resources={"CPU": 2, "memory": 2 * 2**30})
+    c.connect()
+    yield c
+    c.shutdown()
+
+
+def test_monitor_process_scales_and_restarts(cluster, tmp_path,
+                                             monkeypatch):
+    from ray_tpu.autoscaler.monitor import MonitorProcess
+
+    (tmp_path / "fileprov.py").write_text(PROVIDER_SRC)
+    state = tmp_path / "prov.json"
+    monkeypatch.setenv("FILEPROV_PATH", str(state))
+    monkeypatch.setenv(
+        "PYTHONPATH",
+        f"{tmp_path}{os.pathsep}" + os.environ.get("PYTHONPATH", ""))
+
+    head_addr = f"127.0.0.1:{cluster.head_port}"
+    mon = MonitorProcess(head_addr, "fileprov:FileProvider",
+                         {"max_workers": 2, "poll_interval_s": 0.25,
+                          "idle_timeout_s": 3600.0})
+    mon.start()
+    try:
+        assert mon.proc is not None and mon.proc.poll() is None
+
+        # queued demand beyond current capacity (but fitting the
+        # worker node type) -> the monitor must ask the provider for
+        # a node
+        @ray_tpu.remote(num_cpus=2)
+        def hog():
+            import time as _t
+            _t.sleep(120)
+            return 1
+
+        refs = [hog.remote() for _ in range(3)]
+        deadline = time.time() + 60
+        creates = 0
+        while time.time() < deadline:
+            if state.exists():
+                creates = json.loads(state.read_text())["creates"]
+                if creates >= 1:
+                    break
+            time.sleep(0.5)
+        assert creates >= 1, "monitor never launched a node"
+        del refs  # hogs keep running; the cluster teardown reaps them
+
+        # chaos: kill the monitor; the supervisor restarts it
+        old_pid = mon.proc.pid
+        os.kill(old_pid, signal.SIGKILL)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            p = mon.proc
+            if p is not None and p.pid != old_pid and p.poll() is None:
+                break
+            time.sleep(0.5)
+        assert mon.restarts >= 1
+        assert mon.proc.pid != old_pid and mon.proc.poll() is None
+    finally:
+        mon.stop()
+    assert mon.proc.poll() is not None  # stopped for real
