@@ -35,15 +35,38 @@ assert jax.default_backend() == "cpu", (
 @pytest.fixture(autouse=True)
 def _hang_watchdog():
     """Convert silent suite wedges into diagnosed failures: if any single
-    test runs >15min, faulthandler dumps EVERY thread's stack and the
+    test runs >10min, faulthandler dumps EVERY thread's stack and the
     process exits — a monolithic `pytest tests/` run must never sit
     stalled for an hour with idle leaked workers (observed in r4: a
-    cross-file hang wedged the suite >44min with zero output)."""
+    cross-file hang wedged the suite >44min with zero output).
+
+    The dump goes to a FILE (ray_tpu_hang_dump.log under the system
+    temp dir), not stderr: pytest's default fd-level capture dup2s
+    fd 2 before this conftest even imports, so both sys.stderr and
+    sys.__stderr__ land in the doomed process's capture temp file —
+    exactly what made the first watchdog firing an undiagnosable
+    silent rc=1. A plain file survives the hard _exit."""
     import faulthandler
 
-    faulthandler.dump_traceback_later(900, exit=True)
+    faulthandler.dump_traceback_later(600, exit=True,
+                                      file=_watchdog_log())
     yield
     faulthandler.cancel_dump_traceback_later()
+
+
+_WATCHDOG_FH = None
+
+
+def _watchdog_log():
+    global _WATCHDOG_FH
+    if _WATCHDOG_FH is None:
+        import tempfile
+
+        path = os.path.join(tempfile.gettempdir(),
+                            "ray_tpu_hang_dump.log")
+        _WATCHDOG_FH = open(path, "a")  # noqa: SIM115 — must outlive tests
+        print(f"[conftest] hang-watchdog dumps -> {path}")
+    return _WATCHDOG_FH
 
 
 def _kill_orphan_workers():
