@@ -105,6 +105,23 @@ _DEFS: dict[str, Any] = {
     # -- memory monitor --
     "memory_monitor_interval_s": 2.0,
     "memory_usage_kill_fraction": 0.95,  # memory_monitor.h:52 analog
+    # -- collective (DCN path) --
+    # transport for the process-group allreduce/allgather/reducescatter:
+    # "ring" = chunked pipelined ring over p2p RPC (2*(N-1)/N bytes/rank),
+    # "star" = legacy rank-0 tree (O(N*bytes) at the root; the fallback)
+    "collective_transport": "ring",
+    # wire codec for ring payloads: "none" (dtype passthrough), "bf16",
+    # "int8" (EQuARX-style block-scaled with error feedback)
+    "collective_codec": "none",
+    # bytes per in-flight ring chunk; serialization of chunk k overlaps
+    # the wire time of chunk k-1
+    "collective_chunk_bytes": 1024 * 1024,
+    # per-recv deadline inside group ops (env RAY_TPU_COLLECTIVE_TIMEOUT_S)
+    "collective_timeout_s": 120.0,
+    # block length for the int8 block-scaled codec (one f32 scale each)
+    "collective_quant_block": 512,
+    # gradient-bucket target size for train.dcn_allreduce_grads
+    "collective_bucket_bytes": 4 * 1024 * 1024,
 }
 
 _cache: dict[str, Any] = {}

@@ -73,6 +73,79 @@ class _AsyncActor:
         return b"ok"
 
 
+@ray_tpu.remote(num_cpus=0)
+class _CollRank:
+    """One collective rank for the DCN star/ring/ring+int8 comparison."""
+
+    def init(self, world, rank, name):
+        from ray_tpu.collective import init_collective_group
+
+        init_collective_group(world, rank, group_name=name)
+        self.group = name
+        return rank
+
+    def allreduce_loop(self, nbytes, iters, transport, codec):
+        """Lockstep allreduce timing; returns (s/op, wire bytes/op)."""
+        from ray_tpu.collective import collective as col
+        from ray_tpu.collective import ring
+
+        arr = np.ones(nbytes // 4, dtype=np.float32)
+        col.allreduce(arr, self.group, transport=transport, codec=codec)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            col.allreduce(arr, self.group, transport=transport,
+                          codec=codec)
+        dt = time.perf_counter() - t0
+        st = ring.last_op_stats(self.group)
+        return dt / iters, st.bytes_sent
+
+
+def run_collective_benchmarks(*, quick: bool = False) -> list[dict]:
+    """The `collective` family: star vs ring vs ring+int8 allreduce across
+    4 ranks at 1 MB / 16 MB — wall time plus per-rank wire bytes, the
+    numbers the ring engine exists to move (2·(N−1)/N per rank vs
+    O(N·bytes) at the star root; int8 ≤ ~26% of the f32 bytes)."""
+    import uuid
+
+    results = []
+    world = 4
+    ranks = [_CollRank.remote() for _ in range(world)]
+    try:
+        name = f"perf-{uuid.uuid4().hex[:8]}"
+        ray_tpu.get([a.init.remote(world, r, name)
+                     for r, a in enumerate(ranks)], timeout=120)
+        sizes = [(1, 5)] if quick else [(1, 8), (16, 3)]
+        for mb, iters in sizes:
+            nbytes = mb * 1024 * 1024
+            for transport, codec, label in (
+                ("star", None, "star"),
+                ("ring", None, "ring"),
+                ("ring", "int8", "ring+int8"),
+            ):
+                outs = ray_tpu.get(
+                    [a.allreduce_loop.remote(nbytes, iters, transport,
+                                             codec)
+                     for a in ranks],
+                    timeout=600,
+                )
+                per_op = max(dt for dt, _ in outs)
+                wire = max(b for _, b in outs)
+                r = {
+                    "name":
+                        f"collective allreduce {label} {mb}MB (4 ranks)",
+                    "per_s": round(1.0 / per_op, 1),
+                    "unit": "ops/s",
+                    "wire_bytes_per_rank": int(wire),
+                    "tensor_bytes": nbytes,
+                }
+                results.append(r)
+                print(json.dumps(r), flush=True)
+    finally:
+        for a in ranks:
+            ray_tpu.kill(a)
+    return results
+
+
 def run_benchmarks(*, quick: bool = False) -> list[dict]:
     results = []
     windows = 1 if quick else 3
@@ -168,6 +241,9 @@ def run_benchmarks(*, quick: bool = False) -> list[dict]:
     results.append(r)
     print(json.dumps(r), flush=True)
 
+    # ---- collective (DCN star vs ring vs ring+int8) ----
+    results.extend(run_collective_benchmarks(quick=quick))
+
     return results
 
 
@@ -218,6 +294,8 @@ def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--out", default=None, help="write results JSON here")
     p.add_argument("--quick", action="store_true")
+    p.add_argument("--family", default="all", choices=["all", "collective"],
+                   help="run one workload family only")
     p.add_argument("--in-process", action="store_true",
                    help="head in the driver process (debug only)")
     p.add_argument("--store-capacity", type=int,
@@ -231,7 +309,10 @@ def main(argv=None):
         proc, address = _start_head_proc(args.store_capacity)
         ray_tpu.init(address=address)
     try:
-        results = run_benchmarks(quick=args.quick)
+        if args.family == "collective":
+            results = run_collective_benchmarks(quick=args.quick)
+        else:
+            results = run_benchmarks(quick=args.quick)
     finally:
         ray_tpu.shutdown()
         if proc is not None:

@@ -7,8 +7,13 @@ send:531/recv:594). The reference's NCCL backend has **no TPU analog by
 design**: inside a mesh, the XLA compiler *is* the collective library —
 `mesh_allreduce` etc. lower to psum/all-gather over ICI via shard_map.
 Across processes/hosts (the gloo-path analog), the `cpu` backend runs
-ring/tree collectives over the framework's TCP RPC with rendezvous through
-the control-plane KV (mirroring gloo_util.py:271 RayInternalKvStore).
+collectives over the framework's TCP RPC with rendezvous through the
+control-plane KV (mirroring gloo_util.py:271 RayInternalKvStore).
+
+The DCN transport is selected by `RAY_TPU_COLLECTIVE_TRANSPORT`:
+``ring`` (default — `ring.py`, chunked/pipelined ring reduce-scatter +
+all-gather, 2·(N−1)/N bytes per rank, pluggable `compression.py` codecs
+with error feedback) or ``star`` (the legacy rank-0 tree fallback).
 """
 
 from ray_tpu.collective.collective import (  # noqa: F401
@@ -26,6 +31,17 @@ from ray_tpu.collective.collective import (  # noqa: F401
     reduce,
     reducescatter,
     send,
+)
+from ray_tpu.collective.compression import (  # noqa: F401
+    Codec,
+    get_codec,
+)
+from ray_tpu.collective.ring import (  # noqa: F401
+    OpStats,
+    last_op_stats,
+    ring_allgather,
+    ring_allreduce,
+    ring_reducescatter,
 )
 from ray_tpu.collective.mesh_ops import (  # noqa: F401
     mesh_allgather,

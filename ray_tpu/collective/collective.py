@@ -6,9 +6,15 @@ init_collective_group:120, allreduce:258, …). Backend mapping:
 - reference NCCL backend → **not needed on TPU**: intra-mesh tensors use the
   compiler-native ops in `mesh_ops.py` (psum over ICI).
 - reference Gloo backend (CPU, Ray-KV rendezvous, gloo_util.py:271) → the
-  `cpu` backend here: host-memory ring/tree collectives among worker
-  processes over the framework RPC, rendezvous via control-plane KV. This is
-  the DCN path — cross-host coordination where no shared mesh exists.
+  `cpu` backend here: host-memory collectives among worker processes over
+  the framework RPC, rendezvous via control-plane KV. This is the DCN
+  path — cross-host coordination where no shared mesh exists.
+
+allreduce/reducescatter/allgather route through a transport flag
+(`RAY_TPU_COLLECTIVE_TRANSPORT`): ``ring`` (default) is the chunked,
+pipelined, optionally quantized engine in `ring.py`; ``star`` is the
+legacy rank-0 tree kept as the fallback (and still the shape of
+reduce/broadcast, which are inherently rooted).
 
 Tensors are numpy arrays or host-convertible (jax arrays are converted on
 the way in and back on the way out, like the reference's gloo path).
@@ -22,9 +28,24 @@ from typing import Any
 
 import numpy as np
 
-from ray_tpu._private import serialization
+from ray_tpu._private import config, serialization
 
 KV_NS = "collective"
+
+
+def _default_timeout() -> float:
+    """Configurable op deadline (env RAY_TPU_COLLECTIVE_TIMEOUT_S)."""
+    return float(config.get("collective_timeout_s"))
+
+
+def _transport(override: str | None = None) -> str:
+    t = override or config.get("collective_transport")
+    if t not in ("ring", "star"):
+        raise ValueError(
+            f"RAY_TPU_COLLECTIVE_TRANSPORT must be 'ring' or 'star', "
+            f"got {t!r}"
+        )
+    return t
 
 
 class _Mailbox:
@@ -53,11 +74,16 @@ class _Mailbox:
 class Group:
     """One rank's view of a collective group (reference BaseGroup)."""
 
-    def __init__(self, name: str, world_size: int, rank: int, worker):
+    def __init__(self, name: str, world_size: int, rank: int, worker,
+                 epoch: int = 1):
         self.name = name
         self.world_size = world_size
         self.rank = rank
         self.worker = worker
+        # group incarnation, agreed at rendezvous (max over ranks): keys
+        # every frame so a destroyed-and-recreated same-name group can
+        # never consume frames still in flight from the old incarnation
+        self.epoch = epoch
         self.seq = 0  # lockstep counter: every rank runs collectives in the
         # same order, so it advances identically group-wide
         self.p2p_send: dict[int, int] = {}  # dst → count (independent pairs)
@@ -69,23 +95,62 @@ class Group:
         return self.seq
 
     def _send_to(self, dst_rank: int, seq: int, tag: str, array):
+        self._send_obj(dst_rank, seq, tag, np.asarray(array))
+
+    def _send_obj(self, dst_rank: int, seq: int, tag: str, obj,
+                  *, fire: bool = False):
+        """Ship any picklable object to a peer's mailbox. ``fire=True``
+        uses the buffered fire-and-forget path (the ring engine's chunk
+        pipelining: sends drain on the io thread while this thread
+        decodes/reduces); delivery failures surface as the receiver's
+        timeout, which names this op."""
         peer = self.peers[dst_rank]
         cli = self.worker._peer(peer)
         if cli is None:
-            raise ConnectionError(f"cannot reach rank {dst_rank}")
-        payload = serialization.pack_payload(np.asarray(array))
-        cli.call("coll_msg", {
-            "group": self.name, "seq": seq, "src": self.rank, "tag": tag,
-            "payload": payload,
-        })
+            raise ConnectionError(
+                f"collective '{self.name}' rank {self.rank}: cannot reach "
+                f"rank {dst_rank}"
+            )
+        msg = {
+            "group": self.name, "inc": self.epoch, "seq": seq,
+            "src": self.rank, "tag": tag,
+            "payload": serialization.pack_payload(obj),
+        }
+        if fire:
+            cli.fire("coll_msg", msg)
+        else:
+            cli.call("coll_msg", msg)
 
-    def _recv_from(self, src_rank: int, seq: int, tag: str, timeout=120.0):
+    def _recv_from(self, src_rank: int, seq: int, tag: str,
+                   timeout: float | None = None, op: str | None = None):
+        return self._recv_obj(src_rank, seq, tag, timeout=timeout, op=op)
+
+    def _recv_obj(self, src_rank: int, seq: int, tag: str,
+                  timeout: float | None = None, op: str | None = None):
+        if timeout is None:
+            timeout = _default_timeout()
         box = _mailbox()
-        msg = box.take((self.name, seq, src_rank, tag), timeout)
+        try:
+            msg = box.take((self.name, self.epoch, seq, src_rank, tag),
+                           timeout)
+        except TimeoutError:
+            raise TimeoutError(
+                f"collective group '{self.name}' rank {self.rank}: "
+                f"op '{op or tag}' timed out after {timeout}s waiting for "
+                f"rank {src_rank} (seq {seq}, tag {tag!r})"
+            ) from None
         return serialization.unpack_payload(msg)
 
 
 _groups: dict[str, Group] = {}
+# times THIS process has initialized each group name; published at
+# rendezvous so the group epoch = max over ranks (a restarted process
+# re-joining a recreated group adopts the survivors' higher epoch)
+_inc_counts: dict[str, int] = {}
+# minimum live epoch per group name: frames below it are stragglers from
+# a destroyed incarnation and are dropped at ingress instead of pinning
+# the mailbox forever (nothing would ever take their keys)
+_min_epochs: dict[str, int] = {}
 _box: _Mailbox | None = None
 _lock = threading.Lock()
 
@@ -99,7 +164,11 @@ def _mailbox() -> _Mailbox:
 
 
 async def _rpc_coll_msg(conn, p):
-    _mailbox().put((p["group"], p["seq"], p["src"], p["tag"]), p["payload"])
+    inc = p.get("inc", 1)
+    if inc < _min_epochs.get(p["group"], 0):
+        return False  # stale frame from a destroyed incarnation
+    _mailbox().put((p["group"], inc, p["seq"], p["src"], p["tag"]),
+                   p["payload"])
     return True
 
 
@@ -121,12 +190,14 @@ def init_collective_group(world_size: int, rank: int,
     w = _get_worker()
     _install_route(w)
     me = w.owner_address
+    my_inc = _inc_counts.get(group_name, 0) + 1
     w.head.call("kv_put", {
         "ns": KV_NS,
         "key": f"{group_name}/{rank}".encode(),
-        "value": msgpack.packb(me),
+        "value": msgpack.packb({"owner": me, "inc": my_inc}),
     })
     group = Group(group_name, world_size, rank, w)
+    incs = {rank: my_inc}
     deadline = time.monotonic() + timeout
     while len(group.peers) < world_size:
         if time.monotonic() > deadline:
@@ -141,9 +212,16 @@ def init_collective_group(world_size: int, rank: int,
                 "ns": KV_NS, "key": f"{group_name}/{r}".encode(),
             })
             if raw is not None:
-                group.peers[r] = msgpack.unpackb(raw)
+                entry = msgpack.unpackb(raw)
+                group.peers[r] = entry["owner"]
+                incs[r] = entry["inc"]
         if len(group.peers) < world_size:
             time.sleep(0.05)
+    # every rank sees the same published set, so max() agrees group-wide
+    group.epoch = max(incs.values())
+    _inc_counts[group_name] = group.epoch
+    _min_epochs[group_name] = max(_min_epochs.get(group_name, 0),
+                                  group.epoch)
     _groups[group_name] = group
     return group
 
@@ -173,9 +251,42 @@ class CollectiveActorMixin:
         self._coll_group = group_name
         return rank
 
+    def __ray_tpu_destroy_collective__(self, group_name):
+        destroy_collective_group(group_name)
+        self._coll_group = None
+        return True
+
 
 def destroy_collective_group(group_name: str = "default"):
-    _groups.pop(group_name, None)
+    """Tear down this rank's view of a group.
+
+    Purges the process mailbox of the group's pending ``(group, seq, src,
+    tag)`` frames and resets the p2p seq counters, so re-initializing a
+    group under the same name cannot consume stale frames from the old
+    incarnation; also best-effort deletes this rank's KV rendezvous entry
+    so a future same-name rendezvous can't read a dead peer address."""
+    from ray_tpu.collective import ring as _ring
+
+    g = _groups.pop(group_name, None)
+    box = _box
+    if box is not None:
+        with box.cond:
+            for k in [k for k in box.msgs if k[0] == group_name]:
+                del box.msgs[k]
+    _ring.purge_group(group_name)
+    if g is not None:
+        # straggler frames from this incarnation arriving after the purge
+        # above are dropped at ingress
+        _min_epochs[group_name] = max(
+            _min_epochs.get(group_name, 0), g.epoch + 1)
+        g.p2p_send.clear()
+        g.p2p_recv.clear()
+        try:
+            g.worker.head.call("kv_del", {
+                "ns": KV_NS, "key": f"{group_name}/{g.rank}".encode(),
+            })
+        except Exception:  # noqa: BLE001 — teardown is best-effort
+            pass
 
 
 def get_rank(group_name: str = "default") -> int:
@@ -212,33 +323,74 @@ def _to_numpy(tensor):
     return np.asarray(tensor)  # jax arrays device→host here
 
 
-def allreduce(tensor, group_name: str = "default", op: str = "sum"):
-    """Tree allreduce via rank 0 (reference collective.py:258)."""
+def allreduce(tensor, group_name: str = "default", op: str = "sum",
+              *, codec=None, transport: str | None = None,
+              timeout: float | None = None, ef_tag: str | None = None):
+    """Allreduce over the group.
+
+    Transport is the ``collective_transport`` flag (default ``ring``: the
+    chunked pipelined engine in `ring.py`, 2·(N−1)/N bytes per rank) or
+    ``star`` (the legacy rank-0 tree, the fallback). ``codec`` selects a
+    ring wire codec (``none``/``bf16``/``int8``); the star path is always
+    full precision. ``ef_tag`` names a stable tensor identity across
+    repeated calls (e.g. a gradient bucket id) — error feedback engages
+    ONLY when it is set, since residuals folded across unrelated tensors
+    would bias the reduction.
+    """
     g = _group(group_name)
-    seq = g._next_seq()
     arr = _to_numpy(tensor)
+    if _transport(transport) == "ring":
+        from ray_tpu.collective import ring as _ring
+
+        return _ring.ring_allreduce(g, arr, op=op, codec=codec,
+                                    timeout=timeout, ef_tag=ef_tag)
+    return _star_allreduce(g, arr, op, timeout)
+
+
+def _star_allreduce(g: Group, arr: np.ndarray, op: str,
+                    timeout: float | None = None):
+    """Legacy tree allreduce via rank 0 (reference collective.py:258)."""
+    from ray_tpu.collective.ring import OpStats, record_stats
+
+    seq = g._next_seq()
+    st = OpStats("allreduce", "star", "none", g.world_size,
+                 tensor_bytes=arr.nbytes)
     if g.world_size == 1:
-        return arr
+        record_stats(g.name, st)
+        return arr.copy()
+    t0 = time.perf_counter()
     if g.rank == 0:
         parts = [arr] + [
-            g._recv_from(r, seq, "ar-up") for r in range(1, g.world_size)
+            np.asarray(g._recv_from(r, seq, "ar-up", timeout, op="allreduce"))
+            for r in range(1, g.world_size)
         ]
-        out = _REDUCE[op](np.stack([np.asarray(p) for p in parts]))
+        st.bytes_recv += sum(p.nbytes for p in parts[1:])
+        out = _REDUCE[op](np.stack(parts))
         for r in range(1, g.world_size):
             g._send_to(r, seq, "ar-down", out)
+        st.bytes_sent += out.nbytes * (g.world_size - 1)
+        st.chunks = 2 * (g.world_size - 1)
+        st.seconds = time.perf_counter() - t0
+        record_stats(g.name, st)
         return out
     g._send_to(0, seq, "ar-up", arr)
-    return np.asarray(g._recv_from(0, seq, "ar-down"))
+    out = np.asarray(g._recv_from(0, seq, "ar-down", timeout, op="allreduce"))
+    st.bytes_sent += arr.nbytes
+    st.bytes_recv += out.nbytes
+    st.chunks = 2
+    st.seconds = time.perf_counter() - t0
+    record_stats(g.name, st)
+    return out
 
 
 def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
-           op: str = "sum"):
+           op: str = "sum", *, timeout: float | None = None):
     g = _group(group_name)
     seq = g._next_seq()
     arr = _to_numpy(tensor)
     if g.rank == dst_rank:
         parts = [arr] + [
-            g._recv_from(r, seq, "red")
+            g._recv_from(r, seq, "red", timeout, op="reduce")
             for r in range(g.world_size) if r != dst_rank
         ]
         return _REDUCE[op](np.stack([np.asarray(p) for p in parts]))
@@ -246,7 +398,8 @@ def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
     return arr
 
 
-def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default",
+              *, timeout: float | None = None):
     g = _group(group_name)
     seq = g._next_seq()
     if g.rank == src_rank:
@@ -255,18 +408,26 @@ def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
             if r != src_rank:
                 g._send_to(r, seq, "bc", arr)
         return arr
-    return np.asarray(g._recv_from(src_rank, seq, "bc"))
+    return np.asarray(
+        g._recv_from(src_rank, seq, "bc", timeout, op="broadcast"))
 
 
-def allgather(tensor, group_name: str = "default") -> list:
+def allgather(tensor, group_name: str = "default", *, codec=None,
+              transport: str | None = None,
+              timeout: float | None = None) -> list:
     g = _group(group_name)
-    seq = g._next_seq()
     arr = _to_numpy(tensor)
+    if _transport(transport) == "ring":
+        from ray_tpu.collective import ring as _ring
+
+        return _ring.ring_allgather(g, arr, codec=codec, timeout=timeout)
+    seq = g._next_seq()
     if g.world_size == 1:
         return [arr]
     if g.rank == 0:
         parts = [arr] + [
-            g._recv_from(r, seq, "ag-up") for r in range(1, g.world_size)
+            g._recv_from(r, seq, "ag-up", timeout, op="allgather")
+            for r in range(1, g.world_size)
         ]
         parts = [np.asarray(p) for p in parts]
         stacked = np.stack(parts)
@@ -274,12 +435,26 @@ def allgather(tensor, group_name: str = "default") -> list:
             g._send_to(r, seq, "ag-down", stacked)
         return parts
     g._send_to(0, seq, "ag-up", arr)
-    return list(np.asarray(g._recv_from(0, seq, "ag-down")))
+    return list(np.asarray(
+        g._recv_from(0, seq, "ag-down", timeout, op="allgather")))
 
 
-def reducescatter(tensor, group_name: str = "default", op: str = "sum"):
+def reducescatter(tensor, group_name: str = "default", op: str = "sum",
+                  *, codec=None, transport: str | None = None,
+                  timeout: float | None = None, ef_tag: str | None = None):
+    """Each rank returns its own reduced axis-0 shard.
+
+    Ring transport moves only (N−1)/N of the tensor per rank and delivers
+    each rank exactly its shard; the star fallback is the legacy
+    allreduce-then-slice (every rank pays full allreduce traffic)."""
     g = _group(group_name)
-    out = allreduce(tensor, group_name, op)
+    arr = _to_numpy(tensor)
+    if _transport(transport) == "ring":
+        from ray_tpu.collective import ring as _ring
+
+        return _ring.ring_reducescatter(g, arr, op=op, codec=codec,
+                                        timeout=timeout, ef_tag=ef_tag)
+    out = _star_allreduce(g, arr, op, timeout)
     shards = np.array_split(out, g.world_size, axis=0)
     return shards[g.rank]
 
@@ -295,8 +470,9 @@ def send(tensor, dst_rank: int, group_name: str = "default"):
     g._send_to(dst_rank, seq, "p2p", _to_numpy(tensor))
 
 
-def recv(src_rank: int, group_name: str = "default", timeout: float = 120.0):
+def recv(src_rank: int, group_name: str = "default",
+         timeout: float | None = None):
     """P2P recv (reference collective.py:594)."""
     g = _group(group_name)
     g.p2p_recv[src_rank] = seq = g.p2p_recv.get(src_rank, 0) + 1
-    return np.asarray(g._recv_from(src_rank, seq, "p2p", timeout))
+    return np.asarray(g._recv_from(src_rank, seq, "p2p", timeout, op="recv"))

@@ -1,0 +1,217 @@
+"""Wire codecs for the DCN ring-collective engine.
+
+EQuARX (arXiv:2506.17615) shows block-scaled quantized all-reduce recovers
+most cross-slice (DCN) bandwidth at negligible quality cost. This module is
+the pluggable codec layer the ring engine (`ring.py`) compresses through:
+
+- ``none``  — dtype passthrough (raw bytes, exact)
+- ``bf16``  — float payloads truncated to bfloat16 (2 bytes/elem)
+- ``int8``  — EQuARX-style block-scaled int8: one f32 scale per
+  ``collective_quant_block`` elements, round-to-nearest; ~26% of the f32
+  wire bytes at the default block of 512
+
+Lossy codecs compose with **error feedback** (`encode_with_ef`): the
+quantization residual from step *t* is added back into the tensor at step
+*t+1*, so compression error is carried forward rather than lost — the
+standard EF-SGD construction that keeps int8 training loss within noise
+of f32.
+
+Encoded frames are plain dicts of bytes + small metadata (msgpack/pickle
+friendly); `wire_bytes` reports the payload size for the accounting the
+perf floors assert on.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu._private import config
+
+try:  # bf16 is an ml_dtypes type (always present under jax)
+    import ml_dtypes
+
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except Exception:  # pragma: no cover - ml_dtypes ships with jax
+    ml_dtypes = None
+    _BF16 = None
+
+def _is_float(arr: np.ndarray) -> bool:
+    if arr.dtype.kind == "f":
+        return True
+    return _BF16 is not None and arr.dtype == _BF16
+
+
+class Codec:
+    """One wire codec: ndarray -> framed dict -> ndarray.
+
+    ``lossless`` lets the error-feedback wrapper skip the decode
+    round-trip when there is no residual to extract.
+    """
+
+    name = "base"
+    lossless = True
+
+    def encode(self, arr: np.ndarray) -> dict:
+        raise NotImplementedError
+
+    def decode(self, frame: dict) -> np.ndarray:
+        raise NotImplementedError
+
+
+def _frame_meta(arr: np.ndarray) -> dict:
+    # dtype by NAME, not .str: ml_dtypes extension types (bfloat16) stringify
+    # to an anonymous void ('<V2') that cannot round-trip
+    return {"shape": list(arr.shape), "dtype": arr.dtype.name}
+
+
+def _wire_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _restore(flat: np.ndarray, frame: dict) -> np.ndarray:
+    return flat.reshape(frame["shape"])
+
+
+class PassthroughCodec(Codec):
+    name = "none"
+    lossless = True
+
+    def encode(self, arr: np.ndarray) -> dict:
+        arr = np.ascontiguousarray(arr)
+        f = _frame_meta(arr)
+        f.update(codec=self.name, data=arr.tobytes())
+        return f
+
+    def decode(self, frame: dict) -> np.ndarray:
+        flat = np.frombuffer(frame["data"], dtype=_wire_dtype(frame["dtype"]))
+        return _restore(flat, frame)
+
+
+class Bf16Codec(Codec):
+    """Truncate float payloads to bfloat16; non-floats pass through."""
+
+    name = "bf16"
+    lossless = False
+
+    def encode(self, arr: np.ndarray) -> dict:
+        arr = np.ascontiguousarray(arr)
+        if not _is_float(arr) or _BF16 is None:
+            f = PassthroughCodec().encode(arr)
+            f["codec"] = self.name
+            f["enc"] = "raw"
+            return f
+        f = _frame_meta(arr)
+        f.update(codec=self.name, enc="bf16",
+                 data=arr.astype(_BF16).tobytes())
+        return f
+
+    def decode(self, frame: dict) -> np.ndarray:
+        if frame.get("enc") == "raw":
+            return PassthroughCodec().decode(frame)
+        flat = np.frombuffer(frame["data"], dtype=_BF16)
+        return _restore(flat.astype(_wire_dtype(frame["dtype"])), frame)
+
+
+class BlockInt8Codec(Codec):
+    """Block-scaled int8 (EQuARX §3): per-block max-abs f32 scale +
+    round-to-nearest int8 mantissas. Non-float payloads pass through
+    (quantizing exact integer reductions would corrupt them)."""
+
+    name = "int8"
+    lossless = False
+
+    def __init__(self, block: int | None = None):
+        self.block = int(block or config.get("collective_quant_block"))
+
+    def encode(self, arr: np.ndarray) -> dict:
+        arr = np.ascontiguousarray(arr)
+        if not _is_float(arr):
+            f = PassthroughCodec().encode(arr)
+            f["codec"] = self.name
+            f["enc"] = "raw"
+            return f
+        flat = arr.astype(np.float32).ravel()
+        n = flat.size
+        nblocks = max(1, -(-n // self.block))
+        padded = np.zeros(nblocks * self.block, dtype=np.float32)
+        padded[:n] = flat
+        blocks = padded.reshape(nblocks, self.block)
+        scales = np.abs(blocks).max(axis=1) / 127.0
+        safe = np.where(scales == 0.0, 1.0, scales).astype(np.float32)
+        q = np.rint(blocks / safe[:, None]).astype(np.int8)
+        f = _frame_meta(arr)
+        f.update(codec=self.name, enc="int8", block=self.block,
+                 data=q.tobytes()[:n],
+                 scales=scales.astype(np.float32).tobytes())
+        return f
+
+    def decode(self, frame: dict) -> np.ndarray:
+        if frame.get("enc") == "raw":
+            return PassthroughCodec().decode(frame)
+        block = frame["block"]
+        q = np.frombuffer(frame["data"], dtype=np.int8)
+        scales = np.frombuffer(frame["scales"], dtype=np.float32)
+        n = q.size
+        nblocks = scales.size
+        padded = np.zeros(nblocks * block, dtype=np.int8)
+        padded[:n] = q
+        deq = (padded.reshape(nblocks, block).astype(np.float32)
+               * scales[:, None]).ravel()[:n]
+        out_dtype = _wire_dtype(frame["dtype"])
+        if _BF16 is not None and out_dtype == _BF16:
+            deq = deq.astype(_BF16)
+        elif out_dtype.kind == "f":
+            deq = deq.astype(out_dtype)
+        return _restore(deq, frame)
+
+
+_CODECS = {
+    "none": PassthroughCodec,
+    "bf16": Bf16Codec,
+    "int8": BlockInt8Codec,
+}
+
+
+def get_codec(codec: "str | Codec | None") -> Codec:
+    """Resolve a codec name (or pass an instance through); ``None`` reads
+    the ``collective_codec`` config flag."""
+    if isinstance(codec, Codec):
+        return codec
+    name = codec or config.get("collective_codec")
+    try:
+        return _CODECS[name]()
+    except KeyError:
+        raise ValueError(
+            f"unknown collective codec {name!r}; have {sorted(_CODECS)}"
+        ) from None
+
+
+def wire_bytes(frame: dict) -> int:
+    """Payload bytes a frame puts on the wire (data + scales; the few
+    bytes of shape/dtype metadata are noise and excluded so accounting
+    assertions stay exact)."""
+    n = len(frame.get("data", b""))
+    n += len(frame.get("scales", b""))
+    return n
+
+
+def encode_with_ef(codec: Codec, arr: np.ndarray, residual):
+    """Error-feedback encode: fold the previous residual into the tensor,
+    encode, and return ``(frame, new_residual)``.
+
+    For lossless codecs the residual is always None. Residuals live at the
+    caller's granularity (the ring engine keys them per group/tag/step).
+    """
+    if codec.lossless or not _is_float(arr):
+        return codec.encode(arr), None
+    work = np.asarray(arr, dtype=np.float32)
+    if residual is not None and residual.shape == work.shape:
+        work = work + residual
+    frame = codec.encode(work.astype(arr.dtype) if arr.dtype != np.float32
+                         else work)
+    decoded = np.asarray(codec.decode(frame), dtype=np.float32)
+    new_residual = work - decoded
+    return frame, new_residual

@@ -117,7 +117,9 @@ def use_mesh(mesh: Mesh):
     """
     if hasattr(jax, "set_mesh"):
         return jax.set_mesh(mesh)
-    return jax.sharding.use_mesh(mesh)  # pragma: no cover - older jax
+    if hasattr(jax.sharding, "use_mesh"):
+        return jax.sharding.use_mesh(mesh)
+    return mesh  # oldest jax: Mesh is itself the context manager
 
 
 def local_mesh() -> Mesh:

@@ -30,6 +30,10 @@ from ray_tpu.train.trainer import (  # noqa: F401
     ScalingConfig,
 )
 from ray_tpu.train import session  # noqa: F401
+from ray_tpu.train.dcn import (  # noqa: F401
+    dcn_allreduce_grads,
+    init_cross_slice_group,
+)
 from ray_tpu.train.gbdt import (  # noqa: F401,E402
     GBDTPredictor,
     GBDTTrainer,

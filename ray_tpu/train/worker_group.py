@@ -15,13 +15,17 @@ import ray_tpu
 logger = logging.getLogger(__name__)
 
 
+from ray_tpu.collective import CollectiveActorMixin
+
+
 @ray_tpu.remote
-class TrainWorker:
+class TrainWorker(CollectiveActorMixin):
     """Host process for training functions (RayTrainWorker analog).
 
     Generic: `execute` runs any pickled callable in the worker, so backend
     setup (jax.distributed init), the user train loop, and checkpoint ops
-    all ride the same actor."""
+    all ride the same actor. The CollectiveActorMixin hooks let a
+    WorkerGroup host the cross-slice DCN gradient group."""
 
     def __init__(self, worker_idx: int):
         self.worker_idx = worker_idx
@@ -72,8 +76,39 @@ class WorkerGroup:
             ).remote(i)
             for i in range(num_workers)
         ]
+        self._coll_group: str | None = None
         # fail fast if any worker can't start
         ray_tpu.get([w.ping.remote() for w in self.workers], timeout=120)
+
+    def init_collective(self, group_name: str | None = None,
+                        backend: str = "cpu") -> str:
+        """Rendezvous a collective group over the gang (rank == worker
+        index) — the DCN fabric `train.dcn_allreduce_grads` rides for
+        cross-slice gradient sync. Returns the group name."""
+        import uuid
+
+        from ray_tpu.collective import create_collective_group
+
+        name = group_name or f"wg-{uuid.uuid4().hex[:8]}"
+        create_collective_group(
+            self.workers, self.num_workers, list(range(self.num_workers)),
+            backend=backend, group_name=name,
+        )
+        self._coll_group = name
+        return name
+
+    def destroy_collective(self):
+        if not self._coll_group:
+            return
+        try:
+            ray_tpu.get(
+                [w.__ray_tpu_destroy_collective__.remote(self._coll_group)
+                 for w in self.workers],
+                timeout=30,
+            )
+        except Exception:  # noqa: BLE001 — teardown is best-effort
+            pass
+        self._coll_group = None
 
     def execute(self, fn: Callable, *args, timeout: float = 600.0,
                 **kwargs) -> list:
@@ -97,6 +132,7 @@ class WorkerGroup:
         )
 
     def shutdown(self):
+        self.destroy_collective()
         for w in self.workers:
             try:
                 ray_tpu.kill(w)

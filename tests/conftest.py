@@ -24,7 +24,12 @@ import jax  # noqa: E402
 import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax (< 0.5) has no jax_num_cpu_devices; the
+    # xla_force_host_platform_device_count XLA flag above covers it
+    pass
 
 assert jax.default_backend() == "cpu", (
     "jax backend initialized before conftest could force CPU; "

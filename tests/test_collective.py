@@ -32,6 +32,22 @@ class Rank(col.CollectiveActorMixin):
     def allreduce(self, value, op):
         return col.allreduce(np.asarray(value), self.g, op=op)
 
+    def allreduce_via(self, value, op, transport, codec=None):
+        from ray_tpu.collective import ring
+
+        out = col.allreduce(np.asarray(value), self.g, op=op,
+                            transport=transport, codec=codec)
+        st = ring.last_op_stats(self.g)
+        return out, st.transport, st.bytes_sent
+
+    def reducescatter_via(self, value, transport):
+        from ray_tpu.collective import ring
+
+        out = col.reducescatter(np.asarray(value), self.g,
+                                transport=transport)
+        st = ring.last_op_stats(self.g)
+        return out, st.bytes_sent
+
     def broadcast(self, value, src):
         return col.broadcast(np.asarray(value), src_rank=src,
                              group_name=self.g)
@@ -158,6 +174,44 @@ def test_send_recv(group):
 def test_barrier(group):
     outs = _call_all(group, "barrier_then", *[(r,) for r in range(WORLD)])
     assert sorted(outs) == list(range(WORLD))
+
+
+def test_star_vs_ring_parity(group):
+    """The RAY_TPU_COLLECTIVE_TRANSPORT flag must not change results:
+    integer-valued f32 sums are exact on both transports."""
+    vals = [np.arange(24.0, dtype=np.float32).reshape(6, 4) * (r + 1)
+            for r in range(WORLD)]
+    ring_outs = _call_all(group, "allreduce_via",
+                          *[(v, "sum", "ring") for v in vals])
+    star_outs = _call_all(group, "allreduce_via",
+                          *[(v, "sum", "star") for v in vals])
+    expect = np.sum(np.stack(vals), axis=0)
+    for (ro, rt, _), (so, st_, _) in zip(ring_outs, star_outs):
+        assert rt == "ring" and st_ == "star"
+        np.testing.assert_array_equal(ro, expect)
+        np.testing.assert_array_equal(so, expect)
+
+
+def test_ring_reducescatter_wire_bytes_on_fabric(group):
+    """Over the real RPC fabric, ring reduce-scatter must put at most
+    (N-1)/N of the tensor on each rank's wire; the star path re-sends
+    the FULL tensor to every rank (root pays N-1 copies)."""
+    n = 64 * 1024 // 4
+    vals = [np.full(n, float(r), np.float32) for r in range(WORLD)]
+    ring_outs = _call_all(group, "reducescatter_via",
+                          *[(v, "ring") for v in vals])
+    star_outs = _call_all(group, "reducescatter_via",
+                          *[(v, "star") for v in vals])
+    tensor_bytes = vals[0].nbytes
+    expect = np.sum(np.stack(vals), axis=0)
+    shards = np.array_split(expect, WORLD, axis=0)
+    for r, ((out, sent), (sout, _)) in enumerate(zip(ring_outs, star_outs)):
+        np.testing.assert_array_equal(out, shards[r])
+        np.testing.assert_array_equal(sout, shards[r])
+        assert sent <= tensor_bytes * (WORLD - 1) / WORLD + 256
+    # star root pays (N-1) full downlink copies on top of its uplink
+    star_root_sent = star_outs[0][1]
+    assert star_root_sent >= tensor_bytes * (WORLD - 1)
 
 
 # ---------------- mesh_ops parity on the 8-device CPU mesh ----------------

@@ -139,3 +139,53 @@ def test_wait_1k_refs_floor(cluster):
     dt = time.perf_counter() - t0
     assert len(ready) == 1000
     assert dt < 2.0, f"wait on 1k local refs took {dt:.2f}s"
+
+
+def test_collective_family_floors(cluster):
+    """The `collective` runtime_perf family's committed invariants, run
+    small (4 ranks, 1 MB): per-rank wire bytes for ring allreduce are
+    exactly 2·(N−1)/N of the tensor (vs ≥(N−1)·tensor at the star root),
+    ring+int8 moves ≤30% of the f32 ring bytes, and throughput floors
+    ~5-10x under dev-box measurements (RUNTIME_BENCH.json) so only a
+    pathological regression — a per-chunk sync point, a serialization
+    storm — trips them."""
+    import uuid
+
+    from ray_tpu._private.runtime_perf import _CollRank
+
+    world = 4
+    nbytes = 1024 * 1024
+    ranks = [_CollRank.remote() for _ in range(world)]
+    name = f"floor-{uuid.uuid4().hex[:8]}"
+
+    def run(transport, codec, iters=3):
+        outs = ray_tpu.get(
+            [a.allreduce_loop.remote(nbytes, iters, transport, codec)
+             for a in ranks],
+            timeout=300,
+        )
+        per_op = max(dt for dt, _ in outs)
+        return 1.0 / per_op, [b for _, b in outs]
+
+    try:
+        ray_tpu.get([a.init.remote(world, r, name)
+                     for r, a in enumerate(ranks)], timeout=120)
+        star_rate, star_bytes = run("star", None)
+        ring_rate, ring_bytes = run("ring", None)
+        int8_rate, int8_bytes = run("ring", "int8")
+
+        ring_limit = 2 * (world - 1) / world * nbytes
+        for b in ring_bytes:
+            assert b <= ring_limit, f"ring rank moved {b} > {ring_limit}"
+        # star root re-sends the full reduction to every other rank
+        assert max(star_bytes) >= (world - 1) * nbytes
+        for b8, bf in zip(int8_bytes, ring_bytes):
+            assert b8 <= 0.30 * bf, f"int8 wire {b8} > 30% of f32 {bf}"
+        # measured ~30-60/s (ring) and ~25-50/s (star) on the dev box for
+        # 1 MB x 4 ranks in this in-process fixture
+        assert ring_rate > 3, f"ring 1MB allreduce {ring_rate:.1f}/s"
+        assert star_rate > 3, f"star 1MB allreduce {star_rate:.1f}/s"
+        assert int8_rate > 3, f"ring+int8 1MB allreduce {int8_rate:.1f}/s"
+    finally:
+        for a in ranks:
+            ray_tpu.kill(a)
