@@ -308,7 +308,12 @@ def bench_decode_serve(size: str, *, slots: int = 8,
         errors: list[str] = []
 
         def one(i):
-            body = {"prompt_ids": [rnd.randrange(1, 30000)
+            # per-request RNG, seeded by request index: the shared
+            # module-level Random is unlocked (thread-racy draws) and
+            # order-dependent — prompts must be identical run to run for
+            # the benchmark to be comparable
+            r = random.Random(1000 + i)
+            body = {"prompt_ids": [r.randrange(1, 30000)
                                    for _ in range(prompt_len)],
                     "max_tokens": new_tokens}
             try:

@@ -122,6 +122,18 @@ _DEFS: dict[str, Any] = {
     "collective_quant_block": 512,
     # gradient-bucket target size for train.dcn_allreduce_grads
     "collective_bucket_bytes": 4 * 1024 * 1024,
+    # bound on abort detection while blocked in a collective recv: the
+    # mailbox wait re-checks the group's abort flag at least this often
+    # (abort events also wake waiters immediately via the mailbox
+    # condition; this is the belt-and-braces floor)
+    "collective_abort_poll_s": 0.5,
+    # rendezvous deadline for reform_group after a membership change
+    "collective_reform_timeout_s": 120.0,
+    # -- fault injection (chaos tests) --
+    # JSON list of injection specs (see _private/fault_injection.py);
+    # declared here so set_system_config propagates it to spawned
+    # workers via the RAY_TPU_FAULT_SPEC env var
+    "fault_spec": "",
 }
 
 _cache: dict[str, Any] = {}

@@ -1,0 +1,226 @@
+"""Deterministic fault-injection harness for chaos testing.
+
+Real distributed failures (a rank dying mid-allreduce, a dropped frame,
+a stalled heartbeat) are timing-dependent and unreproducible by nature;
+this module turns them into *deterministic, config-keyed* events: every
+instrumented code path calls :func:`fire` with a site name and context,
+and a matching spec performs its action on an exact occurrence count —
+the same spec always trips at the same site, the same call, every run.
+
+Specs are plain dicts (JSON-able so they ride ``RAY_TPU_FAULT_SPEC``
+into spawned workers):
+
+    {"site": "ring.send",            # required: instrumented site name
+     "match": {"rank": 1, "chunk": 0},  # subset-match against fire() ctx
+     "after": 0,                     # skip the first N matching hits
+     "count": 1,                     # then trip on the next N (0 = all)
+     "action": "die",                # see ACTIONS below
+     "delay_s": 0.25,                # for delay/stall
+     "exit_code": 1}                 # for exit
+
+Actions:
+
+- ``die``   — raise :class:`InjectedFault` at the site (an in-process
+  crash the caller's failure handling must absorb).
+- ``exit``  — ``os._exit(exit_code)``: simulates hard process death
+  (no destructors, no goodbye frames) for worker-kill chaos tests.
+- ``drop``  — the site skips the guarded side effect (e.g. a frame is
+  never sent).
+- ``dup``   — the site performs the side effect twice.
+- ``delay`` / ``stall`` — sleep ``delay_s`` at the site, then proceed.
+
+Instrumented sites (grow as needed): ``ring.send`` / ``ring.recv``
+(per-chunk, ctx: group/rank/op/step/chunk), ``collective.send``
+(per-frame, ctx: group/rank/dst/tag), ``agent.heartbeat`` (per beat,
+ctx: node). Sites are zero-overhead when no spec is configured (one
+module-flag check, no lock).
+
+Every tripped spec is appended to an in-process hit log queryable via
+:func:`hits` — chaos tests assert determinism by comparing logs across
+runs — and counted in the ``fault_injections_total`` Prometheus counter
+(tags: site, action).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any
+
+ACTIONS = ("die", "exit", "drop", "dup", "delay", "stall")
+
+_lock = threading.Lock()
+_specs: list[dict] = []
+_armed = False           # fast-path flag: fire() is a no-op when False
+_env_loaded = False
+_hits: list[dict] = []
+_seq = 0
+_metrics = None
+
+
+class InjectedFault(RuntimeError):
+    """Raised by a ``die`` injection at the instrumented site."""
+
+    def __init__(self, site: str, ctx: dict):
+        self.site = site
+        self.ctx = ctx
+        super().__init__(f"injected fault at {site} ({ctx})")
+
+
+def _get_metrics():
+    global _metrics
+    if _metrics is None:
+        from ray_tpu.util import metrics as M
+
+        _metrics = M.Counter(
+            "fault_injections_total",
+            "fault-injection actions performed",
+            tag_keys=("site", "action"),
+        )
+    return _metrics
+
+
+def configure(specs: list[dict] | dict | None) -> None:
+    """Install injection specs for this process (replaces any existing).
+
+    Accepts one spec dict or a list; ``None`` / empty clears. Specs are
+    validated eagerly so a typo'd action fails the configuring test, not
+    the instrumented hot path.
+    """
+    global _armed
+    if specs is None:
+        specs = []
+    if isinstance(specs, dict):
+        specs = [specs]
+    prepared = []
+    for s in specs:
+        if "site" not in s:
+            raise ValueError(f"fault spec missing 'site': {s!r}")
+        action = s.get("action", "die")
+        if action not in ACTIONS:
+            raise ValueError(
+                f"unknown fault action {action!r} (one of {ACTIONS})")
+        prepared.append({
+            "site": s["site"],
+            "match": dict(s.get("match") or {}),
+            "after": int(s.get("after", 0)),
+            "count": int(s.get("count", 1)),
+            "action": action,
+            "delay_s": float(s.get("delay_s", 0.0)),
+            "exit_code": int(s.get("exit_code", 1)),
+            "_seen": 0,  # matching occurrences observed so far
+        })
+    with _lock:
+        _specs[:] = prepared
+        _armed = bool(prepared)
+
+
+def clear() -> None:
+    """Remove all specs and the hit log (test teardown)."""
+    global _armed, _seq
+    with _lock:
+        _specs.clear()
+        _hits.clear()
+        _armed = False
+        _seq = 0
+
+
+def hits() -> list[dict]:
+    """Copies of every action performed, in trip order — chaos tests
+    assert determinism by comparing this log across repeated runs."""
+    with _lock:
+        return [dict(h) for h in _hits]
+
+
+def _load_env_once() -> None:
+    """Adopt RAY_TPU_FAULT_SPEC once per process, so specs set via
+    config propagation reach spawned workers. Accepts JSON or a Python
+    repr: `set_system_config` exports overrides with str(v), which
+    renders lists/dicts with single quotes that json.loads rejects."""
+    global _env_loaded
+    if _env_loaded:
+        return
+    _env_loaded = True
+    raw = os.environ.get("RAY_TPU_FAULT_SPEC", "")
+    if not raw:
+        return
+    specs = None
+    try:
+        specs = json.loads(raw)
+    except (ValueError, TypeError):
+        import ast
+
+        try:
+            specs = ast.literal_eval(raw)
+        except (ValueError, SyntaxError):
+            pass
+    if specs is None:
+        # never take the runtime down — but a chaos run that silently
+        # injects nothing is worse than noisy, so say something
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "RAY_TPU_FAULT_SPEC is neither JSON nor a Python literal; "
+            "ignoring: %r", raw[:200])
+        return
+    try:
+        configure(specs)
+    except (ValueError, TypeError):
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "RAY_TPU_FAULT_SPEC failed validation; ignoring: %r",
+            raw[:200])
+
+
+def enabled() -> bool:
+    _load_env_once()
+    return _armed
+
+
+def fire(site: str, **ctx: Any) -> str | None:
+    """Report reaching an instrumented site.
+
+    Returns the action the site must implement (``drop`` / ``dup``), or
+    ``None`` for proceed-as-normal. ``delay``/``stall`` sleep here;
+    ``die`` raises :class:`InjectedFault`; ``exit`` never returns.
+    """
+    if not enabled():
+        return None
+    fired: dict | None = None
+    with _lock:
+        for s in _specs:
+            if s["site"] != site:
+                continue
+            if any(ctx.get(k) != v for k, v in s["match"].items()):
+                continue
+            n = s["_seen"]
+            s["_seen"] = n + 1
+            if n < s["after"]:
+                continue
+            if s["count"] and n >= s["after"] + s["count"]:
+                continue
+            global _seq
+            _seq += 1
+            fired = {"seq": _seq, "site": site, "action": s["action"],
+                     "occurrence": n, "ctx": dict(ctx),
+                     "delay_s": s["delay_s"], "exit_code": s["exit_code"]}
+            _hits.append(fired)
+            break  # first matching spec wins (deterministic ordering)
+    if fired is None:
+        return None
+    try:
+        _get_metrics().inc(1, {"site": site, "action": fired["action"]})
+    except Exception:  # noqa: BLE001 — accounting never blocks injection
+        pass
+    action = fired["action"]
+    if action in ("delay", "stall"):
+        time.sleep(fired["delay_s"])
+        return None
+    if action == "die":
+        raise InjectedFault(site, fired["ctx"])
+    if action == "exit":
+        os._exit(fired["exit_code"])
+    return action  # "drop" / "dup": the call site implements the effect

@@ -34,6 +34,19 @@ logger = logging.getLogger(__name__)
 REQUEST, RESPONSE, PUSH, ONEWAY = 0, 1, 2, 3
 _LEN = struct.Struct("<I")
 MAX_FRAME = 1 << 31
+# fire() outboxes stop writing to the transport past this much buffered
+# data and fall back to an awaited drain (sync and async clients share
+# the cap). This bounds the WRITE RATE into a wedged peer's transport —
+# one queued backlog per drain window — not the buffer's absolute size:
+# frames are never dropped (a lost collective chunk would wedge its
+# whole group), so a peer that stays wedged grows by at most one
+# producer-window of frames per FIRE_DRAIN_TIMEOUT_S until the
+# producer's own op timeout stops it.
+FIRE_BUFFER_BACKSTOP = 32 * 1024 * 1024
+# how long the async backstop waits for the buffer to recede before
+# writing the queued fires through anyway (mirrors SyncRpcClient.fire's
+# ~5s bounded producer-side wait)
+FIRE_DRAIN_TIMEOUT_S = 5.0
 
 
 def pack(obj: Any) -> bytes:
@@ -232,9 +245,15 @@ class AsyncRpcClient:
         self._push_handlers: dict[str, Callable[[Any], None]] = {}
         self._read_task: asyncio.Task | None = None
         self.closed = False
+        # invoked (io thread, read-loop teardown) when the connection
+        # dies; the collective abort path keys off this
+        self.on_close: Callable[[], None] | None = None
         # coalesced fire() outbox: packed frames flushed in one
         # writer.write per loop tick
         self._fire_out: list[bytes] = []
+        # awaited-drain task active while the transport buffer is past
+        # FIRE_BUFFER_BACKSTOP; flushes pause until it completes
+        self._fire_drain_task: asyncio.Task | None = None
 
     async def connect(self, retries: int = 30, delay: float = 0.1):
         last = None
@@ -287,6 +306,11 @@ class AsyncRpcClient:
                 if not fut.done():
                     fut.set_exception(err)
             self._pending.clear()
+            if self.on_close is not None:
+                try:
+                    self.on_close()
+                except Exception:
+                    logger.exception("on_close callback failed")
 
     async def call(self, method: str, payload: Any = None, timeout=None) -> Any:
         if self.closed:
@@ -320,18 +344,59 @@ class AsyncRpcClient:
         if len(body) > MAX_FRAME:
             raise RpcError(f"frame of {len(body)} bytes exceeds limit")
         self._fire_out.append(_LEN.pack(len(body)) + body)
-        if len(self._fire_out) == 1:
+        if len(self._fire_out) == 1 and self._fire_drain_task is None:
             asyncio.get_running_loop().call_soon(self._flush_fires)
 
+    def _write_buffer_size(self) -> int:
+        try:
+            w = self._writer
+            return w.transport.get_write_buffer_size() if w else 0
+        except Exception:  # noqa: BLE001 — transport mid-close
+            return 0
+
     def _flush_fires(self):
+        if self._fire_drain_task is not None:
+            return  # drain in progress; it re-flushes on completion
         chunks = self._fire_out
         self._fire_out = []
         try:
             if not chunks or self.closed or self._writer is None:
                 return
             self._writer.write(b"".join(chunks))
+            if self._write_buffer_size() > FIRE_BUFFER_BACKSTOP:
+                # backstop (mirrors SyncRpcClient.fire's producer-side
+                # block): stop writing to the transport and await a
+                # drain — later fires queue in _fire_out until the
+                # buffer recedes, so a wedged peer can't grow the
+                # transport buffer without bound
+                self._fire_drain_task = asyncio.ensure_future(
+                    self._drain_fire_backlog())
         except (ConnectionError, RuntimeError, OSError):
             pass  # read-loop disconnect machinery owns this failure
+
+    async def _drain_fire_backlog(self):
+        try:
+            await asyncio.wait_for(self._writer.drain(),
+                                   timeout=FIRE_DRAIN_TIMEOUT_S)
+        except asyncio.TimeoutError:
+            # Bounded WAIT, not a bounded peer: mirror SyncRpcClient.fire,
+            # which also gives up pacing after ~5s but still writes —
+            # frames must not be silently dropped (a collective chunk to a
+            # slow-but-alive peer would wedge the whole group until the op
+            # timeout). The backlog flushes below; if the buffer is still
+            # over the backstop, the next flush re-arms another drain, so
+            # a wedged peer costs one backlog write per 5s window.
+            logger.warning(
+                "peer %s:%s transport buffer stuck above %d bytes for "
+                "%.0fs; writing %d queued fire frames through anyway",
+                self.host, self.port, FIRE_BUFFER_BACKSTOP,
+                FIRE_DRAIN_TIMEOUT_S, len(self._fire_out))
+        except (ConnectionError, RuntimeError, OSError):
+            pass
+        finally:
+            self._fire_drain_task = None
+            if self._fire_out:
+                self._flush_fires()
 
     async def close(self):
         self.closed = True
@@ -472,7 +537,7 @@ class SyncRpcClient:
         # the buffer recedes; give up after ~5s (peer is wedged — the
         # disconnect machinery owns that failure).
         waited = 0.0
-        while self._write_buffer_size() > 32 * 1024 * 1024 and waited < 5.0:
+        while self._write_buffer_size() > FIRE_BUFFER_BACKSTOP and waited < 5.0:
             time.sleep(0.005)
             waited += 0.005
         with self._fire_lock:
@@ -486,11 +551,7 @@ class SyncRpcClient:
             pass
 
     def _write_buffer_size(self) -> int:
-        try:
-            w = self.client._writer
-            return w.transport.get_write_buffer_size() if w else 0
-        except Exception:  # noqa: BLE001 — transport mid-close
-            return 0
+        return self.client._write_buffer_size()
 
     def _drain_one(self, method, payload):  # io thread only
         # delegate to the async client's coalescer (one writer.write per

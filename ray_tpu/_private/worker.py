@@ -203,6 +203,13 @@ class CoreWorker:
         # Head restart (GCS FT): the SyncRpcClient reconnects transparently;
         # we must re-register and re-subscribe on the fresh connection.
         self.head.on_reconnect = self._resync_head
+        # Failure-event listeners (the collective layer registers here):
+        # peer-lost fires when a cached peer RPC connection closes
+        # (fastest signal that a peer process died); node-dead fans the
+        # control plane's heartbeat-timeout events out beyond task
+        # routing. Callbacks run on the io thread and must not block.
+        self._peer_lost_listeners: list = []
+        self._node_dead_listeners: list = []
         # Reference counting (reference_count.h:61 semantics, centralized):
         # per-oid local count; 0<->1 transitions reported to the directory,
         # which frees cluster copies when no process holds a reference.
@@ -453,9 +460,42 @@ class CoreWorker:
             ).start()
         return True
 
+    def add_peer_lost_listener(self, fn) -> None:
+        """fn((addr, port)) runs on the io thread when a cached peer RPC
+        connection closes; must not block (spawn a thread for real work)."""
+        if fn not in self._peer_lost_listeners:
+            self._peer_lost_listeners.append(fn)
+
+    def add_node_dead_listener(self, fn) -> None:
+        """fn(payload) runs on the io thread for every node_dead event."""
+        if fn not in self._node_dead_listeners:
+            self._node_dead_listeners.append(fn)
+
+    def _notify_peer_lost(self, key: tuple) -> None:
+        # evict the dead client FIRST: a reformed collective group (or
+        # any later caller) must redial rather than receive the cached
+        # closed client — keeping it would re-abort every fresh
+        # incarnation that reuses the same (addr, port)
+        stale = self._peer_clients.pop(key, None)
+        if stale is not None:
+            try:
+                stale.close()
+            except Exception:  # noqa: BLE001 — already dead
+                pass
+        for fn in list(self._peer_lost_listeners):
+            try:
+                fn(key)
+            except Exception:  # noqa: BLE001 — listeners are best-effort
+                logger.exception("peer-lost listener failed")
+
     def _on_node_dead(self, payload: dict):
         dead = payload.get("node_id")
         self._dead_nodes.add(dead)
+        for fn in list(self._node_dead_listeners):
+            try:
+                fn(payload)
+            except Exception:  # noqa: BLE001
+                logger.exception("node-dead listener failed")
         if len(self._dead_nodes) > 1000:
             self._dead_nodes.pop()
         stranded = [tid for tid, nid in self._task_nodes.items()
@@ -853,6 +893,10 @@ class CoreWorker:
             cli = rpc.SyncRpcClient(owner["addr"], owner["port"], self.io)
         except rpc.ConnectionLost:
             return None
+        # connection loss to a peer is the fastest death signal the
+        # collective abort path has; notify listeners from the read
+        # loop's teardown (io thread — listeners must not block)
+        cli.client.on_close = lambda k=key: self._notify_peer_lost(k)
         self._peer_clients[key] = cli
         return cli
 

@@ -28,6 +28,15 @@ import time
 
 logger = logging.getLogger(__name__)
 
+# Exit-code contract (MonitorProcess keys restarts off these):
+#   0 — intentional shutdown; never restarted
+#   2 — broken provider wiring (bad spec/import/construction): restarting
+#       would loop the same failure; never restarted
+#   3 — head unreachable: almost always TRANSIENT (head restart, network
+#       blip), so the supervisor restarts with backoff — a temporary
+#       outage must not permanently disable autoscaling
+RC_OK, RC_WIRING, RC_HEAD_UNREACHABLE = 0, 2, 3
+
 
 def _build_provider(spec: str, head_addr: str):
     """provider spec forms:
@@ -54,8 +63,9 @@ def _build_provider(spec: str, head_addr: str):
 
 def run_monitor(head_addr: str, provider_spec: str,
                 config: dict | None = None) -> int:
-    """Process entrypoint: connect to the head, reconcile until the
-    head goes away (exit 0) or the provider wiring is broken (exit 2)."""
+    """Process entrypoint: connect to the head, reconcile until the head
+    goes away (exit RC_HEAD_UNREACHABLE — restartable) or the provider
+    wiring is broken (exit RC_WIRING — terminal)."""
     from ray_tpu._private import rpc
     from ray_tpu._private.rpc import EventLoopThread, SyncRpcClient
     from ray_tpu.autoscaler import Autoscaler, AutoscalerConfig
@@ -66,13 +76,13 @@ def run_monitor(head_addr: str, provider_spec: str,
         head = SyncRpcClient(host, int(port), io, reconnect=True)
     except rpc.ConnectionLost:
         logger.error("monitor: cannot reach head at %s", head_addr)
-        return 2
+        return RC_HEAD_UNREACHABLE
     try:
         provider = _build_provider(provider_spec, head_addr)
     except Exception:
         logger.exception("monitor: provider %r failed to construct",
                          provider_spec)
-        return 2
+        return RC_WIRING
     cfg = AutoscalerConfig(**(config or {}))
     scaler = Autoscaler(head, provider, cfg)
     logger.info("monitor up: head=%s provider=%s", head_addr,
@@ -83,12 +93,14 @@ def run_monitor(head_addr: str, provider_spec: str,
             scaler.update()
             misses = 0
         except (rpc.ConnectionLost, rpc.RpcError):
-            # head restarting: SyncRpcClient reconnects; a DEAD head
-            # ends the monitor (the supervisor died with it)
+            # head restarting: SyncRpcClient reconnects per call; after
+            # a sustained outage exit with the RESTARTABLE code — the
+            # supervisor's backoff keeps trying, since the head may be
+            # back minutes later and autoscaling must come back with it
             misses += 1
             if misses > 30:
                 logger.warning("monitor: head unreachable, exiting")
-                return 0
+                return RC_HEAD_UNREACHABLE
         except Exception:  # noqa: BLE001 — keep reconciling
             logger.exception("monitor: reconcile error")
         time.sleep(cfg.poll_interval_s)
@@ -122,22 +134,39 @@ class MonitorProcess:
         self.proc = self._spawn()
 
         def _supervise():
+            backoff = self.RESTART_BACKOFF_S
+            spawned_at = time.monotonic()
             while not self._stop.is_set():
                 p = self.proc
                 if p is not None and p.poll() is not None:
-                    if p.returncode in (0, 2):
-                        # clean exit / broken wiring: restarting would
-                        # loop the same failure
+                    if p.returncode in (RC_OK, RC_WIRING):
+                        # intentional shutdown / broken wiring:
+                        # restarting would loop the same failure
                         logger.warning(
                             "monitor exited rc=%d; not restarting",
                             p.returncode)
                         return
+                    # crashes AND rc=RC_HEAD_UNREACHABLE restart: a
+                    # transient head outage must not permanently disable
+                    # autoscaling. The first restart of a FRESH outage
+                    # waits the base backoff; consecutive fast
+                    # head-unreachable exits escalate (capped); a run
+                    # that stayed healthy >=60s resets the ladder so an
+                    # old outage can't tax a new blip.
+                    healthy_run = time.monotonic() - spawned_at >= 60.0
+                    if p.returncode != RC_HEAD_UNREACHABLE or healthy_run:
+                        backoff = self.RESTART_BACKOFF_S
+                    wait_s = backoff
+                    if p.returncode == RC_HEAD_UNREACHABLE:
+                        backoff = min(backoff * 2, 60.0)
                     logger.warning(
-                        "monitor died rc=%d; restarting", p.returncode)
+                        "monitor died rc=%d; restarting in %.1fs",
+                        p.returncode, wait_s)
                     self.restarts += 1
-                    time.sleep(self.RESTART_BACKOFF_S)
-                    if not self._stop.is_set():
-                        self.proc = self._spawn()
+                    if self._stop.wait(wait_s):
+                        return
+                    self.proc = self._spawn()
+                    spawned_at = time.monotonic()
                 self._stop.wait(1.0)
 
         self._sup = threading.Thread(target=_supervise, daemon=True,

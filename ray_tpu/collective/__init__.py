@@ -21,6 +21,7 @@ from ray_tpu.collective.collective import (  # noqa: F401
     allreduce,
     barrier,
     broadcast,
+    CollectiveAbortError,
     CollectiveActorMixin,
     create_collective_group,
     destroy_collective_group,
@@ -30,6 +31,7 @@ from ray_tpu.collective.collective import (  # noqa: F401
     recv,
     reduce,
     reducescatter,
+    reform_group,
     send,
 )
 from ray_tpu.collective.compression import (  # noqa: F401

@@ -22,15 +22,51 @@ the way in and back on the way out, like the reference's gloo path).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Any
 
 import numpy as np
 
-from ray_tpu._private import config, serialization
+from ray_tpu._private import config, fault_injection, serialization
+
+logger = logging.getLogger(__name__)
 
 KV_NS = "collective"
+
+
+class CollectiveAbortError(RuntimeError):
+    """A collective op was aborted because the group lost a member.
+
+    Raised by every surviving rank blocked in (or entering) a collective
+    once a member's death is detected — via the control plane's
+    node-death events, a dropped peer connection, or an explicit abort
+    frame circulated around the ring — instead of blocking out the full
+    ``RAY_TPU_COLLECTIVE_TIMEOUT_S``. Names the group incarnation so
+    callers can checkpoint-restore, :func:`reform_group`, and resume.
+    """
+
+    def __init__(self, group: str, rank: int, epoch: int, op: str | None,
+                 reason: str, origin_rank: int | None = None):
+        self.group = group
+        self.rank = rank
+        self.epoch = epoch
+        self.op = op
+        self.reason = reason
+        self.origin_rank = origin_rank
+        origin = "" if origin_rank is None else f" (from rank {origin_rank})"
+        super().__init__(
+            f"collective group '{group}' rank {rank} epoch {epoch}: "
+            f"op '{op or '?'}' aborted{origin}: {reason}"
+        )
+
+
+class _Aborted(Exception):
+    """Internal mailbox-wakeup signal; surfaces as CollectiveAbortError."""
+
+    def __init__(self, info: dict):
+        self.info = info
 
 
 def _default_timeout() -> float:
@@ -60,14 +96,23 @@ class _Mailbox:
             self.msgs[key] = value
             self.cond.notify_all()
 
-    def take(self, key: tuple, timeout: float = 120.0):
+    def take(self, key: tuple, timeout: float = 120.0, abort=None):
+        """Wait for a frame. ``abort`` is an optional callable returning
+        the owning group's abort record; checked on every wake (aborts
+        notify this condition, so detection is immediate — the poll
+        floor `collective_abort_poll_s` is the belt-and-braces bound)."""
+        poll = float(config.get("collective_abort_poll_s"))
         deadline = time.monotonic() + timeout
         with self.cond:
             while key not in self.msgs:
+                if abort is not None:
+                    info = abort()
+                    if info is not None:
+                        raise _Aborted(info)
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     raise TimeoutError(f"collective wait timed out on {key}")
-                self.cond.wait(timeout=min(remaining, 1.0))
+                self.cond.wait(timeout=min(remaining, poll))
             return self.msgs.pop(key)
 
 
@@ -89,10 +134,49 @@ class Group:
         self.p2p_send: dict[int, int] = {}  # dst → count (independent pairs)
         self.p2p_recv: dict[int, int] = {}  # src → count
         self.peers: dict[int, dict] = {}  # rank → owner addr dict
+        self.peer_nodes: dict[int, bytes] = {}  # rank → node id (if known)
+        # sticky abort record for THIS incarnation ({reason, origin, op});
+        # once set, every op on the group raises CollectiveAbortError
+        # until reform_group() builds a fresh incarnation
+        self._abort: dict | None = None
 
     def _next_seq(self) -> int:
         self.seq += 1
         return self.seq
+
+    # ---- abort state ----
+
+    def _poll_abort(self, op: str | None = None) -> None:
+        """Raise if this incarnation has been aborted (ring engine calls
+        this between chunks; recvs check it inside the mailbox wait)."""
+        a = self._abort
+        if a is not None:
+            raise CollectiveAbortError(
+                self.name, self.rank, self.epoch, op or a.get("op"),
+                a["reason"], origin_rank=a.get("origin"))
+
+    def local_abort(self, reason: str, *, origin: int | None = None,
+                    op: str | None = None) -> bool:
+        """Mark this rank's incarnation aborted and wake every thread
+        blocked in one of its recvs. Returns True on the first call
+        (False if already aborted — abort is sticky per incarnation)."""
+        if self._abort is not None:
+            return False
+        self._abort = {"reason": reason, "origin": origin, "op": op}
+        box = _box
+        if box is not None:
+            with box.cond:
+                box.cond.notify_all()
+        _record_abort(self, reason, origin)
+        return True
+
+    def abort(self, reason: str, *, op: str | None = None) -> None:
+        """Abort locally AND circulate an abort frame to every reachable
+        peer, so survivors that cannot observe the failure directly
+        (e.g. the dead rank's downstream ring neighbors) wake within the
+        abort-detection interval instead of timing out."""
+        if self.local_abort(reason, origin=self.rank, op=op):
+            _broadcast_abort(self, reason, op)
 
     def _send_to(self, dst_rank: int, seq: int, tag: str, array):
         self._send_obj(dst_rank, seq, tag, np.asarray(array))
@@ -103,14 +187,22 @@ class Group:
         uses the buffered fire-and-forget path (the ring engine's chunk
         pipelining: sends drain on the io thread while this thread
         decodes/reduces); delivery failures surface as the receiver's
-        timeout, which names this op."""
+        timeout or, for a dead peer, as a CollectiveAbortError that is
+        also circulated to the rest of the group."""
+        self._poll_abort(op=tag)
+        if fault_injection.enabled():
+            act = fault_injection.fire(
+                "collective.send", group=self.name, rank=self.rank,
+                dst=dst_rank, tag=tag)
+            if act == "drop":
+                return
         peer = self.peers[dst_rank]
         cli = self.worker._peer(peer)
-        if cli is None:
-            raise ConnectionError(
-                f"collective '{self.name}' rank {self.rank}: cannot reach "
-                f"rank {dst_rank}"
-            )
+        if cli is None or getattr(cli.client, "closed", False):
+            # the peer's process is gone: abort the group (and tell the
+            # others) instead of letting everyone ride out the timeout
+            self.abort(f"cannot reach rank {dst_rank}", op=tag)
+            self._poll_abort(op=tag)
         msg = {
             "group": self.name, "inc": self.epoch, "seq": seq,
             "src": self.rank, "tag": tag,
@@ -132,7 +224,12 @@ class Group:
         box = _mailbox()
         try:
             msg = box.take((self.name, self.epoch, seq, src_rank, tag),
-                           timeout)
+                           timeout, abort=lambda: self._abort)
+        except _Aborted as a:
+            raise CollectiveAbortError(
+                self.name, self.rank, self.epoch, op or tag,
+                a.info["reason"], origin_rank=a.info.get("origin")
+            ) from None
         except TimeoutError:
             raise TimeoutError(
                 f"collective group '{self.name}' rank {self.rank}: "
@@ -172,9 +269,255 @@ async def _rpc_coll_msg(conn, p):
     return True
 
 
+# ---------------------------------------------------------------------------
+# abort propagation (node-death events, peer-connection loss, abort frames)
+# ---------------------------------------------------------------------------
+
+_seen_aborts: set[str] = set()  # abort-frame ids already applied/forwarded
+_abort_metrics = None
+
+
+def _get_abort_metrics():
+    global _abort_metrics
+    if _abort_metrics is None:
+        from ray_tpu.util import metrics as M
+
+        _abort_metrics = {
+            "aborts": M.Counter(
+                "collective_aborts_total",
+                "collective group incarnations aborted on this rank",
+                tag_keys=("group",),
+            ),
+            "reforms": M.Counter(
+                "collective_group_reforms_total",
+                "collective group reforms completed on this rank",
+                tag_keys=("group",),
+            ),
+        }
+    return _abort_metrics
+
+
+def _record_abort(g: "Group", reason: str, origin: int | None) -> None:
+    """Abort accounting: Prometheus counter + a control-plane event so
+    cluster-wide `list events` shows who aborted what and why."""
+    logger.warning("collective group '%s' rank %d epoch %d aborted: %s",
+                   g.name, g.rank, g.epoch, reason)
+    try:
+        _get_abort_metrics()["aborts"].inc(1, {"group": g.name})
+    except Exception:  # noqa: BLE001 — accounting must never fail an abort
+        pass
+    try:
+        g.worker.head.fire("record_event", {
+            "kind": "COLLECTIVE_ABORT",
+            "message": f"group '{g.name}' rank {g.rank} epoch {g.epoch} "
+                       f"aborted: {reason}",
+            "group": g.name, "rank": g.rank, "epoch": g.epoch,
+        })
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def _broadcast_abort(g: "Group", reason: str, op: str | None) -> None:
+    """Fan the abort frame out to every reachable peer off-thread (peer
+    connects must not run on the io loop, and abort paths are called
+    from push handlers there)."""
+    frame = {
+        "group": g.name, "epoch": g.epoch, "origin": g.rank,
+        "reason": reason, "op": op,
+        "abort_id": f"{g.name}:{g.epoch}:{g.rank}",
+    }
+    _seen_aborts.add(frame["abort_id"])
+
+    def _fan_out():
+        for r, owner in list(g.peers.items()):
+            if r == g.rank:
+                continue
+            try:
+                cli = g.worker._peer(owner)
+                if cli is not None and not getattr(cli.client, "closed",
+                                                   False):
+                    cli.fire("coll_abort", frame)
+            except Exception:  # noqa: BLE001 — best-effort per peer
+                pass
+
+    threading.Thread(target=_fan_out, daemon=True,
+                     name="coll-abort-fanout").start()
+
+
+async def _rpc_coll_abort(conn, p):
+    """An abort frame from a peer: mark the group and ring it onward.
+
+    Forwarding once to the right neighbor makes the frame circulate the
+    full ring even when the origin could not reach every survivor
+    directly; the abort_id dedup set terminates the circulation."""
+    g = _groups.get(p["group"])
+    if g is None or g.epoch != p.get("epoch"):
+        # NOT marked seen: this rank may still be mid-reform at the
+        # frame's epoch — a later (re)delivery must be able to land once
+        # the group exists, or the rank blocks out the full op timeout
+        return True
+    aid = p.get("abort_id", "")
+    if aid in _seen_aborts:
+        return True
+    _seen_aborts.add(aid)
+    if len(_seen_aborts) > 10_000:
+        _seen_aborts.clear()
+        _seen_aborts.add(aid)
+    if g.local_abort(p.get("reason", "peer abort"), origin=p.get("origin"),
+                     op=p.get("op")):
+
+        def _forward():
+            right = (g.rank + 1) % g.world_size
+            if right == p.get("origin"):
+                return
+            owner = g.peers.get(right)
+            if owner is None:
+                return
+            try:
+                cli = g.worker._peer(owner)
+                if cli is not None and not getattr(cli.client, "closed",
+                                                   False):
+                    cli.fire("coll_abort", p)
+            except Exception:  # noqa: BLE001
+                pass
+
+        threading.Thread(target=_forward, daemon=True,
+                         name="coll-abort-forward").start()
+    return True
+
+
+def _on_peer_lost(key: tuple) -> None:
+    """Worker-level hook: a cached peer RPC connection closed. Abort any
+    group whose member lives behind that (addr, port) — connection loss
+    is the fastest death signal for a peer this rank talks to."""
+    for g in list(_groups.values()):
+        if g._abort is not None:
+            continue
+        for r, owner in g.peers.items():
+            if r != g.rank and (owner.get("addr"), owner.get("port")) == key:
+                g.abort(f"lost connection to rank {r}")
+                break
+
+
+def _on_node_dead(payload) -> None:
+    """Worker-level hook for control-plane node-death events: abort any
+    group with a member on the dead node. Detection latency is bounded
+    by the heartbeat timeout (~2 intervals), even for ranks that never
+    opened a connection to the dead peer."""
+    node_id = payload.get("node_id") if isinstance(payload, dict) \
+        else payload
+    if not node_id:
+        return
+    for g in list(_groups.values()):
+        if g._abort is not None:
+            continue
+        for r, nid in g.peer_nodes.items():
+            if r != g.rank and nid == node_id:
+                g.abort(f"rank {r} node {node_id.hex()[:8]} died")
+                break
+
+
 def _install_route(worker):
     if "coll_msg" not in worker.server.handlers:
         worker.server.handlers["coll_msg"] = _rpc_coll_msg
+        worker.server.handlers["coll_abort"] = _rpc_coll_abort
+        worker.add_peer_lost_listener(_on_peer_lost)
+        worker.add_node_dead_listener(_on_node_dead)
+
+
+def _probe_addr(owner: dict, timeout: float = 0.75) -> bool:
+    """Cheap liveness probe: does the peer's RPC port accept a TCP
+    connection RIGHT NOW? Used to reject stale rendezvous entries left
+    by crashed members (they died without kv_del)."""
+    import socket
+
+    try:
+        s = socket.create_connection(
+            (owner.get("addr"), owner.get("port")), timeout=timeout)
+        s.close()
+        return True
+    except OSError:
+        return False
+
+
+class _EpochMoved(Exception):
+    """The group generation advanced mid-rendezvous (a survivor bumped
+    the epoch channel after we read a stale value): restart under it."""
+
+    def __init__(self, epoch: int):
+        self.epoch = epoch
+
+
+def _epoch_key(group_name: str) -> bytes:
+    return f"{group_name}/epoch".encode()
+
+
+def _publish_epoch(w, group_name: str, epoch: int) -> None:
+    import msgpack
+
+    try:
+        w.head.call("kv_put", {
+            "ns": KV_NS, "key": _epoch_key(group_name),
+            "value": msgpack.packb(epoch),
+        })
+    except Exception:  # noqa: BLE001 — the channel is advisory for init
+        pass
+
+
+def _read_epoch(w, group_name: str) -> int | None:
+    import msgpack
+
+    raw = w.head.call("kv_get", {
+        "ns": KV_NS, "key": _epoch_key(group_name),
+    })
+    return None if raw is None else msgpack.unpackb(raw)
+
+
+def _poll_peers(w, group: Group, key_prefix: str, incs: dict,
+                deadline: float, watch=None) -> None:
+    """Poll the KV namespace until every rank's entry is adopted.
+
+    An entry is adopted only if its address passes a liveness probe: a
+    crashed member's stale key must not hand a survivor a dead address
+    during re-rendezvous — the respawned member overwrites the key and
+    the next poll round adopts the fresh entry. ``watch`` (reform path)
+    re-reads the epoch channel each round and raises _EpochMoved when a
+    survivor bumped past the generation we rendezvoused under."""
+    import msgpack
+
+    bad: dict[tuple, float] = {}  # addr -> last failed-probe timestamp
+    while len(group.peers) < group.world_size:
+        if watch is not None:
+            moved = watch()
+            if moved is not None:
+                raise _EpochMoved(moved)
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"collective rendezvous '{key_prefix}': "
+                f"{len(group.peers)}/{group.world_size} ranks adopted "
+                f"before the deadline"
+            )
+        for r in range(group.world_size):
+            if r in group.peers:
+                continue
+            raw = w.head.call("kv_get", {
+                "ns": KV_NS, "key": f"{key_prefix}/{r}".encode(),
+            })
+            if raw is None:
+                continue
+            entry = msgpack.unpackb(raw)
+            owner = entry["owner"]
+            akey = (owner.get("addr"), owner.get("port"))
+            if time.monotonic() - bad.get(akey, -10.0) < 1.0:
+                continue  # recently failed probe; await overwrite
+            if not _probe_addr(owner):
+                bad[akey] = time.monotonic()
+                continue
+            group.peers[r] = owner
+            group.peer_nodes[r] = entry.get("node", b"")
+            incs[r] = entry.get("inc", 1)
+        if len(group.peers) < group.world_size:
+            time.sleep(0.05)
 
 
 def init_collective_group(world_size: int, rank: int,
@@ -189,40 +532,172 @@ def init_collective_group(world_size: int, rank: int,
 
     w = _get_worker()
     _install_route(w)
+    if group_name in _groups:
+        # re-init under a live name: tear the old incarnation down first
+        # (purges its mailbox frames, EF residuals, and ingress floor)
+        destroy_collective_group(group_name)
     me = w.owner_address
     my_inc = _inc_counts.get(group_name, 0) + 1
     w.head.call("kv_put", {
         "ns": KV_NS,
         "key": f"{group_name}/{rank}".encode(),
-        "value": msgpack.packb({"owner": me, "inc": my_inc}),
+        "value": msgpack.packb({"owner": me, "inc": my_inc,
+                                "node": w.node_id}),
     })
     group = Group(group_name, world_size, rank, w)
+    group.peers[rank] = me
+    group.peer_nodes[rank] = w.node_id
     incs = {rank: my_inc}
-    deadline = time.monotonic() + timeout
-    while len(group.peers) < world_size:
-        if time.monotonic() > deadline:
-            raise TimeoutError(
-                f"collective rendezvous: {len(group.peers)}/{world_size} "
-                f"ranks after {timeout}s"
-            )
-        for r in range(world_size):
-            if r in group.peers:
-                continue
-            raw = w.head.call("kv_get", {
-                "ns": KV_NS, "key": f"{group_name}/{r}".encode(),
-            })
-            if raw is not None:
-                entry = msgpack.unpackb(raw)
-                group.peers[r] = entry["owner"]
-                incs[r] = entry["inc"]
-        if len(group.peers) < world_size:
-            time.sleep(0.05)
+    _poll_peers(w, group, group_name, incs,
+                time.monotonic() + timeout)
     # every rank sees the same published set, so max() agrees group-wide
     group.epoch = max(incs.values())
     _inc_counts[group_name] = group.epoch
     _min_epochs[group_name] = max(_min_epochs.get(group_name, 0),
                                   group.epoch)
     _groups[group_name] = group
+    # publish the agreed generation so a later reform_group can bump it
+    # (all ranks write the same value; last-write-wins is benign)
+    _publish_epoch(w, group_name, group.epoch)
+    return group
+
+
+def reform_group(world_size: int, rank: int, group_name: str = "default",
+                 *, epoch: int | None = None,
+                 timeout: float | None = None) -> Group:
+    """Rebuild a group over survivors (and/or respawned members) under a
+    bumped epoch after a membership change.
+
+    The fresh incarnation rendezvouses under epoch-NAMESPACED KV keys
+    (``{group}@{epoch}/{rank}``), so stale entries from any older
+    incarnation — including a crashed member's init-time key — are
+    invisible by construction, and every frame of the new incarnation
+    carries the bumped epoch, so in-flight chunks from the old one are
+    provably rejected at mailbox ingress (inc below the floor).
+
+    Epoch agreement: a caller holding the old group (a survivor) bumps
+    ``old.epoch + 1`` and publishes it on the group's epoch channel; a
+    caller with no local group (a respawned process) adopts the channel
+    value, migrating mid-rendezvous if a survivor bumps past a stale
+    read. Drivers coordinating the reform (``WorkerGroup
+    .reform_collective``) may pass ``epoch`` explicitly instead. If no
+    generation was ever published (a fully fresh world), this falls back
+    to a plain :func:`init_collective_group`.
+
+    Error-feedback residuals of the old incarnation are DROPPED, not
+    rescaled: membership change alters the ring's segment geometry, so a
+    stale residual would fold into the wrong elements — dropping loses
+    at most one step's quantization correction, which EF re-accumulates.
+    """
+    from ray_tpu._private.api import _get_worker
+
+    import msgpack
+
+    w = _get_worker()
+    _install_route(w)
+    if timeout is None:
+        timeout = float(config.get("collective_reform_timeout_s"))
+    deadline = time.monotonic() + timeout
+    old = _groups.get(group_name)
+    old_epoch = old.epoch if old is not None else None
+    if epoch is not None and old_epoch is not None and epoch <= old_epoch:
+        # a reform MUST bump past the live incarnation: rendezvousing at
+        # (or below) the old epoch would put every frame of the new
+        # group under the ingress floor destroy() is about to raise —
+        # a silent group-wide hang. Fail loudly instead (the usual cause
+        # is a lost epoch-channel write at init).
+        raise ValueError(
+            f"reform_group('{group_name}'): epoch {epoch} does not bump "
+            f"past the live incarnation's epoch {old_epoch}")
+    if old is not None:
+        # local teardown: purge mailbox frames + EF residuals, raise the
+        # ingress floor so the old incarnation's stragglers are dropped
+        destroy_collective_group(group_name)
+    follow_channel = False
+    if epoch is None:
+        if old_epoch is not None:
+            epoch = old_epoch + 1
+            # survivors all write the same E+1: benign last-write-wins
+            _publish_epoch(w, group_name, epoch)
+        else:
+            follow_channel = True
+            # budget split: wait at most half the deadline for a
+            # survivor's bump, reserving the rest for the fresh-world
+            # fallback rendezvous — the total stays within `timeout`
+            # (a driver's reform_collective wait must not be outlived)
+            channel_deadline = time.monotonic() + timeout / 2
+            while True:
+                cur = _read_epoch(w, group_name)
+                if cur is not None:
+                    epoch = cur
+                    break
+                if time.monotonic() > channel_deadline:
+                    # nothing ever published a generation: whole-world
+                    # fresh start — plain init is safe (no older
+                    # incarnation can have frames or live KV entries)
+                    return init_collective_group(
+                        world_size, rank, group_name=group_name,
+                        timeout=max(1.0, deadline - time.monotonic()))
+                time.sleep(0.05)
+
+    while True:
+        prefix = f"{group_name}@{epoch}"
+        w.head.call("kv_put", {
+            "ns": KV_NS, "key": f"{prefix}/{rank}".encode(),
+            "value": msgpack.packb({"owner": w.owner_address,
+                                    "inc": epoch, "node": w.node_id}),
+        })
+        group = Group(group_name, world_size, rank, w, epoch=epoch)
+        group.peers[rank] = w.owner_address
+        group.peer_nodes[rank] = w.node_id
+        incs = {rank: epoch}
+
+        def _watch(cur_epoch=epoch):
+            if not follow_channel:
+                return None
+            cur = _read_epoch(w, group_name)
+            return cur if (cur is not None and cur > cur_epoch) else None
+
+        try:
+            _poll_peers(w, group, prefix, incs, deadline, watch=_watch)
+            break
+        except _EpochMoved as m:
+            # we adopted a stale channel value before a survivor bumped;
+            # drop our entry and re-rendezvous under the new generation
+            try:
+                w.head.call("kv_del", {
+                    "ns": KV_NS, "key": f"{prefix}/{rank}".encode(),
+                })
+            except Exception:  # noqa: BLE001
+                pass
+            epoch = m.epoch
+
+    _min_epochs[group_name] = max(_min_epochs.get(group_name, 0), epoch)
+    _inc_counts[group_name] = epoch
+    _groups[group_name] = group
+    try:
+        # our pre-reform init key can only confuse a future plain init
+        w.head.call("kv_del", {
+            "ns": KV_NS, "key": f"{group_name}/{rank}".encode(),
+        })
+    except Exception:  # noqa: BLE001
+        pass
+    logger.info("collective group '%s' rank %d reformed at epoch %d "
+                "(world %d)", group_name, rank, epoch, world_size)
+    try:
+        _get_abort_metrics()["reforms"].inc(1, {"group": group_name})
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        w.head.fire("record_event", {
+            "kind": "COLLECTIVE_REFORM",
+            "message": f"group '{group_name}' rank {rank} reformed at "
+                       f"epoch {epoch} (world {world_size})",
+            "group": group_name, "rank": rank, "epoch": epoch,
+            "world_size": world_size,
+        })
+    except Exception:  # noqa: BLE001
+        pass
     return group
 
 
@@ -250,6 +725,19 @@ class CollectiveActorMixin:
         init_collective_group(world_size, rank, backend, group_name)
         self._coll_group = group_name
         return rank
+
+    def __ray_tpu_reform_collective__(self, world_size, rank, group_name,
+                                      epoch=None):
+        reform_group(world_size, rank, group_name, epoch=epoch)
+        self._coll_group = group_name
+        return rank
+
+    def __ray_tpu_collective_epoch__(self, group_name):
+        """This member's live incarnation epoch (0 if it has none) — a
+        driver coordinating a reform consults every survivor so a wiped
+        epoch channel (head restart) can't produce a non-bumping epoch."""
+        g = _groups.get(group_name)
+        return 0 if g is None else g.epoch
 
     def __ray_tpu_destroy_collective__(self, group_name):
         destroy_collective_group(group_name)
@@ -281,12 +769,14 @@ def destroy_collective_group(group_name: str = "default"):
             _min_epochs.get(group_name, 0), g.epoch + 1)
         g.p2p_send.clear()
         g.p2p_recv.clear()
-        try:
-            g.worker.head.call("kv_del", {
-                "ns": KV_NS, "key": f"{group_name}/{g.rank}".encode(),
-            })
-        except Exception:  # noqa: BLE001 — teardown is best-effort
-            pass
+        for key in (f"{group_name}/{g.rank}",
+                    f"{group_name}@{g.epoch}/{g.rank}"):
+            try:
+                g.worker.head.call("kv_del", {
+                    "ns": KV_NS, "key": key.encode(),
+                })
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                pass
 
 
 def get_rank(group_name: str = "default") -> int:

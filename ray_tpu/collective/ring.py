@@ -41,8 +41,43 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from ray_tpu._private import config
+from ray_tpu._private import config, fault_injection
 from ray_tpu.collective import compression
+
+
+def _abort_poll(g, op: str) -> None:
+    """Raise the group's CollectiveAbortError between chunks (tolerant
+    of duck-typed test groups without abort state)."""
+    poll = getattr(g, "_poll_abort", None)
+    if poll is not None:
+        poll(op=op)
+
+
+def _send_chunk(g, right: int, seq: int, key: str, frame, st, *,
+                op: str, step: int, chunk: int) -> None:
+    """One pipelined chunk send, wrapped with the deterministic
+    fault-injection site ``ring.send`` (drop / dup / delay / die)."""
+    if fault_injection.enabled():
+        act = fault_injection.fire(
+            "ring.send", group=g.name, rank=g.rank, op=op, step=step,
+            chunk=chunk)
+        if act == "drop":
+            return
+        if act == "dup":
+            g._send_obj(right, seq, key, frame, fire=True)
+            st.bytes_sent += compression.wire_bytes(frame)
+    g._send_obj(right, seq, key, frame, fire=True)
+    st.bytes_sent += compression.wire_bytes(frame)
+    st.chunks += 1
+
+
+def _recv_chunk(g, left: int, seq: int, key: str, *, timeout: float,
+                op: str, step: int, chunk: int):
+    if fault_injection.enabled():
+        fault_injection.fire(
+            "ring.recv", group=g.name, rank=g.rank, op=op, step=step,
+            chunk=chunk)
+    return g._recv_obj(left, seq, key, timeout=timeout, op=op)
 
 _REDUCE_ELEMWISE = {
     "sum": np.add,
@@ -239,6 +274,7 @@ def _ring_reduce_scatter_flat(g, flat: np.ndarray, bounds: list[int], *,
         send_chunks = _chunk_bounds(s_lo, s_hi, celems)
         recv_chunks = _chunk_bounds(r_lo, r_hi, celems)
         t0 = time.perf_counter()
+        _abort_poll(g, f"{tag}:rs{step}")
         # fire every chunk of the step before blocking on receives: the
         # outbox drains on the io thread while we decode/accumulate
         for ci, (lo, hi) in enumerate(send_chunks):
@@ -252,13 +288,12 @@ def _ring_reduce_scatter_flat(g, flat: np.ndarray, bounds: list[int], *,
                 _ef_put(ef_key, residual)
             else:
                 frame = codec.encode(work[lo:hi])
-            g._send_obj(right, seq, f"{tag}:rs{step}:{ci}", frame,
-                        fire=True)
-            st.bytes_sent += compression.wire_bytes(frame)
-            st.chunks += 1
+            _send_chunk(g, right, seq, f"{tag}:rs{step}:{ci}", frame, st,
+                        op=f"{tag}:rs{step}", step=step, chunk=ci)
         for ci, (lo, hi) in enumerate(recv_chunks):
-            frame = g._recv_obj(left, seq, f"{tag}:rs{step}:{ci}",
-                                timeout=timeout, op=f"{tag}:rs{step}")
+            frame = _recv_chunk(g, left, seq, f"{tag}:rs{step}:{ci}",
+                                timeout=timeout, op=f"{tag}:rs{step}",
+                                step=step, chunk=ci)
             st.bytes_recv += compression.wire_bytes(frame)
             incoming = codec.decode(frame)
             if hi > lo:
@@ -301,15 +336,15 @@ def _ring_all_gather_flat(g, work: np.ndarray, bounds: list[int], *,
         r_lo, r_hi = bounds[recv_seg], bounds[recv_seg + 1]
         recv_chunks = _chunk_bounds(r_lo, r_hi, celems)
         t0 = time.perf_counter()
+        _abort_poll(g, f"{tag}:ag{step}")
         for ci, frame in enumerate(frames):
-            g._send_obj(right, seq, f"{tag}:ag{step}:{ci}", frame,
-                        fire=True)
-            st.bytes_sent += compression.wire_bytes(frame)
-            st.chunks += 1
+            _send_chunk(g, right, seq, f"{tag}:ag{step}:{ci}", frame, st,
+                        op=f"{tag}:ag{step}", step=step, chunk=ci)
         frames = []
         for ci, (clo, chi) in enumerate(recv_chunks):
-            frame = g._recv_obj(left, seq, f"{tag}:ag{step}:{ci}",
-                                timeout=timeout, op=f"{tag}:ag{step}")
+            frame = _recv_chunk(g, left, seq, f"{tag}:ag{step}:{ci}",
+                                timeout=timeout, op=f"{tag}:ag{step}",
+                                step=step, chunk=ci)
             st.bytes_recv += compression.wire_bytes(frame)
             frames.append(frame)  # forward verbatim next step
             if chi > clo:

@@ -496,6 +496,16 @@ class ControlPlane:
             events = [e for e in events if e["kind"] == kind]
         return events[-int(p.get("limit", 1000)):]
 
+    async def rpc_record_event(self, conn, p):
+        """Worker-reported structured event (collective aborts/reforms,
+        chaos-test markers): same bounded ring as head-side events, so
+        `list events` shows cluster-wide failure handling in one place."""
+        fields = {k: v for k, v in p.items()
+                  if k not in ("kind", "message")}
+        self.record_event(str(p.get("kind", "WORKER_EVENT")),
+                          str(p.get("message", "")), **fields)
+        return True
+
     async def rpc_op_stats(self, conn, p):
         """Per-RPC-route handler stats (asio event-stats analog)."""
         return self.server.stats_snapshot()

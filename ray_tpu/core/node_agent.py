@@ -35,7 +35,7 @@ from collections import deque
 from typing import Any
 
 from ray_tpu._private import config as cfg
-from ray_tpu._private import rpc, task_spec
+from ray_tpu._private import fault_injection, rpc, task_spec
 from ray_tpu._private.rpc import AsyncRpcClient, RpcServer
 from ray_tpu.core import pull_manager
 from ray_tpu.core.object_store import ObjectStoreClient, StoreFullError
@@ -366,6 +366,15 @@ class NodeAgent:
             try:
                 if self.head.closed:
                     if not await self._reconnect_head():
+                        await asyncio.sleep(1.0)
+                        continue
+                if fault_injection.enabled():
+                    # chaos site: "stall" sleeps past the head's timeout
+                    # (node marked dead while the process lives), "drop"
+                    # skips one beat — both deterministic per occurrence
+                    act = fault_injection.fire(
+                        "agent.heartbeat", node=self.node_id.hex())
+                    if act == "drop":
                         await asyncio.sleep(1.0)
                         continue
                 reply = await self.head.call(
@@ -2090,13 +2099,33 @@ class NodeAgent:
         holds more than transfer_outbound_window_bytes — a slow or
         flooded receiver backs up its own connection and only its own
         transfers pace; other peers' connections are independent. The
-        sender's memory per peer stays bounded at window + one chunk."""
+        sender's memory per peer stays bounded at window + one chunk.
+
+        The wait is event-driven: the peer's transport water marks are
+        set to the window once, and every waiter parks in drain() until
+        the transport's resume_writing wakes them — ONE per-peer wakeup
+        instead of N independent 5 ms poll loops. If the buffer is still
+        over the window at the deadline the peer is flooded beyond
+        pacing: refuse RETRYABLY ({"busy": True}) rather than stacking
+        another chunk onto a connection already minutes behind. The
+        drain wait is short (20s vs the old 60s poll) BECAUSE the
+        refusal is retryable — the puller backs off client-side instead
+        of pinning a server handler, and its own wall-clock budget then
+        bounds how long one flooded location can stall a pull."""
         if conn is not None:
             window = int(cfg.get("transfer_outbound_window_bytes"))
-            deadline = time.monotonic() + 60.0
-            while (self._conn_write_buffered(conn) > window
-                   and time.monotonic() < deadline):
-                await asyncio.sleep(0.005)
+            if self._conn_write_buffered(conn) > window:
+                if not conn.state.get("paced"):
+                    conn.state["paced"] = True
+                    try:
+                        conn.writer.transport.set_write_buffer_limits(
+                            high=window, low=max(1, window // 2))
+                    except Exception:  # noqa: BLE001 — transport mid-close
+                        pass
+                try:
+                    await asyncio.wait_for(conn.drain(), timeout=20.0)
+                except asyncio.TimeoutError:
+                    return {"busy": True, "retry_after_s": 0.5}
         return self._read_object_chunk(p)
 
     @staticmethod
@@ -2203,10 +2232,31 @@ class NodeAgent:
             await asyncio.sleep(0.1)
         return False
 
+    async def _read_chunk_backoff(self, cli: AsyncRpcClient, oid: bytes,
+                                  offset: int, budget_s: float = 60.0):
+        """read_object_chunk with bounded backoff on the server's
+        retryable {"busy": True} refusal (its pacing deadline expired:
+        our own connection is flooded). Bounded by WALL CLOCK, not
+        attempt count — each refused attempt can itself block in the
+        server's drain wait, so counting attempts alone could pin a pull
+        on one flooded location for minutes. Returns the chunk dict, or
+        None (missing / still flooded — the outer pull loop retries
+        other locations within its own deadline)."""
+        backoff = 0.1
+        deadline = time.monotonic() + budget_s
+        while True:
+            part = await cli.call("read_object_chunk",
+                                  {"object_id": oid, "offset": offset})
+            if not (isinstance(part, dict) and part.get("busy")):
+                return part
+            if time.monotonic() > deadline:
+                return None
+            await asyncio.sleep(min(backoff, 2.0))
+            backoff *= 1.6
+
     async def _pull_from(self, cli: AsyncRpcClient, oid: bytes) -> bool:
         try:
-            first = await cli.call("read_object_chunk",
-                                   {"object_id": oid, "offset": 0})
+            first = await self._read_chunk_backoff(cli, oid, 0)
             if first is None:
                 return False
             total, meta = first["total"], first["meta"]
@@ -2217,10 +2267,7 @@ class NodeAgent:
                 wbuf.data[0:len(first["chunk"])] = first["chunk"]
                 offset = len(first["chunk"])
                 while offset < total:
-                    part = await cli.call(
-                        "read_object_chunk",
-                        {"object_id": oid, "offset": offset},
-                    )
+                    part = await self._read_chunk_backoff(cli, oid, offset)
                     if part is None:
                         wbuf.abort()
                         return False

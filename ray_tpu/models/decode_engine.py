@@ -87,6 +87,7 @@ def decode_chunk(params, cache, tok, active, cfg: LlamaConfig,
     w_out = (
         params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     ).astype(cdt)
+    max_len = cache["k"].shape[2]
 
     def one_step(carry, _):
         t, k, v, pos = carry
@@ -105,7 +106,13 @@ def decode_chunk(params, cache, tok, active, cfg: LlamaConfig,
         logits = (h[:, 0] @ w_out).astype(jnp.float32)  # [B, V]
         nxt = jnp.argmax(logits, axis=-1).astype(t.dtype)
         nxt = jnp.where(active, nxt, t)  # frozen slots hold their token
-        pos = pos + active.astype(pos.dtype)
+        # clamp: a slot that exhausts its cache rows mid-chunk (pump()
+        # only frees slots at chunk boundaries) must keep scattering
+        # in-range — unclamped, jit's clamping scatter would write row
+        # max_len-1 anyway, but the mask (k_pos <= pos) would open past
+        # the cache and pump()'s pos >= max_len-1 finish check stays
+        # exact instead of relying on overflow
+        pos = jnp.minimum(pos + active.astype(pos.dtype), max_len - 1)
         return (nxt, k, v, pos), nxt
 
     (last, k, v, pos), toks = jax.lax.scan(
@@ -131,7 +138,15 @@ def _prefill_batch_into_slots(params, prompts, true_lens, slots,
     real tokens (a prefix) never see the pad garbage, the first token
     samples from the TRUE last prompt position, and each later decode
     step overwrites a pad cache row at its position before the growing
-    per-slot mask can expose it."""
+    per-slot mask can expose it.
+
+    FULL-SLOT-OVERWRITE ASSUMPTION: correctness of slot reuse depends on
+    this scatter replacing ALL max_len cache rows of the slot (tmp is a
+    full-length cache, zeros past the prompt), never a prefix. A
+    partial-row write would leave the previous occupant's k/v beyond the
+    prompt, and the new stream's growing mask — or a clamped write at
+    row max_len-1 from a slot that decoded to the cache edge — would
+    eventually attend over stale tokens."""
     f = prompts.shape[0]
     slot_len = cache["k"].shape[2]
     tmp = llama.init_cache(cfg, f, slot_len)

@@ -33,6 +33,7 @@ from ray_tpu.train import session  # noqa: F401
 from ray_tpu.train.dcn import (  # noqa: F401
     dcn_allreduce_grads,
     init_cross_slice_group,
+    reform_cross_slice_group,
 )
 from ray_tpu.train.gbdt import (  # noqa: F401,E402
     GBDTPredictor,

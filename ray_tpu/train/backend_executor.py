@@ -126,7 +126,11 @@ def _next_result(worker, timeout: float = 10.0):
                     import traceback
 
                     tb = "".join(traceback.format_exception(sess.error))
-                    return {"type": "error", "error": tb}
+                    # the exception TYPE rides as data so the driver can
+                    # classify (e.g. CollectiveAbortError => retriable
+                    # infra failure) without probing the traceback text
+                    return {"type": "error", "error": tb,
+                            "error_type": type(sess.error).__name__}
                 return {"type": "finished"}
             if time.monotonic() > deadline:
                 return {"type": "pending"}

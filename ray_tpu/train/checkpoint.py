@@ -326,3 +326,11 @@ class CheckpointManager:
             return None
         path = max(self._registered, key=lambda t: t[1])[2]
         return Checkpoint(path)
+
+    def latest_dict(self) -> dict | None:
+        """Payload of the newest dict-style checkpoint, or None when
+        nothing was registered — the restore hook of the elastic
+        abort → restore → reform → resume cycle for small train states
+        (step counters, host-replicated params)."""
+        c = self.latest
+        return None if c is None else c.to_dict()

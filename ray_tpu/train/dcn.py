@@ -30,7 +30,8 @@ import numpy as np
 from ray_tpu._private import config
 from ray_tpu.collective import collective as _col
 
-__all__ = ["dcn_allreduce_grads", "init_cross_slice_group"]
+__all__ = ["dcn_allreduce_grads", "init_cross_slice_group",
+           "reform_cross_slice_group"]
 
 
 def init_cross_slice_group(group_name: str = "dcn", *,
@@ -50,6 +51,35 @@ def init_cross_slice_group(group_name: str = "dcn", *,
     return _col.init_collective_group(world_size, rank,
                                       group_name=group_name,
                                       timeout=timeout)
+
+
+def reform_cross_slice_group(group_name: str = "dcn", *,
+                             world_size: int | None = None,
+                             rank: int | None = None,
+                             epoch: int | None = None,
+                             timeout: float | None = None):
+    """Rebuild the cross-slice gradient group after losing (or
+    regaining) a slice — the in-loop half of the elastic cycle:
+
+        try:
+            grads = dcn_allreduce_grads(grads)
+        except CollectiveAbortError:
+            state = restore_latest_checkpoint(...)
+            reform_cross_slice_group(world_size=new_ws, rank=new_rank)
+            continue  # resume the step loop at the surviving world size
+
+    The reformed incarnation runs under a bumped epoch: stale gradient
+    chunks from the aborted step can never fold into post-reform
+    buckets, and each bucket's error-feedback residual restarts empty
+    (membership change invalidates the old segment geometry)."""
+    if world_size is None or rank is None:
+        from ray_tpu.train import session
+
+        world_size = session.get_world_size() if world_size is None \
+            else world_size
+        rank = session.get_world_rank() if rank is None else rank
+    return _col.reform_group(world_size, rank, group_name,
+                             epoch=epoch, timeout=timeout)
 
 
 def _fill_buckets(leaves: list[np.ndarray], bucket_bytes: int):

@@ -112,10 +112,22 @@ class JaxTrainer:
                     metrics_history=history,
                 )
             except (RayActorError, GetTimeoutError, RuntimeError) as e:
-                if isinstance(e, TrainingFailedError):
-                    executor.shutdown()
-                    raise
                 executor.shutdown()
+                # A collective abort reported by the user loop means a
+                # peer slice died mid-allreduce: that's an infra
+                # failure, not a user error — retriable under
+                # max_failures like actor death. The gang restart IS the
+                # reform at this level: fresh processes re-rendezvous
+                # their groups (the reachability-probed rendezvous skips
+                # the dead gang's stale KV entries) and resume from the
+                # latest checkpoint. Classified by the TYPED error_type
+                # the worker reported, not a traceback-text probe.
+                abort = (isinstance(e, TrainingFailedError)
+                         and getattr(e, "error_type", "")
+                         == "CollectiveAbortError")
+                if isinstance(e, TrainingFailedError) and not (
+                        abort and failures_left > 0):
+                    raise
                 if failures_left <= 0:
                     return Result(
                         metrics=history[-1] if history else None,
@@ -148,7 +160,9 @@ class JaxTrainer:
             rounds = executor.next_results(timeout=15.0)
             for rank, res in enumerate(rounds):
                 if res["type"] == "error":
-                    raise TrainingFailedError(res["error"])
+                    err = TrainingFailedError(res["error"])
+                    err.error_type = res.get("error_type", "")
+                    raise err
                 if res["type"] == "finished":
                     finished[rank] = True
                 elif res["type"] == "report":

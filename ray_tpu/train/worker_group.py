@@ -8,6 +8,7 @@ arbitrary functions on all/any worker, torn down as a unit.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Any, Callable
 
 import ray_tpu
@@ -44,13 +45,29 @@ class TrainWorker(CollectiveActorMixin):
 
 
 class WorkerGroup:
-    """N TrainWorker actors gang-scheduled via one placement group."""
+    """N TrainWorker actors gang-scheduled via one placement group.
+
+    ``max_restarts`` is real: it rides into each actor's options (the
+    control plane re-schedules a died actor up to that many times) AND
+    bounds the driver-side respawns :meth:`heal` may perform when the
+    runtime restart is exhausted or impossible. After a failure, the
+    elastic cycle is ``heal()`` (respawn or shrink) →
+    ``reform_collective()`` (bumped-epoch re-rendezvous) → resume from
+    the latest checkpoint.
+
+    After a shrink, ``worker_idx`` is an actor IDENTITY, not a rank:
+    ranks are gang positions, assigned per incarnation by
+    ``reform_collective`` (and by whatever rank argument the driver
+    passes to step functions). ``grow()`` assigns fresh, never-reused
+    worker_idx values so two actors can never share an identity."""
 
     def __init__(self, num_workers: int,
                  resources_per_worker: dict | None = None,
                  strategy: str = "SPREAD",
                  max_restarts: int = 0):
         self.num_workers = num_workers
+        self.max_restarts = max_restarts
+        self._respawns_left = max_restarts
         self.resources = dict(resources_per_worker or {"CPU": 1})
         self.pg = ray_tpu.placement_group(
             [dict(self.resources) for _ in range(num_workers)],
@@ -63,7 +80,7 @@ class WorkerGroup:
             )
         custom = {r: v for r, v in self.resources.items()
                   if r not in ("CPU", "TPU")}
-        opts = {
+        self._actor_opts = {
             "placement_group": self.pg,
             "num_cpus": self.resources.get("CPU", 0),
             "num_tpus": self.resources.get("TPU", 0),
@@ -72,13 +89,161 @@ class WorkerGroup:
         }
         self.workers = [
             TrainWorker.options(
-                **opts, placement_group_bundle_index=i
+                **self._actor_opts, placement_group_bundle_index=i
             ).remote(i)
             for i in range(num_workers)
         ]
+        # bundle index of each current worker (parallel to self.workers):
+        # heal() shrinks may free slots; grow() re-fills them
+        self._bundle_count = num_workers
+        self._bundles = list(range(num_workers))
+        # monotonically fresh worker identities for grow(): appending
+        # len(self.workers) after a mid-list shrink would duplicate a
+        # survivor's worker_idx
+        self._next_worker_idx = num_workers
         self._coll_group: str | None = None
         # fail fast if any worker can't start
         ray_tpu.get([w.ping.remote() for w in self.workers], timeout=120)
+
+    # ---- elastic membership ----
+
+    def probe(self, timeout: float = 5.0,
+              indices: list[int] | None = None) -> list[bool]:
+        """Liveness of gang members under ONE shared deadline: all pings
+        launch together, so detection cost doesn't scale with the number
+        of dead ranks (the recovery path must beat the collective
+        timeout it exists to avoid). ``indices`` restricts the probe to
+        a subset (heal's re-ping loop); result order matches it."""
+        idxs = list(range(len(self.workers))) if indices is None \
+            else list(indices)
+        refs = [self.workers[i].ping.remote() for i in idxs]
+        deadline = time.monotonic() + timeout
+        alive = []
+        for r in refs:
+            try:
+                ray_tpu.get(r, timeout=max(0.1, deadline - time.monotonic()))
+                alive.append(True)
+            except Exception:  # noqa: BLE001 — dead OR mid-restart
+                alive.append(False)
+        return alive
+
+    def heal(self, *, wait_restart_s: float = 60.0,
+             respawn: bool = True) -> int:
+        """Make the gang whole again after worker death.
+
+        1. Detect dead members by ping. 2. Give the runtime's actor
+        restart (``max_restarts`` in the actor options) time to bring
+        them back. 3. Manually respawn any still-dead member while the
+        driver-side respawn budget lasts. 4. Otherwise SHRINK: drop the
+        dead members and compact ranks, so training can resume at the
+        surviving world size. Returns the new world size.
+
+        Callers must follow with :meth:`reform_collective` — membership
+        changed, so the old collective incarnation is unusable.
+        """
+        if self.max_restarts <= 0:
+            # no runtime restarts are configured, so waiting for one is
+            # pure recovery latency — detect and move straight to
+            # respawn-or-shrink
+            wait_restart_s = 0.0
+        deadline = time.monotonic() + wait_restart_s
+        dead = [i for i, ok in enumerate(self.probe()) if not ok]
+        while dead and time.monotonic() < deadline:
+            time.sleep(1.0)
+            window = min(5.0, max(0.5, deadline - time.monotonic()))
+            ok = self.probe(timeout=window, indices=dead)
+            dead = [i for i, alive in zip(dead, ok) if not alive]
+        # reap the dead handles FIRST (no_restart): a runtime restart
+        # completing after our wait would otherwise bring an old actor
+        # back into the same bundle as our respawn/grow — two actors
+        # oversubscribing one bundle slot
+        for i in dead:
+            try:
+                ray_tpu.kill(self.workers[i])
+            except Exception:  # noqa: BLE001 — already gone
+                pass
+        # launch every respawn the budget allows, then gather their
+        # pings under ONE shared deadline — serial 60s-per-rank waits
+        # would scale recovery latency with the number of dead ranks
+        spawns: dict[int, Any] = {}
+        if respawn:
+            for i in dead:
+                if len(spawns) >= self._respawns_left:
+                    break
+                # fresh identity, not the list position: after an
+                # earlier shrink, position i may belong to a live actor
+                # whose worker_idx == i (identities never recycle)
+                idx = self._next_worker_idx
+                self._next_worker_idx += 1
+                spawns[i] = TrainWorker.options(
+                    **self._actor_opts,
+                    placement_group_bundle_index=self._bundles[i],
+                ).remote(idx)
+        pings = {i: w.ping.remote() for i, w in spawns.items()}
+        spawn_deadline = time.monotonic() + 60.0
+        for i, ref in pings.items():
+            try:
+                ray_tpu.get(ref, timeout=max(
+                    0.1, spawn_deadline - time.monotonic()))
+            except Exception:  # noqa: BLE001 — bundle may be gone too
+                try:
+                    ray_tpu.kill(spawns[i])  # don't double-book the slot
+                except Exception:  # noqa: BLE001
+                    pass
+                logger.warning("respawn of train worker %d failed", i)
+                continue
+            self._respawns_left -= 1
+            self.workers[i] = spawns[i]
+            dead.remove(i)
+            logger.info("train worker %d respawned (%d respawns left)",
+                        i, self._respawns_left)
+        if dead:
+            gone = set(dead)
+            self.workers = [w for i, w in enumerate(self.workers)
+                            if i not in gone]
+            self._bundles = [b for i, b in enumerate(self._bundles)
+                             if i not in gone]
+            logger.warning("worker group shrunk: dropped dead ranks %s, "
+                           "world size now %d", sorted(gone),
+                           len(self.workers))
+        self.num_workers = len(self.workers)
+        if self.num_workers == 0:
+            raise RuntimeError("worker group lost every member")
+        return self.num_workers
+
+    def grow(self, num_workers: int, timeout: float = 60.0) -> int:
+        """Re-expand a shrunk gang toward the placement group's original
+        bundle count (the 'regained a slice' half of elasticity): new
+        TrainWorkers take the freed bundle slots. Membership changed, so
+        follow with :meth:`reform_collective`. Returns the world size."""
+        if num_workers > self._bundle_count:
+            raise ValueError(
+                f"cannot grow to {num_workers}: placement group has "
+                f"{self._bundle_count} bundles")
+        free = sorted(set(range(self._bundle_count)) - set(self._bundles))
+        while len(self.workers) < num_workers and free:
+            b = free.pop(0)
+            idx = self._next_worker_idx
+            self._next_worker_idx += 1
+            w = TrainWorker.options(
+                **self._actor_opts, placement_group_bundle_index=b
+            ).remote(idx)
+            try:
+                ray_tpu.get(w.ping.remote(), timeout=timeout)
+            except Exception:  # noqa: BLE001 — spawn failed/hung
+                # reap the half-started actor so the bundle isn't left
+                # double-booked for the caller's retry
+                try:
+                    ray_tpu.kill(w)
+                except Exception:  # noqa: BLE001
+                    pass
+                logger.warning("grow: worker in bundle %d failed to "
+                               "start; stopping expansion", b)
+                break
+            self.workers.append(w)
+            self._bundles.append(b)
+        self.num_workers = len(self.workers)
+        return self.num_workers
 
     def init_collective(self, group_name: str | None = None,
                         backend: str = "cpu") -> str:
@@ -94,6 +259,48 @@ class WorkerGroup:
             self.workers, self.num_workers, list(range(self.num_workers)),
             backend=backend, group_name=name,
         )
+        self._coll_group = name
+        return name
+
+    def reform_collective(self, group_name: str | None = None,
+                          timeout: float = 120.0) -> str:
+        """Driver-coordinated reform after :meth:`heal`: bump the
+        group's epoch channel, then have every CURRENT member
+        re-rendezvous under the bumped epoch (rank = gang position, so a
+        shrunk gang gets contiguous ranks). Frames from the old
+        incarnation are rejected at ingress; its error-feedback
+        residuals are dropped."""
+        import msgpack
+
+        from ray_tpu._private.api import _get_worker
+        from ray_tpu.collective.collective import KV_NS, _epoch_key
+
+        name = group_name or self._coll_group
+        if not name:
+            raise RuntimeError("no collective group to reform")
+        w = _get_worker()
+        raw = w.head.call("kv_get", {"ns": KV_NS, "key": _epoch_key(name)})
+        cur = msgpack.unpackb(raw) if raw is not None else 1
+        # the channel can be stale or wiped (head restart, lost init
+        # publish): consult the survivors' live epochs too, or the bump
+        # might not clear a member's incarnation and reform would fail
+        try:
+            live = ray_tpu.get(
+                [a.__ray_tpu_collective_epoch__.remote(name)
+                 for a in self.workers], timeout=30)
+        except Exception:  # noqa: BLE001 — best-effort refinement
+            live = []
+        epoch = max([cur] + list(live)) + 1
+        w.head.call("kv_put", {
+            "ns": KV_NS, "key": _epoch_key(name),
+            "value": msgpack.packb(epoch),
+        })
+        refs = [
+            a.__ray_tpu_reform_collective__.remote(
+                self.num_workers, r, name, epoch)
+            for r, a in enumerate(self.workers)
+        ]
+        ray_tpu.get(refs, timeout=timeout)
         self._coll_group = name
         return name
 

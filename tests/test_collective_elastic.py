@@ -1,0 +1,450 @@
+"""Elastic fault tolerance of the DCN collective stack.
+
+Covers the abort → heal/reform → resume cycle end to end:
+
+- a rank killed mid-allreduce (deterministic fault injection) makes the
+  SURVIVORS raise CollectiveAbortError within the abort-detection
+  interval — well under RAY_TPU_COLLECTIVE_TIMEOUT_S;
+- `reform_group` (via the driver's `WorkerGroup.reform_collective`)
+  rebuilds the ring under a bumped epoch, and a resumed training step
+  produces gradients matching a clean run at the surviving world size;
+- a 2-slice DCN job resumes from checkpoint at reduced then restored
+  world size (shrink → grow elasticity);
+- frames from an old incarnation are provably rejected at mailbox
+  ingress; abort frames wake blocked recvs; error-feedback residuals
+  are dropped across a reform and cannot corrupt post-reform numerics.
+"""
+
+import asyncio
+import sys
+import threading
+import time
+from types import SimpleNamespace
+
+import cloudpickle
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.worker_group import WorkerGroup
+
+# worker subprocesses can't import the tests package: ship helpers by value
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+DIM = 8
+LR = 0.1
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(head_resources={"CPU": 8, "memory": 4 * 2**30})
+    c.connect()
+    yield c
+    c.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# worker-side helpers (shipped by value)
+# ---------------------------------------------------------------------------
+
+
+def _survivor_allreduce(worker, group):
+    """Run an allreduce with a LONG timeout; report how fast (and how)
+    it failed. The abort must beat the timeout by an order of
+    magnitude."""
+    from ray_tpu.collective import CollectiveAbortError, allreduce
+
+    t0 = time.monotonic()
+    try:
+        out = allreduce(np.ones(256, np.float32), group, timeout=60.0)
+        return {"aborted": False, "sum": float(np.asarray(out).sum())}
+    except CollectiveAbortError as e:
+        return {"aborted": True, "elapsed": time.monotonic() - t0,
+                "group": e.group, "rank": e.rank, "epoch": e.epoch,
+                "op": e.op, "msg": str(e)}
+
+
+def _victim_allreduce(worker, group):
+    """Configure a deterministic kill (hard process exit at this rank's
+    first ring chunk send) and walk into it."""
+    from ray_tpu._private import fault_injection
+    from ray_tpu.collective import allreduce
+
+    fault_injection.configure([{
+        "site": "ring.send", "match": {"rank": 1, "step": 0, "chunk": 0},
+        "action": "exit",
+    }])
+    return allreduce(np.ones(256, np.float32), group, timeout=60.0)
+
+
+def _member_reform(worker, group, world, rank):
+    """SPMD-side reform (no driver-chosen epoch): a survivor bumps the
+    epoch channel; a respawned member adopts it (migrating if it read a
+    stale value first)."""
+    from ray_tpu.collective import reform_group
+
+    g = reform_group(world, rank, group)
+    return {"epoch": g.epoch, "rank": g.rank}
+
+
+def _plain_allreduce(worker, group, value):
+    from ray_tpu.collective import allreduce
+    from ray_tpu.collective.collective import _groups
+
+    out = allreduce(np.full(4, float(value), np.float32), group,
+                    timeout=60.0)
+    return {"out": np.asarray(out).tolist(), "epoch": _groups[group].epoch}
+
+
+def _grad(rank, step):
+    rng = np.random.default_rng(1000 * (rank + 1) + step)
+    return rng.standard_normal(DIM).astype(np.float32)
+
+
+def _train_steps(worker, group, rank, start, n, params, kill_at=None):
+    """SGD over dcn-synced mean gradients; deterministic per (rank,
+    step). kill_at=N hard-kills this rank at its Nth ring chunk send."""
+    from ray_tpu._private import fault_injection
+    from ray_tpu.train import dcn_allreduce_grads
+
+    p = np.asarray(params, np.float32).copy()
+    if kill_at is not None:
+        fault_injection.configure([{
+            "site": "ring.send", "match": {"rank": rank},
+            "after": kill_at, "action": "exit",
+        }])
+    for s in range(start, start + n):
+        synced = dcn_allreduce_grads({"p": _grad(rank, s)}, group,
+                                     timeout=60.0)["p"]
+        p = p - LR * synced
+    return p
+
+
+def _train_steps_expect_abort(worker, group, rank, start, n, params):
+    from ray_tpu.collective import CollectiveAbortError
+
+    t0 = time.monotonic()
+    try:
+        out = _train_steps(worker, group, rank, start, n, params)
+        return {"aborted": False, "params": out}
+    except CollectiveAbortError as e:
+        return {"aborted": True, "elapsed": time.monotonic() - t0,
+                "epoch": e.epoch, "op": e.op}
+
+
+# ---------------------------------------------------------------------------
+# cluster tests: kill → fast abort → heal → reform → resume
+# ---------------------------------------------------------------------------
+
+
+def test_mid_op_kill_aborts_survivor_fast_then_reforms(cluster):
+    """Acceptance: a rank killed mid-allreduce under fault injection
+    makes the surviving rank raise CollectiveAbortError well under the
+    60s collective timeout; heal() + reform_collective() then restore a
+    working group under a bumped epoch."""
+    wg = WorkerGroup(2, resources_per_worker={"CPU": 1}, max_restarts=1)
+    try:
+        group = wg.init_collective()
+        refs = [wg.workers[0].execute.remote(_survivor_allreduce, group),
+                wg.workers[1].execute.remote(_victim_allreduce, group)]
+        surv = ray_tpu.get(refs[0], timeout=90)
+        assert surv["aborted"], f"survivor completed?! {surv}"
+        # well under the 60s timeout (observed ~50ms via peer-loss
+        # detection; 20s leaves headroom for a loaded CI box)
+        assert surv["elapsed"] < 20.0, surv
+        # the typed error names group/rank/epoch/op
+        assert surv["group"] == group and surv["rank"] == 0
+        assert surv["epoch"] == 1 and surv["op"].startswith("ar:")
+        assert "rank 1" in surv["msg"]
+
+        # the victim's own ref must not return a value (its process died)
+        with pytest.raises(Exception):
+            ray_tpu.get(refs[1], timeout=15)
+
+        # heal: actor-level max_restarts respawns the dead rank; reform
+        # re-rendezvouses under a bumped epoch — exercised here through
+        # the SPMD member path (survivor bumps the epoch channel, the
+        # respawned fresh process adopts it); the group works again
+        assert wg.heal(wait_restart_s=90) == 2
+        refs = [w.execute.remote(_member_reform, group, 2, r)
+                for r, w in enumerate(wg.workers)]
+        reformed = ray_tpu.get(refs, timeout=120)
+        assert reformed[0]["epoch"] == reformed[1]["epoch"] >= 2
+        outs = wg.execute(_plain_allreduce, group, 1.0, timeout=90)
+        for o in outs:
+            assert o["out"] == [2.0, 2.0, 2.0, 2.0]
+            assert o["epoch"] >= 2  # bumped incarnation
+    finally:
+        wg.shutdown()
+
+
+def test_two_slice_job_resumes_reduced_then_restored(cluster, tmp_path):
+    """Acceptance: a 2-slice DCN job survives losing a slice (resume
+    from checkpoint at world 1) and regaining it (resume at world 2);
+    post-reform gradients match a clean run at each world size."""
+    # bit-exact reference schedule (f32 ring sums are order-stable)
+    p = np.zeros(DIM, np.float32)
+    for s in range(2):
+        p = p - LR * ((_grad(0, s) + _grad(1, s)) / 2)
+    ref_ck1 = p.copy()
+    for s in range(2, 4):
+        p = p - LR * _grad(0, s)
+    ref_ck2 = p.copy()
+    for s in range(4, 6):
+        p = p - LR * ((_grad(0, s) + _grad(1, s)) / 2)
+    ref_final = p.copy()
+
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    wg = WorkerGroup(2, resources_per_worker={"CPU": 1}, max_restarts=0)
+    try:
+        group = wg.init_collective()
+        p0 = np.zeros(DIM, np.float32)
+
+        # steps 0-1 at world 2, checkpoint
+        refs = [w.execute.remote(_train_steps, group, r, 0, 2, p0)
+                for r, w in enumerate(wg.workers)]
+        outs = ray_tpu.get(refs, timeout=120)
+        np.testing.assert_allclose(outs[0], ref_ck1, rtol=1e-6)
+        np.testing.assert_array_equal(outs[0], outs[1])  # lockstep
+        mgr.register(Checkpoint.from_dict(
+            {"step": 2, "params": outs[0]}, mgr.next_dir()))
+
+        # step 2 attempt: rank 1 hard-dies mid-allreduce; rank 0 aborts
+        # fast and applies NO partial update
+        refs = [wg.workers[0].execute.remote(
+                    _train_steps_expect_abort, group, 0, 2, 1, outs[0]),
+                wg.workers[1].execute.remote(
+                    _train_steps, group, 1, 2, 1, outs[1], 0)]
+        surv = ray_tpu.get(refs[0], timeout=90)
+        assert surv["aborted"] and surv["elapsed"] < 20.0, surv
+
+        # shrink to the surviving world, reform, resume from checkpoint
+        assert wg.heal(wait_restart_s=5) == 1  # max_restarts=0: drop
+        wg.reform_collective()
+        ck = mgr.latest_dict()
+        assert ck["step"] == 2
+        out = ray_tpu.get(wg.workers[0].execute.remote(
+            _train_steps, group, 0, ck["step"], 2, ck["params"]),
+            timeout=120)
+        np.testing.assert_allclose(out, ref_ck2, rtol=1e-6)
+        mgr.register(Checkpoint.from_dict(
+            {"step": 4, "params": out}, mgr.next_dir()))
+
+        # regain the slice: grow back to world 2, reform, resume
+        assert wg.grow(2) == 2
+        wg.reform_collective()
+        ck = mgr.latest_dict()
+        assert ck["step"] == 4
+        refs = [w.execute.remote(
+                    _train_steps, group, r, ck["step"], 2, ck["params"])
+                for r, w in enumerate(wg.workers)]
+        outs = ray_tpu.get(refs, timeout=120)
+        np.testing.assert_allclose(outs[0], ref_final, rtol=1e-6)
+        np.testing.assert_array_equal(outs[0], outs[1])
+    finally:
+        wg.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# unit tests: abort wakeups, stale-epoch ingress, EF residuals across reform
+# ---------------------------------------------------------------------------
+
+
+def _stub_worker():
+    """Duck-typed core worker for direct Group construction: absorbs
+    event recording, has no reachable peers (abort fan-out no-ops)."""
+    return SimpleNamespace(
+        head=SimpleNamespace(fire=lambda *a, **k: None),
+        _peer=lambda owner: None,
+        node_id=b"stub",
+    )
+
+
+def test_abort_frame_wakes_blocked_recv():
+    """An abort frame must wake a thread blocked in a collective recv
+    within the abort-detection interval, raising the typed error."""
+    from ray_tpu.collective import CollectiveAbortError
+    from ray_tpu.collective import collective as col
+
+    name = "abort-wake-unit"
+    g = col.Group(name, 2, 0, _stub_worker(), epoch=7)
+    g.peers = {0: {"addr": "127.0.0.1", "port": 1},
+               1: {"addr": "127.0.0.1", "port": 2}}
+    col._groups[name] = g
+    try:
+        got = []
+
+        def waiter():
+            t0 = time.monotonic()
+            try:
+                g._recv_obj(1, 1, "t", timeout=30.0, op="unit-op")
+            except CollectiveAbortError as e:
+                got.append((e, time.monotonic() - t0))
+
+        th = threading.Thread(target=waiter, daemon=True)
+        th.start()
+        time.sleep(0.3)
+        asyncio.run(col._rpc_coll_abort(None, {
+            "group": name, "epoch": 7, "origin": 1,
+            "reason": "unit kill", "op": "unit-op",
+            "abort_id": "unit-abort-1"}))
+        th.join(timeout=5)
+        assert not th.is_alive(), "recv never woke on the abort frame"
+        e, dt = got[0]
+        assert dt < 3.0  # woke via cond notify, not the 30s timeout
+        assert e.group == name and e.rank == 0 and e.epoch == 7
+        assert e.origin_rank == 1 and e.op == "unit-op"
+        assert "unit kill" in str(e)
+        # abort is sticky: entering a new op on the incarnation raises
+        with pytest.raises(CollectiveAbortError):
+            g._poll_abort(op="next-op")
+    finally:
+        col._groups.pop(name, None)
+
+
+def test_stale_abort_frame_ignored():
+    """An abort frame from an older epoch must not poison a reformed
+    incarnation."""
+    from ray_tpu.collective import collective as col
+
+    name = "stale-abort-unit"
+    g = col.Group(name, 2, 0, _stub_worker(), epoch=5)
+    col._groups[name] = g
+    try:
+        asyncio.run(col._rpc_coll_abort(None, {
+            "group": name, "epoch": 4, "origin": 1, "reason": "old",
+            "abort_id": "unit-abort-stale"}))
+        assert g._abort is None
+    finally:
+        col._groups.pop(name, None)
+
+
+def test_stale_epoch_frames_rejected_at_ingress():
+    """Frames below the group's minimum live epoch are dropped at
+    ingress — a reformed group can never consume the old incarnation's
+    in-flight chunks."""
+    from ray_tpu.collective import collective as col
+
+    name = "stale-frames-unit"
+    col._min_epochs[name] = 3
+    try:
+        ok = asyncio.run(col._rpc_coll_msg(None, {
+            "group": name, "inc": 2, "seq": 1, "src": 0, "tag": "t",
+            "payload": b"old"}))
+        assert ok is False
+        assert (name, 2, 1, 0, "t") not in col._mailbox().msgs
+        ok = asyncio.run(col._rpc_coll_msg(None, {
+            "group": name, "inc": 3, "seq": 1, "src": 0, "tag": "t",
+            "payload": b"new"}))
+        assert ok is True
+        assert col._mailbox().msgs.pop((name, 3, 1, 0, "t")) == b"new"
+    finally:
+        col._min_epochs.pop(name, None)
+
+
+class _Net:
+    """Shared mailbox for threaded fake ranks (trimmed copy of the
+    test_collective_ring harness — wire-serializes every frame)."""
+
+    def __init__(self):
+        self.cond = threading.Condition()
+        self.msgs = {}
+
+    def put(self, key, val):
+        with self.cond:
+            self.msgs[key] = val
+            self.cond.notify_all()
+
+    def take(self, key, timeout):
+        deadline = time.monotonic() + timeout
+        with self.cond:
+            while key not in self.msgs:
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    raise TimeoutError(key)
+                self.cond.wait(min(rem, 0.2))
+            return self.msgs.pop(key)
+
+
+class _FakeGroup:
+    def __init__(self, net, name, world, rank):
+        self.net = net
+        self.name = name
+        self.world_size = world
+        self.rank = rank
+        self.seq = 0
+
+    def _next_seq(self):
+        self.seq += 1
+        return self.seq
+
+    def _send_obj(self, dst, seq, tag, obj, fire=False):
+        from ray_tpu._private import serialization
+
+        self.net.put((dst, self.name, seq, self.rank, tag),
+                     serialization.pack_payload(obj))
+
+    def _recv_obj(self, src, seq, tag, timeout=None, op=None):
+        from ray_tpu._private import serialization
+
+        msg = self.net.take((self.rank, self.name, seq, src, tag),
+                            timeout or 30)
+        return serialization.unpack_payload(msg)
+
+
+def _run_world(world, fn, name):
+    net = _Net()
+    outs = [None] * world
+    errs = []
+
+    def go(r):
+        try:
+            outs[r] = fn(_FakeGroup(net, name, world, r), r)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=go, args=(r,)) for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    if errs:
+        raise errs[0]
+    return outs
+
+
+def test_reform_drops_ef_residuals_numerics():
+    """Membership change invalidates EF segment geometry: the residuals
+    of the old incarnation are purged on reform, so post-reform int8
+    numerics at the new world size are bit-identical to a fresh group's
+    (no stale residual folds in)."""
+    from ray_tpu.collective import ring
+
+    data = {r: np.random.default_rng(50 + r).standard_normal(512)
+            .astype(np.float32) for r in range(3)}
+
+    def round_w3(g, r):
+        return ring.ring_allreduce(g, data[r], codec="int8",
+                                   ef_tag="w", timeout=30)
+
+    _run_world(3, round_w3, "ef-reform")
+    # lossy codec + EF tag ⇒ residuals were stored for this group
+    assert any(k[0] == "ef-reform" for k in ring._ef_store), \
+        "precondition: EF residuals should exist after an int8 round"
+
+    # reform purges them (destroy_collective_group → ring.purge_group)
+    ring.purge_group("ef-reform")
+    assert not any(k[0] == "ef-reform" for k in ring._ef_store)
+
+    def round_w2(g, r):
+        return ring.ring_allreduce(g, data[r], codec="int8",
+                                   ef_tag="w", timeout=30)
+
+    reformed = _run_world(2, round_w2, "ef-reform")
+    fresh = _run_world(2, round_w2, "ef-fresh-ref")
+    for a, b in zip(reformed, fresh):
+        np.testing.assert_array_equal(a, b)
+    ring.purge_group("ef-reform")
+    ring.purge_group("ef-fresh-ref")

@@ -1,0 +1,221 @@
+"""Deterministic fault-injection harness.
+
+The chaos layer's core promise: the same injection config yields the
+same action at the same site occurrence, every run — so a failure a
+chaos test provokes is exactly reproducible.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu._private import fault_injection as fi
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    fi.clear()
+    yield
+    fi.clear()
+
+
+# ---------------------------------------------------------------------------
+# spec semantics
+# ---------------------------------------------------------------------------
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError):
+        fi.configure([{"action": "die"}])  # site missing
+    with pytest.raises(ValueError):
+        fi.configure([{"site": "x", "action": "meltdown"}])
+    fi.configure(None)
+    assert not fi.enabled()
+
+
+def test_disabled_is_noop():
+    assert fi.fire("anything", rank=3) is None
+    assert fi.hits() == []
+
+
+def test_count_and_after_semantics():
+    fi.configure([{"site": "s", "action": "drop", "after": 2, "count": 2}])
+    acts = [fi.fire("s") for _ in range(6)]
+    assert acts == [None, None, "drop", "drop", None, None]
+    # the hit log records exactly the tripped occurrences, in order
+    assert [h["occurrence"] for h in fi.hits()] == [2, 3]
+
+
+def test_match_is_subset_equality():
+    fi.configure([{"site": "s", "match": {"rank": 1, "chunk": 0},
+                   "action": "drop", "count": 0}])
+    assert fi.fire("s", rank=0, chunk=0) is None
+    assert fi.fire("s", rank=1, chunk=1) is None
+    assert fi.fire("s", rank=1, chunk=0, extra="ignored") == "drop"
+    assert fi.fire("other", rank=1, chunk=0) is None
+
+
+def test_die_raises_injected_fault():
+    fi.configure([{"site": "s", "match": {"rank": 2}, "action": "die"}])
+    fi.fire("s", rank=0)
+    with pytest.raises(fi.InjectedFault, match="injected fault at s"):
+        fi.fire("s", rank=2)
+    # count=1: the next matching occurrence passes
+    assert fi.fire("s", rank=2) is None
+
+
+def test_delay_sleeps_then_proceeds():
+    fi.configure([{"site": "s", "action": "delay", "delay_s": 0.2}])
+    t0 = time.monotonic()
+    assert fi.fire("s") is None
+    assert time.monotonic() - t0 >= 0.2
+
+
+def test_composable_specs_record_in_trip_order():
+    fi.configure([
+        {"site": "a", "action": "drop"},
+        {"site": "b", "action": "dup", "count": 2},
+    ])
+    assert fi.fire("b") == "dup"
+    assert fi.fire("a") == "drop"
+    assert fi.fire("b") == "dup"
+    log = fi.hits()
+    assert [(h["site"], h["action"]) for h in log] == [
+        ("b", "dup"), ("a", "drop"), ("b", "dup")]
+    assert [h["seq"] for h in log] == [1, 2, 3]
+
+
+def test_env_spec_adopted_once(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_FAULT_SPEC", json.dumps(
+        [{"site": "env-site", "action": "drop"}]))
+    fi._env_loaded = False
+    try:
+        assert fi.enabled()
+        assert fi.fire("env-site") == "drop"
+    finally:
+        fi.clear()
+        fi._env_loaded = True  # don't re-adopt in later tests
+
+
+# ---------------------------------------------------------------------------
+# determinism through the real ring engine (threaded fake ranks)
+# ---------------------------------------------------------------------------
+
+
+class _Net:
+    def __init__(self):
+        self.cond = threading.Condition()
+        self.msgs = {}
+
+    def put(self, key, val):
+        with self.cond:
+            self.msgs[key] = val
+            self.cond.notify_all()
+
+    def take(self, key, timeout):
+        deadline = time.monotonic() + timeout
+        with self.cond:
+            while key not in self.msgs:
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    raise TimeoutError(key)
+                self.cond.wait(min(rem, 0.1))
+            return self.msgs.pop(key)
+
+
+class _FakeGroup:
+    def __init__(self, net, name, world, rank):
+        self.net = net
+        self.name = name
+        self.world_size = world
+        self.rank = rank
+        self.seq = 0
+
+    def _next_seq(self):
+        self.seq += 1
+        return self.seq
+
+    def _send_obj(self, dst, seq, tag, obj, fire=False):
+        from ray_tpu._private import serialization
+
+        self.net.put((dst, self.name, seq, self.rank, tag),
+                     serialization.pack_payload(obj))
+
+    def _recv_obj(self, src, seq, tag, timeout=None, op=None):
+        from ray_tpu._private import serialization
+
+        msg = self.net.take((self.rank, self.name, seq, src, tag),
+                            timeout or 30)
+        return serialization.unpack_payload(msg)
+
+
+def _chaos_allreduce_run(spec):
+    """One threaded world-2 allreduce under `spec`; returns
+    (per-rank outcome strings, injection hit log)."""
+    from ray_tpu.collective import ring
+
+    fi.clear()
+    fi.configure(spec)
+    net = _Net()
+    outcome = [None, None]
+
+    def go(r):
+        data = np.arange(64, dtype=np.float32) * (r + 1)
+        try:
+            ring.ring_allreduce(_FakeGroup(net, "chaos", 2, r), data,
+                                timeout=2.0)
+            outcome[r] = "ok"
+        except fi.InjectedFault:
+            outcome[r] = "died"
+        except TimeoutError:
+            outcome[r] = "timeout"
+
+    threads = [threading.Thread(target=go, args=(r,)) for r in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    log = fi.hits()
+    fi.clear()
+    return outcome, log
+
+
+def test_chaos_run_is_deterministic_across_repeats():
+    """Acceptance: the same injection config yields the same abort site
+    (site + occurrence + full ctx) across repeated runs."""
+    spec = [{"site": "ring.send",
+             "match": {"rank": 1, "op": "ar:rs0", "chunk": 0},
+             "action": "die"}]
+    runs = [_chaos_allreduce_run(spec) for _ in range(3)]
+    for outcome, log in runs:
+        assert outcome[1] == "died"      # the victim dies at its site
+        assert outcome[0] == "timeout"   # fake ranks have no abort path
+        assert len(log) == 1
+    sites = [(h["site"], h["occurrence"], tuple(sorted(h["ctx"].items())))
+             for _, (h,) in runs]
+    assert sites[0] == sites[1] == sites[2]
+    assert sites[0][0] == "ring.send"
+    assert dict(sites[0][2])["rank"] == 1
+    assert dict(sites[0][2])["op"] == "ar:rs0"
+
+
+def test_chaos_drop_then_dup_compose():
+    """drop + dup on distinct chunks of the same op: the dup'd frame
+    overwrites idempotently, the dropped one times the receiver out —
+    and both injections are recorded deterministically."""
+    spec = [
+        {"site": "ring.send", "match": {"rank": 0, "chunk": 0},
+         "action": "drop"},
+        {"site": "ring.send", "match": {"rank": 1, "chunk": 0},
+         "action": "dup"},
+    ]
+    outcome, log = _chaos_allreduce_run(spec)
+    # rank 0's dropped reduce-scatter frame strands rank 1; rank 0
+    # still receives rank 1's (duplicated, idempotent) frame for the
+    # reduce-scatter but starves in the all-gather
+    assert outcome == ["timeout", "timeout"]
+    assert {(h["site"], h["action"], h["ctx"]["rank"]) for h in log} == {
+        ("ring.send", "drop", 0), ("ring.send", "dup", 1)}

@@ -248,9 +248,13 @@ def test_outbound_transfer_pacing_backpressure():
         class FakeTransport:
             def __init__(self):
                 self.buffered = 0
+                self.limits = None
 
             def get_write_buffer_size(self):
                 return self.buffered
+
+            def set_write_buffer_limits(self, high=None, low=None):
+                self.limits = (high, low)
 
         class FakeWriter:
             def __init__(self, t):
@@ -260,6 +264,14 @@ def test_outbound_transfer_pacing_backpressure():
             def __init__(self, t):
                 self.writer = FakeWriter(t)
                 self.peer = ("10.0.0.9", 1234)
+                self.state = {}
+
+            async def drain(self):
+                # transport-wakeup analog of the real ServerConn.drain:
+                # resolves once the buffer recedes under the low mark
+                low = (self.writer.transport.limits or (None, 0))[1] or 0
+                while self.writer.transport.buffered > low:
+                    await asyncio.sleep(0.005)
 
         slow = FakeTransport()
         slow.buffered = window + 1  # receiver backed up
@@ -284,5 +296,9 @@ def test_outbound_transfer_pacing_backpressure():
         fast_r, fast_dt, slow_r = c.io.run(scenario(), timeout=60)
         assert fast_r == {"served": True} and slow_r == {"served": True}
         assert fast_dt < 0.05  # unblocked peer never waits
+        # the pacing wait is transport-event-driven: water marks were
+        # set to the window on the paced peer's connection
+        assert slow.limits == (window, window // 2)
+        assert fast.limits is None  # fast path never touches limits
     finally:
         c.shutdown()
