@@ -47,7 +47,10 @@ class RemoteDriverWorker(CoreWorker):
         r = self.agent.call("store_get", {"object_id": oid}, timeout=300)
         if r is None:
             return None
-        parts = serialization.unpack_parts(r["meta_table"], r["data"])
+        # the body arrives out-of-band (zero-copy serve on the agent);
+        # "data" kept for compatibility with inline-framing servers
+        data = r["oob"][0] if r.get("oob") else r["data"]
+        parts = serialization.unpack_parts(r["meta_table"], data)
         return serialization.loads_oob(parts[0], parts[1:])
 
 

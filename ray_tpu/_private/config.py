@@ -36,6 +36,12 @@ _DEFS: dict[str, Any] = {
     # push_manager.h:29 per-peer in-flight chunk windows): bytes of
     # object chunks one node will serve CONCURRENTLY to one peer
     "transfer_outbound_window_bytes": 32 * 1024 * 1024,
+    # cross-host pull pipelining: concurrent in-flight chunk requests
+    # per pull (sized so depth * chunk == the 32MB outbound window —
+    # the sender paces at exactly the window, the puller keeps the pipe
+    # full instead of paying one RTT per 4MB chunk). When the directory
+    # reports >1 holder, the in-flight window is striped across sources.
+    "transfer_pull_pipeline_depth": 8,
     # queued-path pipelining: tasks the dispatcher may stack into one
     # pool worker's exec queue when no idle worker matches and the pool
     # is at cap (the queued analog of lease-push pipelining)

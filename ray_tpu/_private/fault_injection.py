@@ -32,8 +32,12 @@ Actions:
 Instrumented sites (grow as needed): ``ring.send`` / ``ring.recv``
 (per-chunk, ctx: group/rank/op/step/chunk), ``collective.send``
 (per-frame, ctx: group/rank/dst/tag), ``agent.heartbeat`` (per beat,
-ctx: node). Sites are zero-overhead when no spec is configured (one
-module-flag check, no lock).
+ctx: node), ``object.read_chunk`` (per served object chunk, ctx:
+oid/offset; ``drop`` surfaces as a retryable ``{"busy": True}``
+refusal to the puller, ``delay``/``stall`` are awaited on the agent's
+event loop via :func:`fire_async` so one slow chunk does not freeze
+every other transfer on the node). Sites are zero-overhead when no
+spec is configured (one module-flag check, no lock).
 
 Every tripped spec is appended to an in-process hit log queryable via
 :func:`hits` — chaos tests assert determinism by comparing logs across
@@ -189,6 +193,26 @@ def fire(site: str, **ctx: Any) -> str | None:
     """
     if not enabled():
         return None
+    action, delay_s = _fire_common(site, ctx)
+    if action in ("delay", "stall"):
+        time.sleep(delay_s)
+        return None
+    return action
+
+
+def fire_async(site: str, **ctx: Any) -> tuple[str | None, float]:
+    """:func:`fire` for sites on an asyncio event loop: ``delay`` /
+    ``stall`` are NOT slept here — the (action, seconds) pair is
+    returned so the caller can ``await asyncio.sleep(seconds)`` instead
+    of blocking the whole loop (which would stall every other transfer
+    and defeat tests that measure pipelining). ``die``/``exit`` behave
+    exactly like :func:`fire`."""
+    if not enabled():
+        return None, 0.0
+    return _fire_common(site, ctx)
+
+
+def _fire_common(site: str, ctx: dict) -> tuple[str | None, float]:
     fired: dict | None = None
     with _lock:
         for s in _specs:
@@ -210,17 +234,16 @@ def fire(site: str, **ctx: Any) -> str | None:
             _hits.append(fired)
             break  # first matching spec wins (deterministic ordering)
     if fired is None:
-        return None
+        return None, 0.0
     try:
         _get_metrics().inc(1, {"site": site, "action": fired["action"]})
     except Exception:  # noqa: BLE001 — accounting never blocks injection
         pass
     action = fired["action"]
-    if action in ("delay", "stall"):
-        time.sleep(fired["delay_s"])
-        return None
     if action == "die":
         raise InjectedFault(site, fired["ctx"])
     if action == "exit":
         os._exit(fired["exit_code"])
-    return action  # "drop" / "dup": the call site implements the effect
+    # "drop" / "dup": the call site implements the effect;
+    # "delay" / "stall": the caller sleeps (sync) or awaits (async)
+    return action, fired["delay_s"]

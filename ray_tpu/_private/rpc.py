@@ -12,6 +12,15 @@ Frame: 4-byte LE length | msgpack array.
   [1, reqid, ok, payload]       response (payload = result | error string)
   [2, channel, payload]         push (server -> client pubsub)
   [3, method, payload]          one-way request (no response)
+  [4, reqid, ok, payload, [n0, n1, ...]]
+                                out-of-band response header: the frame is
+                                followed by len(ns) RAW buffers of those
+                                byte sizes written straight to the
+                                transport (no msgpack re-framing, no
+                                length cap). Handlers produce one by
+                                returning an OobReply; the client
+                                attaches the received buffers to the
+                                result dict under "oob".
 
 Payloads are msgpack-native structures; binary user data rides as msgpack
 bin (zero-copy on decode via memoryview).
@@ -31,7 +40,7 @@ import msgpack
 
 logger = logging.getLogger(__name__)
 
-REQUEST, RESPONSE, PUSH, ONEWAY = 0, 1, 2, 3
+REQUEST, RESPONSE, PUSH, ONEWAY, RESPONSE_OOB = 0, 1, 2, 3, 4
 _LEN = struct.Struct("<I")
 MAX_FRAME = 1 << 31
 # fire() outboxes stop writing to the transport past this much buffered
@@ -85,6 +94,41 @@ def _write_frame(writer: asyncio.StreamWriter, msg: Any) -> None:
 
 
 Handler = Callable[..., Awaitable[Any]]
+
+
+class OobReply:
+    """Zero-copy handler reply: `payload` rides the normal msgpack header
+    frame; `bufs` (bytes-like, typically memoryviews over a shared-memory
+    segment) are written RAW to the transport right behind it — no
+    bytes() materialization, no msgpack re-framing, no MAX_FRAME cap.
+
+    `release` (optional) is invoked exactly once after every buffer has
+    been handed to the transport (asyncio copies-or-sends on write(), so
+    that is the safe point to drop a shm pin backing the views) — or on
+    a write failure / one-way misuse, so pins can never leak.
+
+    Client side: the buffers arrive as `result["oob"]` (list of bytes,
+    in order) when `payload` is a dict."""
+
+    __slots__ = ("payload", "bufs", "release")
+
+    def __init__(self, payload: Any, bufs: list, release=None):
+        self.payload = payload
+        self.bufs = list(bufs)
+        self.release = release
+
+    def close(self):
+        rel, self.release = self.release, None
+        if rel is not None:
+            try:
+                rel()
+            except Exception:  # noqa: BLE001 — release is best-effort
+                logger.exception("OobReply release failed")
+
+    @staticmethod
+    def buf_sizes(bufs) -> list[int]:
+        return [b.nbytes if isinstance(b, memoryview) else len(b)
+                for b in bufs]
 
 
 class ServerConn:
@@ -224,6 +268,29 @@ class RpcServer:
         st[0] += 1
         st[1] += 0 if ok else 1
         st[2] += time.monotonic() - t0
+        if isinstance(result, OobReply):
+            oob, result = result, None
+            if reqid is None:
+                oob.close()  # one-way caller: nowhere to send buffers
+                return
+            try:
+                # header + raw buffers written back to back with no await
+                # in between: concurrent handler responses on this
+                # connection cannot interleave into the buffer stream
+                _write_frame(conn.writer, [
+                    RESPONSE_OOB, reqid, ok, oob.payload,
+                    OobReply.buf_sizes(oob.bufs),
+                ])
+                for b in oob.bufs:
+                    conn.writer.write(b)
+            except (ConnectionError, RuntimeError):
+                oob.close()
+                return
+            # the transport has copied-or-sent every view: safe to drop
+            # the backing pin BEFORE the (possibly slow) drain
+            oob.close()
+            await conn.drain()
+            return
         if reqid is not None:
             try:
                 _write_frame(conn.writer, [RESPONSE, reqid, ok, result])
@@ -284,6 +351,21 @@ class AsyncRpcClient:
                 if kind == RESPONSE:
                     _, reqid, ok, payload = msg
                     fut = self._pending.pop(reqid, None)
+                    if fut is not None and not fut.done():
+                        if ok:
+                            fut.set_result(payload)
+                        else:
+                            fut.set_exception(RpcError(payload))
+                elif kind == RESPONSE_OOB:
+                    _, reqid, ok, payload, sizes = msg
+                    # the raw buffers follow the header on the stream and
+                    # MUST be consumed even if the caller gave up (timed
+                    # out / disconnected) — they are part of the framing
+                    bufs = [await self._reader.readexactly(n)
+                            for n in sizes]
+                    fut = self._pending.pop(reqid, None)
+                    if ok and isinstance(payload, dict):
+                        payload["oob"] = bufs
                     if fut is not None and not fut.done():
                         if ok:
                             fut.set_result(payload)

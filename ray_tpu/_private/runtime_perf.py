@@ -146,6 +146,132 @@ def run_collective_benchmarks(*, quick: bool = False) -> list[dict]:
     return results
 
 
+def run_transfer_benchmarks(*, quick: bool = False) -> list[dict]:
+    """The `transfer` family: the object data plane under the zero-copy
+    discipline — single-copy put at 1MB/64MB, and cross-node pull of a
+    64MB object with 1 vs 2 source locations (pipelined chunk window,
+    striped across holders) vs a sequential depth=1 pull. The pull tier
+    runs on a dedicated in-process mini-cluster (control plane + 3
+    agents, no driver) so it measures the agent-to-agent chunk path."""
+    import os as _os
+    import uuid
+
+    from ray_tpu._private import config as _cfg
+    from ray_tpu._private.rpc import EventLoopThread
+    from ray_tpu.core.control_plane import ControlPlane
+    from ray_tpu.core.node_agent import NodeAgent
+
+    results = []
+
+    def record(name, per_s, **extra):
+        r = {"name": name, "per_s": round(per_s, 2), "unit": "ops/s",
+             **extra}
+        results.append(r)
+        print(json.dumps(r), flush=True)
+
+    # -- put tier (driver-attached store; requires ray_tpu.init'd) --
+    mb = np.zeros(1024 * 1024, dtype=np.uint8)
+    results.append(timeit("transfer put 1MB (zero-copy)",
+                          lambda: ray_tpu.put(mb),
+                          windows=1 if quick else 3))
+    print(json.dumps(results[-1]), flush=True)
+    big = np.zeros(64 * 1024 * 1024, dtype=np.uint8)
+
+    def put64():
+        r = ray_tpu.put(big)
+        ray_tpu.free([r])
+
+    results.append(timeit("transfer put 64MB", put64,
+                          windows=1 if quick else 3))
+    print(json.dumps(results[-1]), flush=True)
+
+    # -- cross-node pull tier (dedicated mini-cluster) --
+    io = EventLoopThread("ray_tpu-transfer-bench")
+    cp = ControlPlane()
+    head_port = io.run(cp.start())
+    sid = uuid.uuid4().hex[:8]
+    agents = [
+        NodeAgent("127.0.0.1", head_port,
+                  resources={"CPU": 1.0, "memory": 2.0 * 2**30},
+                  store_capacity=512 * 1024 * 1024,
+                  session_id=f"xfer{sid}{i}")
+        for i in range(3)
+    ]
+    for a in agents:
+        io.run(a.start())
+    nbytes = 64 * 1024 * 1024
+    blob = _os.urandom(nbytes)
+
+    def seed(agent):
+        oid = _os.urandom(16)
+        agent.store.put_bytes(oid, blob, metadata=b"")
+        io.run(agent.rpc_object_sealed(None,
+                                       {"object_id": oid, "size": nbytes}))
+        return oid
+
+    def pull(dst, oid):
+        t0 = time.perf_counter()
+        ok = io.run(dst.rpc_fetch_object(
+            None, {"object_id": oid, "timeout": 120}))
+        dt = time.perf_counter() - t0
+        assert ok, "bench pull failed"
+        return dt
+
+    try:
+        iters = 2 if quick else 3
+        depth = _cfg.get("transfer_pull_pipeline_depth")
+        # sequential baseline: one chunk request in flight at a time
+        _cfg.set_system_config({"transfer_pull_pipeline_depth": 1})
+        seq = []
+        for _ in range(iters):
+            oid = seed(agents[0])
+            seq.append(pull(agents[1], oid))
+            agents[1].store.delete(oid)
+            agents[0].store.pin(oid, False)
+            agents[0].store.delete(oid)
+        _cfg.set_system_config({"transfer_pull_pipeline_depth": depth})
+        record("cross-node pull 64MB (sequential depth=1)",
+               1.0 / min(seq), gb_per_s=round(nbytes / min(seq) / 1e9, 3))
+        # pipelined, 1 source
+        one = []
+        for _ in range(iters):
+            oid = seed(agents[0])
+            one.append(pull(agents[1], oid))
+            agents[1].store.delete(oid)
+            agents[0].store.pin(oid, False)
+            agents[0].store.delete(oid)
+        record("cross-node pull 64MB (1 source)", 1.0 / min(one),
+               gb_per_s=round(nbytes / min(one) / 1e9, 3),
+               max_inflight=(agents[1].transfer_stats["last_pull"] or
+                             {}).get("max_inflight"))
+        # pipelined, 2 sources (striped)
+        two = []
+        for _ in range(iters):
+            oid = seed(agents[0])
+            pull(agents[1], oid)  # second holder
+            two.append(pull(agents[2], oid))
+            for a in agents[1:]:
+                a.store.delete(oid)
+            agents[0].store.pin(oid, False)
+            agents[0].store.delete(oid)
+        record("cross-node pull 64MB (2 sources)", 1.0 / min(two),
+               gb_per_s=round(nbytes / min(two) / 1e9, 3),
+               sources=(agents[2].transfer_stats["last_pull"] or
+                        {}).get("sources"))
+    finally:
+        for a in agents:
+            try:
+                io.run(a.stop(), timeout=10)
+            except Exception:
+                pass
+        try:
+            io.run(cp.stop(), timeout=10)
+        except Exception:
+            pass
+        io.stop()
+    return results
+
+
 def run_benchmarks(*, quick: bool = False) -> list[dict]:
     results = []
     windows = 1 if quick else 3
@@ -241,6 +367,9 @@ def run_benchmarks(*, quick: bool = False) -> list[dict]:
     results.append(r)
     print(json.dumps(r), flush=True)
 
+    # ---- transfer (zero-copy put + pipelined cross-node pull) ----
+    results.extend(run_transfer_benchmarks(quick=quick))
+
     # ---- collective (DCN star vs ring vs ring+int8) ----
     results.extend(run_collective_benchmarks(quick=quick))
 
@@ -294,7 +423,8 @@ def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--out", default=None, help="write results JSON here")
     p.add_argument("--quick", action="store_true")
-    p.add_argument("--family", default="all", choices=["all", "collective"],
+    p.add_argument("--family", default="all",
+                   choices=["all", "collective", "transfer"],
                    help="run one workload family only")
     p.add_argument("--in-process", action="store_true",
                    help="head in the driver process (debug only)")
@@ -311,6 +441,8 @@ def main(argv=None):
     try:
         if args.family == "collective":
             results = run_collective_benchmarks(quick=args.quick)
+        elif args.family == "transfer":
+            results = run_transfer_benchmarks(quick=args.quick)
         else:
             results = run_benchmarks(quick=args.quick)
     finally:

@@ -50,12 +50,28 @@ def serialize(obj: Any) -> tuple[bytes, list[pickle.PickleBuffer], list]:
     return meta, buffers, refs
 
 
+def serialize_views(obj: Any) -> tuple[bytes, list[memoryview], list, int]:
+    """serialize() + flat byte views of the out-of-band buffers.
+
+    Returns (meta, views, contained_object_refs, total_size). The views
+    are zero-copy windows over the caller's own buffers (numpy arrays
+    etc.) — valid only while `obj` is alive and unmutated, so they must
+    be consumed (written to the store / the wire) before returning to
+    user code. Sizes come from memoryview.nbytes: nothing is
+    materialized on this path."""
+    meta, bufs, refs = serialize(obj)
+    views = [b.raw() for b in bufs]
+    return meta, views, refs, len(meta) + sum(v.nbytes for v in views)
+
+
 def deserialize(meta: bytes | memoryview, buffers: list) -> Any:
     return pickle.loads(meta, buffers=buffers)
 
 
 def dumps_oob(obj: Any) -> tuple[bytes, list]:
-    """Serialize to (meta, [bytes-like]) for wire transport."""
+    """Serialize to (meta, [bytes-like]) for wire transport. The buffer
+    views are zero-copy (see serialize_views); msgpack packs memoryviews
+    natively, so wire framing costs one copy total."""
     meta, buffers, _ = serialize(obj)
     return meta, [b.raw() for b in buffers]
 
@@ -111,10 +127,14 @@ def pack_callable(fn) -> list:
 # remote data plane): [<I n][n x <Q sizes] table in the object metadata,
 # concatenated parts (meta + oob buffers) in the object body --
 
+def _nbytes(b) -> int:
+    return b.nbytes if isinstance(b, memoryview) else len(b)
+
+
 def pack_part_table(meta: bytes, bufs) -> tuple[bytes, int]:
     import struct
 
-    sizes = [len(meta)] + [len(b) for b in bufs]
+    sizes = [_nbytes(meta)] + [_nbytes(b) for b in bufs]
     return struct.pack(f"<I{len(sizes)}Q", len(sizes), *sizes), sum(sizes)
 
 

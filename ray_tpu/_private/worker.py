@@ -89,7 +89,7 @@ class _ResultEntry:
     """One object's owner-side state."""
 
     __slots__ = ("event", "payload", "error", "in_plasma", "size", "spec",
-                 "reconstructing", "escaped")
+                 "reconstructing", "escaped", "owned")
 
     def __init__(self):
         self.event = threading.Event()
@@ -102,6 +102,10 @@ class _ResultEntry:
         # the ref left this process (task arg, nested in a stored value):
         # the owner-side entry must outlive the local refcount
         self.escaped = False
+        # this process owns the object (put / submitted the producing
+        # task): its resolution is PUSHED to us, so gets may park on the
+        # event instead of polling the directory
+        self.owned = False
 
     @property
     def ready(self):
@@ -498,6 +502,29 @@ class CoreWorker:
                 logger.exception("node-dead listener failed")
         if len(self._dead_nodes) > 1000:
             self._dead_nodes.pop()
+        # Proactive lineage reconstruction: the directory names objects
+        # whose LAST copy died with the node (no surviving location, no
+        # spill file). Resubmit their producing tasks NOW — consumers
+        # hit a warm (or already recomputed) copy instead of paying a
+        # fetch-miss timeout first (reference object_recovery_manager
+        # RecoverObject, triggered here from the death event).
+        lost = [oid for oid in payload.get("lost_objects") or ()
+                if (e := self.memory.get(oid)) is not None
+                and e.spec is not None]
+        if lost:
+            def _recover(oids=lost):
+                for oid in oids:
+                    ent = self.memory.get(oid)
+                    if ent is None:
+                        continue
+                    try:
+                        self._maybe_reconstruct(oid, ent)
+                    except Exception:  # noqa: BLE001 — best effort
+                        logger.exception("proactive reconstruction of %s "
+                                         "failed", oid.hex()[:12])
+            # one thread for the whole event; _maybe_reconstruct makes
+            # blocking head/agent calls that must not run on the io loop
+            threading.Thread(target=_recover, daemon=True).start()
         stranded = [tid for tid, nid in self._task_nodes.items()
                     if nid == dead]
         for tid in stranded:
@@ -655,12 +682,17 @@ class CoreWorker:
     # ------------- put / get / wait -------------
 
     def put(self, value) -> bytes:
-        """Store a value; returns object id (we are the owner)."""
+        """Store a value; returns object id (we are the owner).
+
+        Single-copy: serialization keeps pickle-5 buffers as memoryviews
+        over the caller's arrays; the plasma path writes them straight
+        into the shm segment (the ONLY copy), the inline path
+        materializes once into the owner entry (the payload must not
+        alias caller buffers the user may mutate)."""
         oid = ObjectID.for_put(
             WorkerID(self.worker_id), self.put_counter.next()
         ).binary()
-        meta, bufs, nested_refs = serialization.serialize(value)
-        payload = [meta, [bytes(b.raw()) for b in bufs]]
+        meta, views, nested_refs, size = serialization.serialize_views(value)
         if nested_refs:
             # refs serialized inside this value stay alive as long as the
             # value does (reference AddNestedObjectIds semantics)
@@ -674,18 +706,21 @@ class CoreWorker:
                                {"outer": oid, "inners": inners})
             except (rpc.ConnectionLost, rpc.RpcError, OSError):
                 pass
-        size = len(payload[0]) + sum(len(b) for b in payload[1])
         e = self._entry(oid)
+        e.owned = True
         if size <= INLINE_MAX:
-            e.payload = payload
+            e.payload = [meta, [bytes(v) for v in views]]
         else:
-            self._put_plasma(oid, payload)
+            self._put_plasma(oid, [meta, views])
             e.in_plasma = True
             e.size = size
         e.event.set()
         return oid
 
     def _put_plasma(self, oid: bytes, payload):
+        """payload = [meta, bufs]; bufs may be memoryviews (single-copy
+        put path) or bytes — either way each part is written into the
+        shm segment exactly once."""
         meta, bufs = payload
         # layout: size table in the object metadata, concatenated parts in
         # the body, so deserialize can slice zero-copy (shared with the
@@ -709,12 +744,28 @@ class CoreWorker:
                     time.sleep(0.05)
         off = 0
         for part in [meta] + list(bufs):
-            n = len(part)
+            n = serialization._nbytes(part)
             wbuf.data[off:off + n] = part
             off += n
         wbuf.meta[:] = table
         wbuf.seal()
-        self.agent.call("object_sealed", {
+        # Pin locally BEFORE the async announce: the agent's primary pin
+        # only lands with the announce, and an unpinned fresh object
+        # could be LRU-evicted by a concurrent pressure eviction in the
+        # window. The agent re-pins idempotently; free()/spill unpin.
+        self.store.pin(oid, True)
+        # Async announce (coalesced fire): the seal itself is durable in
+        # the local store, so put() need not pay the worker→agent→head
+        # round trip per object — remote consumers rendezvous through the
+        # directory's object_wait_location long-poll, which fires once
+        # the announce lands. A free() racing the announce is healed by
+        # the directory's freed-tombstone path. Loss bound: fire drops
+        # frames only when THIS worker↔agent connection breaks, and that
+        # connection is not reconnecting — a worker that lost its
+        # node-local agent cannot submit, lease, or fetch either (node
+        # fate-sharing), so a silently unannounced-but-sealed object
+        # cannot outlive the failure domain that produced it.
+        self.agent.fire("object_sealed", {
             "object_id": oid, "owner": self.owner_address, "size": total,
         })
 
@@ -775,6 +826,17 @@ class CoreWorker:
             remaining = None if deadline is None else deadline - time.monotonic()
             if remaining is not None and remaining <= 0:
                 raise GetTimeoutError(f"get timed out on {oid.hex()[:12]}")
+            if deadline is None and e.owned:
+                # owned + nothing to resolve remotely: the result (or a
+                # failure-path error) is PUSHED to this process, so park
+                # on the event — the hot path takes zero poll wakeups.
+                # The slice is bounded (not infinite) as a lost-push
+                # backstop: result pushes are fire-and-forget, so a
+                # push dropped on a breaking connection is only
+                # recoverable through the directory re-check on wakeup
+                # (plasma results announce their location out of band).
+                e.event.wait(timeout=0.5)
+                continue
             e.event.wait(timeout=0.1 if remaining is None
                          else min(0.1, remaining))
 
@@ -907,11 +969,33 @@ class CoreWorker:
         pending = list(object_ids)
         blocked = False  # executor parked here: agent backfills the slot
         try:
+            # first passes come one interval in — not on entry, where a
+            # wide wait() would burst one directory call per ref
+            last_resolve = time.monotonic()
+            last_resolve_owned = last_resolve
             while True:
                 still = []
+                # Owned pending refs are PUSHED to us — polling the
+                # directory for them is pure head load (a wait() over a
+                # large in-flight round once drove thousands of
+                # object_locations calls/s, starving the very dispatch
+                # loop that had to complete the tasks). Borrowed refs
+                # resolve remotely at 10 passes/s; owned refs get a 1/s
+                # backstop pass because result pushes are fire-and-
+                # forget — a push lost on a breaking connection is only
+                # recoverable through the directory (plasma results
+                # announce their location out of band).
+                now = time.monotonic()
+                resolve = now - last_resolve >= 0.1
+                if resolve:
+                    last_resolve = now
+                resolve_owned = now - last_resolve_owned >= 1.0
+                if resolve_owned:
+                    last_resolve_owned = now
                 for oid in pending:
                     e = self._entry(oid)
-                    if not e.ready:
+                    if not e.ready and (resolve_owned
+                                        or (resolve and not e.owned)):
                         self._try_resolve_remote(oid)
                     if e.ready:
                         ready.append(oid)
@@ -1012,7 +1096,9 @@ class CoreWorker:
             for i in range(n_ret)
         ]
         for oid in return_ids:
-            self._entry(oid).spec = spec
+            e = self._entry(oid)
+            e.spec = spec
+            e.owned = True
         # Submitted-task references: args stay pinned until the task
         # completes or exhausts retries (reference_count.h:115).
         self._pin_task_deps(task_id, list(deps))
@@ -1601,8 +1687,8 @@ class CoreWorker:
         travel in the spec (reference: dependency resolver inlining,
         transport/dependency_resolver.cc).
         """
-        meta, bufs, refs = serialization.serialize((args, kwargs))
-        payload = [meta, [bytes(b.raw()) for b in bufs]]
+        meta, views, refs, size = serialization.serialize_views(
+            (args, kwargs))
         deps: list[bytes] = []
         inline_values: dict[bytes, list] = {}
         for ref in refs:
@@ -1621,19 +1707,21 @@ class CoreWorker:
                 deps_marker = None  # noqa: F841 — documents intent
             else:
                 deps.append(oid)
-        size = len(payload[0]) + sum(len(b) for b in payload[1])
         if size > INLINE_MAX:
-            # big args → plasma object, executor reads locally after staging
+            # big args → plasma object (single-copy: views go straight
+            # into the segment), executor reads locally after staging
             args_oid = ObjectID.for_put(
                 WorkerID(self.worker_id), self.put_counter.next()
             ).binary()
-            self._put_plasma(args_oid, payload)
+            self._put_plasma(args_oid, [meta, views])
             e = self._entry(args_oid)
+            e.owned = True
             e.in_plasma = True
             e.event.set()
             deps.append(args_oid)
             return {"args_oid": args_oid}, deps, inline_values
-        return {"payload": payload}, deps, inline_values
+        return {"payload": [meta, [bytes(v) for v in views]]}, \
+            deps, inline_values
 
     # ------------- actor submission (owner side) -------------
 
@@ -1724,7 +1812,7 @@ class CoreWorker:
             for i in range(num_returns)
         ]
         for oid in return_ids:
-            self._entry(oid)
+            self._entry(oid).owned = True
         self._actor_pending.setdefault(actor_id, set()).add(task_id)
         self._send_actor_call(actor_id, call)
         return return_ids

@@ -1393,16 +1393,26 @@ class ControlPlane:
         cli = self._agent_clients.pop(node_id, None)
         if cli is not None:
             await cli.close()
-        # objects on that node are gone
+        # Objects on that node are gone. Objects whose LAST copy it was
+        # (no surviving location, no spill file) are LOST: name them in
+        # the node_dead event so owners start lineage reconstruction on
+        # the event instead of on the first fetch miss.
+        lost: list[bytes] = []
         for oid, entry in self.objects.items():
-            entry["locations"].discard(node_id)
+            if node_id in entry["locations"]:
+                entry["locations"].discard(node_id)
+                if not entry["locations"] and not entry.get("spilled"):
+                    lost.append(oid)
         # actors on that node fail (maybe restart elsewhere)
         for aid, a in list(self.actors.items()):
             if a["node_id"] == node_id and a["state"] in (ALIVE, PENDING,
                                                           RESTARTING):
                 await self._on_actor_failed(aid, f"node died: {reason}")
         self.pub.publish("node_dead",
-                         {"node_id": node_id, "reason": reason})
+                         {"node_id": node_id, "reason": reason,
+                          # bounded: a pathological directory should not
+                          # produce an unboundedly large event frame
+                          "lost_objects": lost[:50_000]})
 
     async def _on_disconnect(self, conn: ServerConn):
         if self._stopping:

@@ -36,18 +36,61 @@ from typing import Any
 
 from ray_tpu._private import config as cfg
 from ray_tpu._private import fault_injection, rpc, task_spec
-from ray_tpu._private.rpc import AsyncRpcClient, RpcServer
+from ray_tpu._private.rpc import AsyncRpcClient, OobReply, RpcServer
 from ray_tpu.core import pull_manager
-from ray_tpu.core.object_store import ObjectStoreClient, StoreFullError
+from ray_tpu.core.object_store import (
+    ObjectExistsError,
+    ObjectStoreClient,
+    StoreFullError,
+)
 
 logger = logging.getLogger(__name__)
 
 # Tunables ride the central flag system (ray_config_def.h analog); env
 # RAY_TPU_<NAME> overrides each.
-CHUNK = cfg.get("object_transfer_chunk_bytes")
 IDLE_CULL_S = cfg.get("idle_worker_cull_s")
 SPILL_MAX = cfg.get("task_spill_max_forwards")
 DEP_LOST_S = cfg.get("dep_lost_reconstruct_s")
+
+# Cached serve-side object pins idle longer than this are dropped (an
+# abandoned mid-transfer puller must not pin store memory forever; a
+# striped pull's non-tail sources also land here, so the TTL is short —
+# a live transfer re-requests within milliseconds, never seconds).
+SERVE_PIN_TTL_S = 10.0
+
+
+def _chunk_size() -> int:
+    """Transfer chunk size, read per use (not import time) so tests and
+    `set_system_config` can resize it on a live process."""
+    return int(cfg.get("object_transfer_chunk_bytes"))
+
+
+def _part_chunk(part: dict):
+    """Chunk bytes of a read_object_chunk reply: out-of-band framed
+    ("oob", the zero-copy path) or inline ("chunk", legacy/local)."""
+    oob = part.get("oob")
+    if oob:
+        return oob[0]
+    return part.get("chunk", b"")
+
+
+_xfer_metrics: dict | None = None
+
+
+def _transfer_metrics() -> dict:
+    global _xfer_metrics
+    if _xfer_metrics is None:
+        from ray_tpu.util import metrics as M
+
+        _xfer_metrics = {
+            "bytes": M.Counter(
+                "object_transfer_pull_bytes_total",
+                "bytes pulled from peer object stores"),
+            "inflight_peak": M.Gauge(
+                "object_transfer_pull_inflight_peak",
+                "peak concurrent chunk requests of the latest pull"),
+        }
+    return _xfer_metrics
 
 
 def detect_tpu_chips() -> int:
@@ -161,6 +204,13 @@ class NodeAgent:
         self.bundle_available: dict[tuple[bytes, int], dict] = {}
         self._peer_clients: dict[bytes, AsyncRpcClient] = {}
         self._pull_sched: pull_manager.PullScheduler | None = None
+        # cross-host pull instrumentation (the OpStats complement: proves
+        # the pipeline actually overlaps chunk requests; tests and the
+        # perf harness read it, /metrics exports it)
+        self.transfer_stats: dict = {
+            "pulls": 0, "pull_bytes": 0, "pull_chunks": 0,
+            "pull_max_inflight": 0, "last_pull": None,
+        }
         # worker leases for owner-direct task pushes (lease caching,
         # reference direct_task_transport.h:110): lease_id -> grant
         self.leases: dict[bytes, dict] = {}
@@ -238,6 +288,8 @@ class NodeAgent:
         self._bg.append(asyncio.ensure_future(self._reap_loop()))
         self._bg.append(asyncio.ensure_future(self._dispatch_loop()))
         self._bg.append(asyncio.ensure_future(self._memory_monitor_loop()))
+        self._bg.append(asyncio.ensure_future(self._serve_pin_sweep_loop()))
+        self.server.on_disconnect = self._on_server_disconnect
         logger.info("node agent %s up on %s:%s", self.node_id.hex()[:8],
                     self.host, port)
         return port
@@ -697,11 +749,11 @@ class NodeAgent:
         buf = self.store.get(oid)
         if buf is None:
             return None
-        try:
-            return {"meta_table": bytes(buf.metadata),
-                    "data": bytes(buf.data)}
-        finally:
-            buf.release()
+        # zero-copy serve: the object body rides the out-of-band frame
+        # as a memoryview over the pinned segment; the pin drops once
+        # the transport has consumed it
+        return OobReply({"meta_table": bytes(buf.metadata)},
+                        [buf.data], release=buf.release)
 
     async def rpc_list_logs(self, conn, p):
         """Log files on this node (reference dashboard log_manager)."""
@@ -2112,21 +2164,41 @@ class NodeAgent:
         refusal is retryable — the puller backs off client-side instead
         of pinning a server handler, and its own wall-clock budget then
         bounds how long one flooded location can stall a pull."""
+        if fault_injection.enabled():
+            act, delay_s = fault_injection.fire_async(
+                "object.read_chunk", oid=p["object_id"].hex(),
+                offset=p["offset"])
+            if act in ("delay", "stall"):
+                await asyncio.sleep(delay_s)
+            elif act == "drop":
+                # the chunk is "lost": surface it as the retryable busy
+                # refusal so the puller's backoff path re-requests it
+                return {"busy": True, "retry_after_s": 0.05}
         if conn is not None:
+            # Serve gate: ~2 chunks buffered per connection, not the full
+            # window. Pipelining depth lives in the puller's OUTSTANDING
+            # REQUESTS (queued here, resident and cheap) — responses
+            # stream out of a small transport buffer at line rate. Large
+            # buffered responses would be actively worse: asyncio's
+            # transport memmoves its whole pending bytearray on every
+            # partial send, so a 32MB backlog burns more memory bandwidth
+            # than the payload itself. The configured window remains the
+            # absolute flooded-peer cap.
             window = int(cfg.get("transfer_outbound_window_bytes"))
-            if self._conn_write_buffered(conn) > window:
+            gate = min(window, 2 * _chunk_size())
+            if self._conn_write_buffered(conn) > gate:
                 if not conn.state.get("paced"):
                     conn.state["paced"] = True
                     try:
                         conn.writer.transport.set_write_buffer_limits(
-                            high=window, low=max(1, window // 2))
+                            high=gate, low=max(1, gate // 2))
                     except Exception:  # noqa: BLE001 — transport mid-close
                         pass
                 try:
                     await asyncio.wait_for(conn.drain(), timeout=20.0)
                 except asyncio.TimeoutError:
                     return {"busy": True, "retry_after_s": 0.5}
-        return self._read_object_chunk(p)
+        return self._read_object_chunk(p, conn)
 
     @staticmethod
     def _conn_write_buffered(conn) -> int:
@@ -2135,18 +2207,79 @@ class NodeAgent:
         except Exception:  # noqa: BLE001 — transport mid-close
             return 0
 
-    def _read_object_chunk(self, p):
+    def _read_object_chunk(self, p, conn=None):
+        """Serve one chunk ZERO-COPY: the reply carries a memoryview
+        slice of the pinned shm object through the rpc layer's
+        out-of-band framing (no bytes() materialization, no msgpack
+        re-framing); the pin is released only after the transport has
+        consumed the view.
+
+        The pin is cached per (connection, oid) across the transfer —
+        one store_get/store_release pair per pull instead of one per
+        chunk — and dropped on the final chunk, on disconnect, or by
+        the TTL sweep (an abandoned puller must not pin the store)."""
         oid, offset = p["object_id"], p["offset"]
-        buf = self.store.get(oid)
+        pins = (conn.state.setdefault("serve_pins", {})
+                if conn is not None else None)
+        ent = pins.get(oid) if pins is not None else None
+        buf = ent[0] if ent is not None else self.store.get(oid)
         if buf is None:
             return None
-        try:
-            total = len(buf.data)
-            chunk = bytes(buf.data[offset:offset + CHUNK])
-            return {"total": total, "meta": buf.metadata if offset == 0 else b"",
-                    "chunk": chunk}
-        finally:
-            buf.release()
+        total = buf.data.nbytes
+        end = min(offset + _chunk_size(), total)
+        view = buf.data[offset:end]
+        meta = buf.metadata if offset == 0 else b""
+        if pins is None:
+            # direct/local caller (no transport to hold the view for):
+            # legacy inline copy, release immediately
+            try:
+                return {"total": total, "meta": meta,
+                        "chunk": bytes(view)}
+            finally:
+                buf.release()
+        # Release once this connection has served the whole object,
+        # counted in BYTES — pipelined pulls complete out of order, so
+        # "served the final offset" alone says nothing about earlier
+        # chunks still in flight. Serving the tail chunk also releases:
+        # a STRIPED pull splits the object across sources, so no single
+        # connection ever reaches total — the tail-serving source drops
+        # its pin here and the other sources' pins fall to the idle
+        # sweep (SERVE_PIN_TTL_S). A retried chunk can double-count and
+        # release early; later chunks then simply re-pin.
+        if ent is None:
+            ent = pins[oid] = [buf, time.monotonic(), 0]
+        ent[1] = time.monotonic()
+        ent[2] += end - offset
+        if ent[2] >= total or end >= total:
+            pins.pop(oid, None)
+            release = buf.release
+        else:
+            release = None
+        return OobReply({"total": total, "meta": meta}, [view],
+                        release=release)
+
+    def _release_serve_pins(self, conn, *, older_than: float | None = None):
+        pins = conn.state.get("serve_pins")
+        if not pins:
+            return
+        now = time.monotonic()
+        for oid, ent in list(pins.items()):
+            if older_than is None or now - ent[1] > older_than:
+                pins.pop(oid, None)
+                ent[0].release()
+
+    async def _serve_pin_sweep_loop(self):
+        while not self._dead:
+            await asyncio.sleep(SERVE_PIN_TTL_S / 3)
+            try:
+                for conn in list(self.server.conns):
+                    self._release_serve_pins(conn,
+                                             older_than=SERVE_PIN_TTL_S)
+            except Exception:  # noqa: BLE001 — sweep must not die
+                logger.exception("serve-pin sweep failed")
+
+    async def _on_server_disconnect(self, conn):
+        self._release_serve_pins(conn)
 
     async def rpc_fetch_object(self, conn, p):
         """Local worker asks: make this object present in the node store."""
@@ -2209,20 +2342,22 @@ class NodeAgent:
                 await asyncio.sleep(0.05)
                 continue
             pulled = False
+            clis = []
             for nid in info["locations"]:
                 cli = await self._peer_agent(nid)
-                if cli is None:
-                    continue
+                if cli is not None:
+                    clis.append(cli)
+            if clis:
                 try:
-                    if await self._pull_from(cli, oid):
-                        pulled = True
-                        break
+                    # every reachable holder goes in: the pipelined pull
+                    # stripes its chunk window across all of them and
+                    # fails over chunk-by-chunk
+                    pulled = await self._pull_from(clis, oid)
                 except StoreFullError:
                     # store saturated even after LRU eviction: back off
                     # and retry within the deadline — the admission
                     # watermark keeps concurrent pulls from compounding
                     await asyncio.sleep(0.2)
-                    break
             if pulled:
                 await self.head.call("object_add_location", {
                     "object_id": oid, "node_id": self.node_id,
@@ -2254,35 +2389,146 @@ class NodeAgent:
             await asyncio.sleep(min(backoff, 2.0))
             backoff *= 1.6
 
-    async def _pull_from(self, cli: AsyncRpcClient, oid: bytes) -> bool:
+    async def _await_sealed(self, oid: bytes, timeout: float = 10.0) -> bool:
+        """Another writer (concurrent pull or local producer) holds the
+        unsealed buffer for `oid`: wait for it to seal instead of
+        propagating ObjectExistsError up the pull."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.store.contains(oid):
+                return True
+            await asyncio.sleep(0.01)
+        return False
+
+    async def _pull_from(self, clis, oid: bytes) -> bool:
+        """Pipelined multi-source pull (object_manager.cc:633 redesigned
+        around the pull RTT): chunk 0 establishes total size + metadata,
+        then a sliding window of transfer_pull_pipeline_depth concurrent
+        chunk requests keeps the pipe full — arriving chunks land at
+        their offset in the pre-created write buffer, so out-of-order
+        completion is fine. With several source locations the window is
+        striped across them (round-robin by worker), and a chunk whose
+        assigned source fails retries the remaining sources before the
+        pull gives up. Failure paths abort the half-written buffer."""
+        if not isinstance(clis, (list, tuple)):
+            clis = [clis]
+        t0 = time.monotonic()
         try:
-            first = await self._read_chunk_backoff(cli, oid, 0)
+            first = None
+            for lead in clis:
+                try:
+                    first = await self._read_chunk_backoff(lead, oid, 0)
+                except (rpc.ConnectionLost, rpc.RpcError, OSError):
+                    first = None  # dead lead: try the next holder
+                if first is not None:
+                    break
             if first is None:
                 return False
             total, meta = first["total"], first["meta"]
+            chunk0 = _part_chunk(first)
             if self.store.contains(oid):
                 return True
-            wbuf = self.store.create_object(oid, total, len(meta))
             try:
-                wbuf.data[0:len(first["chunk"])] = first["chunk"]
-                offset = len(first["chunk"])
-                while offset < total:
-                    part = await self._read_chunk_backoff(cli, oid, offset)
+                wbuf = self.store.create_object(oid, total, len(meta))
+            except ObjectExistsError:
+                return await self._await_sealed(oid)
+            try:
+                n0 = len(chunk0)
+                wbuf.data[0:n0] = chunk0
+                if n0 == 0 and total > 0:
+                    wbuf.abort()
+                    return False
+                # step = the SERVER's chunk size (len of a full chunk),
+                # so offsets line up even if our config disagrees
+                offsets = deque(range(n0, total, n0)) if n0 else deque()
+                depth = max(1, int(cfg.get("transfer_pull_pipeline_depth")))
+                st = {"inflight": 0, "peak": 1, "chunks": 1, "failed": False}
+
+                async def read_one(cli, off, want):
+                    """One source's chunk, or None: connection loss /
+                    rpc errors / a WRONG-SIZED reply (a source with a
+                    different chunk-size config would leave a silent
+                    zero gap in the sealed object) all mean 'try the
+                    next source', not 'abort the pull'."""
+                    try:
+                        part = await self._read_chunk_backoff(
+                            cli, oid, off)
+                    except (rpc.ConnectionLost, rpc.RpcError, OSError):
+                        return None
                     if part is None:
-                        wbuf.abort()
-                        return False
-                    chunk = part["chunk"]
-                    wbuf.data[offset:offset + len(chunk)] = chunk
-                    offset += len(chunk)
+                        return None
+                    data = _part_chunk(part)
+                    return data if len(data) == want else None
+
+                async def fetch_chunks(widx: int):
+                    own = clis[widx % len(clis)]
+                    while offsets and not st["failed"]:
+                        off = offsets.popleft()
+                        want = min(n0, total - off)
+                        st["inflight"] += 1
+                        st["peak"] = max(st["peak"], st["inflight"])
+                        try:
+                            data = await read_one(own, off, want)
+                            if data is None:
+                                for alt in clis:
+                                    if alt is own:
+                                        continue
+                                    data = await read_one(alt, off, want)
+                                    if data is not None:
+                                        break
+                        finally:
+                            st["inflight"] -= 1
+                        if data is None:
+                            st["failed"] = True
+                            return
+                        wbuf.data[off:off + len(data)] = data
+                        st["chunks"] += 1
+
+                n_workers = min(depth, len(offsets))
+                if n_workers:
+                    results = await asyncio.gather(
+                        *(fetch_chunks(i) for i in range(n_workers)),
+                        return_exceptions=True,
+                    )
+                    for r in results:
+                        if isinstance(r, BaseException):
+                            st["failed"] = True
+                            if not isinstance(r, (rpc.ConnectionLost,
+                                                  rpc.RpcError, OSError)):
+                                raise r
+                if st["failed"]:
+                    wbuf.abort()
+                    return False
                 if meta:
                     wbuf.meta[:] = meta
                 wbuf.seal()
+                self._record_pull(oid, total, st, len(clis),
+                                  time.monotonic() - t0)
                 return True
             except Exception:
                 wbuf.abort()
                 raise
         except (rpc.ConnectionLost, rpc.RpcError, OSError):
             return False
+
+    def _record_pull(self, oid: bytes, total: int, st: dict,
+                     n_sources: int, dt: float):
+        ts = self.transfer_stats
+        ts["pulls"] += 1
+        ts["pull_bytes"] += total
+        ts["pull_chunks"] += st["chunks"]
+        ts["pull_max_inflight"] = max(ts["pull_max_inflight"], st["peak"])
+        ts["last_pull"] = {
+            "oid": oid.hex(), "bytes": total, "chunks": st["chunks"],
+            "sources": n_sources, "max_inflight": st["peak"],
+            "seconds": round(dt, 6),
+        }
+        try:
+            m = _transfer_metrics()
+            m["bytes"].inc(total)
+            m["inflight_peak"].set(st["peak"])
+        except Exception:  # noqa: BLE001 — metrics never block the pull
+            pass
 
     async def rpc_object_sealed(self, conn, p):
         """Local worker sealed an object: register location + pin primary."""
@@ -2522,6 +2768,7 @@ class NodeAgent:
             "running": len(self.running),
             "store_used": self.store.used_bytes(),
             "store_capacity": self.store.capacity(),
+            "transfer_stats": dict(self.transfer_stats),
         }
 
 

@@ -389,12 +389,13 @@ class Executor(CoreWorker):
         if error is not None:
             msg["error"] = error
         else:
-            payload = serialization.pack_payload(value)
-            size = len(payload[0]) + sum(len(b) for b in payload[1])
+            # single-copy result put: pickle-5 buffer views flow straight
+            # into the shm segment (plasma) or materialize once (inline)
+            meta, views, _refs, size = serialization.serialize_views(value)
             if size <= INLINE_MAX:
-                msg["payload"] = payload
+                msg["payload"] = [meta, [bytes(v) for v in views]]
             else:
-                self._put_plasma(oid, payload)
+                self._put_plasma(oid, [meta, views])
                 msg["in_plasma"] = True
                 msg["size"] = size
         key = (owner["addr"], owner["port"])

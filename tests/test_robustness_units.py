@@ -183,9 +183,12 @@ def test_read_object_chunk_refuses_retryably_on_pacing_deadline():
     out = asyncio.run(na.NodeAgent.rpc_read_object_chunk(
         agent, _Conn, {"object_id": b"x", "offset": 0}))
     assert out == {"busy": True, "retry_after_s": 0.5}
-    # the per-peer wakeup is transport-level: water marks set to the
-    # window once per connection (no 5ms poll loops)
-    assert _Conn.state["limits"] == (window, window // 2)
+    # the per-peer wakeup is transport-level: water marks set once per
+    # connection (no 5ms poll loops) to the serve gate — ~2 chunks, so
+    # responses stream from a small buffer instead of memmoving a
+    # window-sized bytearray on every partial send
+    gate = min(window, 2 * na._chunk_size())
+    assert _Conn.state["limits"] == (gate, gate // 2)
     assert _Conn.state["paced"] is True
 
 
@@ -194,7 +197,7 @@ def test_read_object_chunk_serves_when_under_window():
 
     agent = _agent_shell()
     sentinel = {"total": 3, "meta": b"", "chunk": b"abc"}
-    agent._read_object_chunk = lambda p: sentinel
+    agent._read_object_chunk = lambda p, conn=None: sentinel
 
     class _Conn:
         state = {}

@@ -35,9 +35,107 @@ def test_put_get_floors(cluster):
     kb = np.zeros(1024, dtype=np.uint8)
     ref = ray_tpu.put(b"ok")
     assert _rate(lambda: ray_tpu.get(ref), 200) > 60_000  # measured ~320k/s
-    assert _rate(lambda: ray_tpu.put(kb), 100) > 3_000  # measured ~18k/s
+    assert _rate(lambda: ray_tpu.put(kb), 100) > 3_000  # measured ~16k/s
     mb = np.zeros(1024 * 1024, dtype=np.uint8)
-    assert _rate(lambda: ray_tpu.put(mb), 30) > 150  # measured ~860/s
+    # single-copy put + async seal announce: measured ~1.6k/s in this
+    # GIL-shared fixture (~2.4k/s standalone vs the 790/s baseline); the
+    # floor pins the zero-copy path — the old double-copy+sync-announce
+    # path measured ~860/s here and would fail it
+    assert _rate(lambda: ray_tpu.put(mb), 100) > 1_000  # measured ~1.6k/s
+
+
+def test_put_get_bandwidth_floor(cluster):
+    """Large-object put+get, the weight-publishing path: one memcpy into
+    the shm segment on put, zero-copy view on get. Measured ~6.5 GB/s
+    warm in this fixture (the old path: ~1.3-3 GB/s)."""
+    big = np.zeros(192 * 1024 * 1024, dtype=np.uint8)
+
+    def put_get():
+        r = ray_tpu.put(big)
+        out = ray_tpu.get(r, timeout=60)
+        assert out.nbytes == big.nbytes
+        del out
+        ray_tpu.free([r])
+
+    put_get()  # warm the segment pages
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        put_get()
+        best = max(best, big.nbytes / (time.perf_counter() - t0))
+    assert best > 3.0e9, f"put+get bandwidth {best/1e9:.2f} GB/s"
+
+
+def test_recorded_bench_meets_2x_baseline():
+    """The committed RUNTIME_BENCH.json must hold the ISSUE-9 acceptance
+    ratios over the pre-zero-copy baseline: put 1MB >= 2x 790 ops/s and
+    put+get 1GB >= 2x 1.2 GB/s."""
+    import json
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "RUNTIME_BENCH.json")
+    with open(path) as f:
+        by_name = {r["name"]: r["per_s"]
+                   for r in json.load(f)["results"]}
+    assert by_name["put 1MB"] >= 2 * 790
+    assert by_name["put+get 1GB (GB/s)"] >= 2 * 1.2
+
+
+def test_pipelined_pull_2x_sequential_under_latency():
+    """Cross-node pull with the chunk window vs one-request-at-a-time,
+    under a deterministic injected per-chunk serve latency (the
+    fault-injection site standing in for real cross-host RTT, which
+    loopback cannot exhibit): the pipeline must hide >= half of it."""
+    import os as _os
+
+    from ray_tpu._private import config as cfg
+    from ray_tpu._private import fault_injection
+    from ray_tpu.cluster_utils import Cluster
+
+    # agents only, no driver (a connect() would clobber the module
+    # cluster fixture's global worker)
+    c = Cluster(head_resources={"CPU": 2, "memory": 2 * 2**30},
+                store_capacity=256 * 2**20)
+    c.add_node(resources={"CPU": 2, "memory": 2 * 2**30})
+    old_chunk = cfg.get("object_transfer_chunk_bytes")
+    try:
+        cfg.set_system_config({"object_transfer_chunk_bytes": 256 * 1024})
+        src, dst = c.agents[0], c.agents[1]
+        data = _os.urandom(4 * 2**20)  # 16 chunks
+        fault_injection.configure([
+            {"site": "object.read_chunk", "action": "delay",
+             "delay_s": 0.01, "count": 0},  # every chunk, 10ms "RTT"
+        ])
+
+        def timed_pull(depth):
+            cfg.set_system_config({"transfer_pull_pipeline_depth": depth})
+            oid = _os.urandom(16)
+            src.store.put_bytes(oid, data, metadata=b"")
+            c.io.run(src.rpc_object_sealed(
+                None, {"object_id": oid, "size": len(data)}))
+            t0 = time.perf_counter()
+            ok = c.io.run(dst.rpc_fetch_object(
+                None, {"object_id": oid, "timeout": 60}))
+            dt = time.perf_counter() - t0
+            assert ok
+            buf = dst.store.get(oid)
+            assert bytes(buf.data) == data
+            buf.release()
+            return dt
+
+        seq = min(timed_pull(1) for _ in range(2))
+        pipe = min(timed_pull(8) for _ in range(2))
+        assert seq / pipe >= 2.0, (
+            f"pipelined pull only {seq/pipe:.2f}x sequential "
+            f"({pipe:.3f}s vs {seq:.3f}s)")
+    finally:
+        fault_injection.clear()
+        cfg.set_system_config({
+            "object_transfer_chunk_bytes": old_chunk,
+            "transfer_pull_pipeline_depth": 8,
+        })
+        c.shutdown()
 
 
 def test_task_throughput_floors(cluster):
