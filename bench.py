@@ -246,14 +246,22 @@ def bench_decode_engine(size: str, *, slots: int = 8,
 def bench_decode_serve(size: str, *, slots: int = 8,
                        prompt_len: int = 128, new_tokens: int = 128,
                        n_requests: int = 32, concurrency: int = 16,
-                       chunk_tokens: int = 32) -> dict:
+                       chunk_tokens: int = 32, replicas: int = 1,
+                       prefill_workers: int = 0,
+                       prefix_cache_block: int = 0) -> dict:
     """E2E SERVING decode: the 1B model behind a Serve deployment with
     chunked continuous batching (serve/llm.py + models/decode_engine.py),
     measured through the HTTP proxy — concurrent requests share one slot
     batch, new streams admitted as slots free. Reports aggregate HTTP
     tokens/s plus TTFT and chunk-normalized per-token latency
     percentiles (tokens arrive per chunk; each positive inter-stamp gap
-    is divided by the tokens it delivered)."""
+    is divided by the tokens it delivered).
+
+    replicas > 1 (or prefill_workers/prefix_cache_block set) swaps the
+    single LLMServer for an LLMPool deployment (serve/llm_pool.py):
+    shared admission queue, N decode replicas adopting ONE published
+    weight blob, optional dedicated prefill workers and prefix/KV
+    cache. Extra outputs then: replicas, prefix_cache_hit_rate."""
     import http.client
     import random
     import threading
@@ -264,18 +272,29 @@ def bench_decode_serve(size: str, *, slots: int = 8,
     from ray_tpu import serve
     from ray_tpu.serve.api import Deployment
     from ray_tpu.serve.llm import LLMServer
+    from ray_tpu.serve.llm_pool import LLMPool
 
+    pooled = (replicas > 1 or prefill_workers > 0
+              or prefix_cache_block > 0)
     ray_tpu.init(num_cpus=4, object_store_memory=512 * 1024 * 1024)
     try:
-        dep = Deployment(
-            LLMServer, max_concurrent_queries=max(16, 2 * slots),
-            resources={"CPU": 0}, route_prefix="/llm")
-        serve.run(dep, name="llm", init_kwargs={
+        init_kwargs = {
             "model_size": size, "slots": slots,
             "max_len": prompt_len + new_tokens + 32,
             "chunk_tokens": chunk_tokens,
             "prompt_buckets": (prompt_len,),
-        })
+        }
+        if pooled:
+            cls, max_q = LLMPool, max(64, 2 * concurrency)
+            init_kwargs.update(
+                min_replicas=replicas, max_replicas=replicas,
+                prefill_workers=prefill_workers,
+                prefix_cache_block=prefix_cache_block)
+        else:
+            cls, max_q = LLMServer, max(16, 2 * slots)
+        dep = Deployment(cls, max_concurrent_queries=max_q,
+                         resources={"CPU": 0}, route_prefix="/llm")
+        serve.run(dep, name="llm", init_kwargs=init_kwargs)
         host, port = serve.start_http_proxy()
 
         def post(path, body):
@@ -357,7 +376,18 @@ def bench_decode_serve(size: str, *, slots: int = 8,
             "concurrency": concurrency, "slots": slots,
             "chunk_tokens": chunk_tokens,
             "prompt_len": prompt_len, "new_tokens": new_tokens,
+            "replicas": replicas,
         }
+        if pooled:
+            try:
+                st = ray_tpu.get(
+                    serve.get_handle("llm").method("stats").remote(),
+                    timeout=60)
+                out["prefix_cache_hit_rate"] = st.get(
+                    "prefix_cache_hit_rate")
+                out["pool_ttft_p99_s"] = st.get("ttft_p99_s")
+            except Exception:  # noqa: BLE001 — stats are best-effort
+                pass
         # empty on total failure: the error report IS the result then
         if ttfts:
             out["ttft_p50_s"] = round(float(np.percentile(ttfts, 50)), 3)
@@ -380,7 +410,8 @@ def bench_decode_serve(size: str, *, slots: int = 8,
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--only", choices=["350m", "1b", "decode", "serve"],
+    ap.add_argument("--only",
+                    choices=["350m", "1b", "decode", "serve", "serve2"],
                     default=None)
     args = ap.parse_args()
 
@@ -398,6 +429,13 @@ def main():
         return
     if args.only == "serve":
         print(json.dumps(bench_decode_serve("1b")))
+        return
+    if args.only == "serve2":
+        # the multi-replica pool configuration (2 decode replicas, one
+        # prefill worker, prefix cache): the ISSUE-10 scaling axis
+        print(json.dumps(bench_decode_serve(
+            "1b", replicas=2, prefill_workers=1, prefix_cache_block=32,
+            concurrency=32)))
         return
 
     # bf16 grads: the optimizer's update math stays f32 (masters are f32);

@@ -76,6 +76,11 @@ _DEFS: dict[str, Any] = {
     # (well under the TTL): multi-owner workloads would otherwise see
     # most of the worker pool pinned by idle leases between bursts
     "worker_lease_idle_reclaim_s": 1.5,
+    # owner probes the leased worker for tasks in flight longer than
+    # this (delivery barrier over the push connection): an execute_task
+    # fire lost in the write path is detected and failed over in ~one
+    # probe period instead of wedging until the test watchdog
+    "worker_lease_probe_s": 3.0,
     # pipelined queued submission: .remote() enqueues; a background pump
     # ships windowed batches to the agent instead of blocking per task
     "submit_batch_max": 200,

@@ -36,8 +36,11 @@ ctx: node), ``object.read_chunk`` (per served object chunk, ctx:
 oid/offset; ``drop`` surfaces as a retryable ``{"busy": True}``
 refusal to the puller, ``delay``/``stall`` are awaited on the agent's
 event loop via :func:`fire_async` so one slow chunk does not freeze
-every other transfer on the node). Sites are zero-overhead when no
-spec is configured (one module-flag check, no lock).
+every other transfer on the node), ``worker.lease_push`` (per
+direct-pushed lease task, ctx: task; ``drop`` skips the execute_task
+fire while keeping owner bookkeeping — the exact "lost fire" wedge the
+lease liveness probe exists to recover). Sites are zero-overhead when
+no spec is configured (one module-flag check, no lock).
 
 Every tripped spec is appended to an in-process hit log queryable via
 :func:`hits` — chaos tests assert determinism by comparing logs across

@@ -272,6 +272,110 @@ def run_transfer_benchmarks(*, quick: bool = False) -> list[dict]:
     return results
 
 
+def run_serve_benchmarks(*, quick: bool = False) -> list[dict]:
+    """Serving-tier floors: LLMPool aggregate decode throughput at 1 vs
+    2 replicas on ONE host, plus the prefix-cache configuration.
+
+    Decode compute rides a tiny model with an EMULATED per-chunk device
+    dispatch latency (decode_engine chunk_delay_s — same idiom as the
+    injected per-chunk latency in the pipelined-pull floor: loopback
+    CPU cannot exhibit the device wait that dominates a real TPU
+    replica's chunk cadence and overlaps perfectly across replicas).
+    What these numbers measure is the SERVING tier — admission,
+    routing, multi-replica overlap, prefix reuse — not matmul speed."""
+    import threading
+
+    from ray_tpu.serve.llm_pool import LLMPool
+
+    prompt_len, new_tokens, chunk_delay = 16, 96, 0.05
+    n_requests = 16 if quick else 32
+    concurrency = 32
+    results = []
+
+    def prompt_for(i, shared_head):
+        rng = np.random.RandomState(1000 + i)
+        if shared_head is not None:
+            return list(shared_head) + [
+                int(x) for x in rng.randint(1, 250, 7)]
+        return [int(x) for x in rng.randint(1, 250, prompt_len)]
+
+    def run_pool(n_replicas, *, prefix=False):
+        pool = LLMPool(
+            model_size="tiny", slots=8, max_len=128, chunk_tokens=8,
+            prompt_buckets=(prompt_len,), min_replicas=n_replicas,
+            max_replicas=n_replicas, chunk_delay_s=chunk_delay,
+            prefix_cache_block=8 if prefix else 0, autoscale=False)
+        head = ([int(x) for x in np.random.RandomState(7)
+                 .randint(1, 250, 8)] if prefix else None)
+        try:
+            # warm EVERY replica through BOTH prefill paths (cold
+            # batched prefill, then the prefix-cache suffix path) so
+            # jit compiles stay out of the timed window
+            warm = prompt_for(0, head)
+            ray_tpu.get([r.handle.generate.remote(warm, 8)
+                         for r in pool._alive()], timeout=600)
+            if prefix:
+                warm2 = prompt_for(1, head)
+                ray_tpu.get([r.handle.generate.remote(warm2, 8)
+                             for r in pool._alive()], timeout=600)
+            outs = [None] * n_requests
+            errs: list[str] = []
+            sem = threading.Semaphore(concurrency)
+
+            def one(i):
+                with sem:
+                    try:
+                        outs[i] = pool.generate(
+                            prompt_for(100 + i, head), new_tokens)
+                    except Exception as e:  # noqa: BLE001 — surface
+                        # the real failure, not a len(None) TypeError
+                        errs.append(f"req {i}: {type(e).__name__}: {e}")
+
+            threads = [threading.Thread(target=one, args=(i,))
+                       for i in range(n_requests)]
+            t0 = time.perf_counter()
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            dt = time.perf_counter() - t0
+            if errs:
+                raise RuntimeError(
+                    f"{len(errs)}/{n_requests} pool requests failed; "
+                    f"first: {errs[0][:300]}")
+            total = sum(len(o["tokens"]) for o in outs)
+            ttfts = sorted(o["token_times_s"][0] - o["submitted_s"]
+                           for o in outs)
+            st = pool.stats()
+            return {
+                "per_s": round(total / dt, 1),
+                "unit": "tokens/s",
+                "replicas": n_replicas,
+                "concurrency": concurrency,
+                "n_requests": n_requests,
+                "new_tokens": new_tokens,
+                "chunk_delay_s": chunk_delay,
+                "ttft_p50_s": round(ttfts[len(ttfts) // 2], 3),
+                "ttft_p99_s": round(ttfts[min(len(ttfts) - 1,
+                                              int(0.99 * len(ttfts)))],
+                                    3),
+                "prefix_hit_rate": st["prefix_cache_hit_rate"],
+            }
+        finally:
+            pool.shutdown()
+
+    for name, kw in [
+        ("serve pool decode (1 replica)", dict(n_replicas=1)),
+        ("serve pool decode (2 replicas)", dict(n_replicas=2)),
+        ("serve pool decode (2 replicas + prefix cache)",
+         dict(n_replicas=2, prefix=True)),
+    ]:
+        r = {"name": name, **run_pool(**kw)}
+        results.append(r)
+        print(json.dumps(r), flush=True)
+    return results
+
+
 def run_benchmarks(*, quick: bool = False) -> list[dict]:
     results = []
     windows = 1 if quick else 3
@@ -367,6 +471,9 @@ def run_benchmarks(*, quick: bool = False) -> list[dict]:
     results.append(r)
     print(json.dumps(r), flush=True)
 
+    # ---- serving tier (LLM pool replica scaling + prefix cache) ----
+    results.extend(run_serve_benchmarks(quick=quick))
+
     # ---- transfer (zero-copy put + pipelined cross-node pull) ----
     results.extend(run_transfer_benchmarks(quick=quick))
 
@@ -424,7 +531,7 @@ def main(argv=None):
     p.add_argument("--out", default=None, help="write results JSON here")
     p.add_argument("--quick", action="store_true")
     p.add_argument("--family", default="all",
-                   choices=["all", "collective", "transfer"],
+                   choices=["all", "collective", "transfer", "serve"],
                    help="run one workload family only")
     p.add_argument("--in-process", action="store_true",
                    help="head in the driver process (debug only)")
@@ -443,6 +550,8 @@ def main(argv=None):
             results = run_collective_benchmarks(quick=args.quick)
         elif args.family == "transfer":
             results = run_transfer_benchmarks(quick=args.quick)
+        elif args.family == "serve":
+            results = run_serve_benchmarks(quick=args.quick)
         else:
             results = run_benchmarks(quick=args.quick)
     finally:

@@ -1336,7 +1336,7 @@ class CoreWorker:
             if lease is not None:
                 lease["inflight"] = 1
                 lease["_last_use"] = now
-                self._lease_tasks[tid] = (key, lease["lease_id"])
+                self._lease_tasks[tid] = (key, lease["lease_id"], now)
             want_grant = (lease is None and len(keep) < max_leases
                           and now >= entry["no_grant_until"])
             if lease is None and not want_grant and keep \
@@ -1353,7 +1353,7 @@ class CoreWorker:
                     lease = cand
                     lease["inflight"] += 1
                     lease["_last_use"] = now
-                    self._lease_tasks[tid] = (key, lease["lease_id"])
+                    self._lease_tasks[tid] = (key, lease["lease_id"], now)
                 elif len(entry["pending"]) < _cfg.get(
                         "worker_lease_pending_max"):
                     if not entry["pending"]:
@@ -1397,7 +1397,7 @@ class CoreWorker:
                     return False
                 entry["spillable"] = bool(grant.get("spillable", True))
                 entry["leases"].append(lease)
-                self._lease_tasks[tid] = (key, lease["lease_id"])
+                self._lease_tasks[tid] = (key, lease["lease_id"], now)
         if lease is None:
             return False
         return self._lease_push(key, lease, spec, requeue_on_fail=False)
@@ -1420,33 +1420,41 @@ class CoreWorker:
             cli = self._peer_clients.get((lease["addr"], lease["port"]))
         else:
             cli = self._peer(addr)
-        ok = cli is not None
+        # a closed client means the frame could only land in a dead
+        # transport — SyncRpcClient.fire would swallow that silently
+        # (the historical "lost execute_task fire" wedge: the task sat
+        # leased forever while the pool idled)
+        ok = cli is not None and not cli.client.closed
         if ok:
             try:
-                # fire, not a blocking oneway: the io-loop round trip per
-                # push (~1ms thread hop) was the submission ceiling. An
-                # async write failure means the leased worker died — the
-                # agent's worker-death → lease_revoked path fails the
-                # task over to the queue, so no sync ack is needed.
-                cli.fire("execute_task", push)
+                from ray_tpu._private import fault_injection as _fi
+
+                if _fi.enabled() and _fi.fire(
+                        "worker.lease_push",
+                        task=spec.get("name", "")) == "drop":
+                    pass  # chaos: simulate the push lost in the write
+                    # path — bookkeeping stays, the probe must recover
+                else:
+                    # fire, not a blocking oneway: the io-loop round
+                    # trip per push (~1ms thread hop) was the
+                    # submission ceiling. An async write failure means
+                    # the leased worker died — the agent's worker-death
+                    # → lease_revoked path fails the task over to the
+                    # queue; the liveness probe (_pending_pump) covers
+                    # writes lost with the worker still alive.
+                    cli.fire("execute_task", push)
             except (rpc.ConnectionLost, rpc.RpcError):
                 ok = False
         if not ok:
-            drain = []
             with self._lease_lock:
                 self._lease_tasks.pop(tid, None)
-                entry = self._lease_cache.get(key)
-                if entry is not None:
-                    entry["leases"] = [
-                        l for l in entry["leases"]
-                        if l["lease_id"] != lease["lease_id"]
-                    ]
-                    if not entry["leases"] and entry["pending"]:
-                        drain = entry["pending"]
-                        entry["pending"] = []
-            self.agent.fire("return_lease", {"lease_id": lease["lease_id"]})
-            for s in drain:
-                self._enqueue_submit(s)
+            # the whole lease is suspect (its connection just failed):
+            # sweep every OTHER task recorded on it through the shared
+            # failover helper — it drops the lease, drains pendings,
+            # tells the agent (lease_tasks_lost + return_lease), and
+            # resubmits — instead of leaving them as unprobeable
+            # orphans for the pump to find later
+            self._fail_lost_lease_tasks(key, lease["lease_id"], [])
             if requeue_on_fail:
                 self._enqueue_submit(spec)
             return False
@@ -1465,7 +1473,17 @@ class CoreWorker:
         # owner-side node tracking for direct pushes (they bypass the
         # agents' task_located notifies entirely)
         self._task_nodes[tid] = self.node_id
+        # the liveness pump must run while ANY lease task is in flight:
+        # it is the only recovery for a push lost with the worker alive
+        self._ensure_lease_pump()
         return True
+
+    def _ensure_lease_pump(self):
+        with self._lease_lock:
+            if self._pending_pump_running:
+                return
+            self._pending_pump_running = True
+        self.io.call_soon(self._start_pending_pump)
 
     def _buffer_lease_started(self, item: dict):
         with self._lease_started_lock:
@@ -1490,11 +1508,19 @@ class CoreWorker:
         asyncio.ensure_future(self._pending_pump())
 
     async def _pending_pump(self):
-        """While any scheduling key holds owner-side pending tasks, keep
-        them live: re-try lease grants once the refusal window lapses and
-        flush pendings that made no progress for 2s to the agent queue
-        (in-flight tasks may be long-running; the agent can spawn workers
-        or spill where the owner cannot)."""
+        """Lease liveness pump. While any scheduling key holds owner-side
+        pending tasks, keep them live: re-try lease grants once the
+        refusal window lapses and flush pendings that made no progress
+        for 2s to the agent queue (in-flight tasks may be long-running;
+        the agent can spawn workers or spill where the owner cannot).
+
+        While any lease task is IN FLIGHT, additionally run the
+        delivery probe (_probe_lease_tasks): a pushed execute_task is an
+        unacked fire, and a frame lost with the worker still alive used
+        to wedge a whole round of tasks — leased forever, pool idle —
+        until the 600s test watchdog (ROADMAP 'owner-lease liveness
+        wedge'). The probe detects undelivered pushes in ~probe_s and
+        fails them over through the queue."""
         import asyncio
 
         from ray_tpu._private import config as _cfg
@@ -1510,7 +1536,7 @@ class CoreWorker:
                 with self._lease_lock:
                     busy_keys = [k for k, e in self._lease_cache.items()
                                  if e["pending"]]
-                    if not busy_keys:
+                    if not busy_keys and not self._lease_tasks:
                         self._pending_pump_running = False
                         return
                     for key in busy_keys:
@@ -1526,10 +1552,163 @@ class CoreWorker:
                     self._enqueue_submit(s)
                 for key in grant_keys:
                     await self._pump_grant_one(key, loop)
+                await self._probe_lease_tasks(now)
         except Exception:
             with self._lease_lock:
                 self._pending_pump_running = False
             raise
+
+    async def _probe_lease_tasks(self, now: float):
+        """Fail over lease tasks whose execute_task push never reached
+        the worker. The worker records every task id at frame ingress
+        (Executor._seen_tids); probing over the SAME connection the push
+        used makes the reply a delivery barrier (TCP FIFO + in-order
+        frame dispatch): 'unknown' means the push is not behind us in
+        the pipe — it was lost — so resubmission cannot double-execute."""
+        from ray_tpu._private import config as _cfg
+
+        probe_s = _cfg.get("worker_lease_probe_s")
+        groups: dict[tuple, list[bytes]] = {}
+        orphans: list[tuple] = []  # (key, lease_id, tid)
+        with self._lease_lock:
+            for tid, rec in self._lease_tasks.items():
+                key, lid, pushed = rec
+                if now - pushed < probe_s:
+                    continue
+                entry = self._lease_cache.get(key)
+                lease = None
+                if entry is not None:
+                    lease = next((l for l in entry["leases"]
+                                  if l["lease_id"] == lid), None)
+                if lease is None:
+                    # lease record already dropped but the task was
+                    # never completed or failed over: orphan (keep its
+                    # lease_id — the AGENT may still hold the task
+                    # active on that lease / migrated to pool_inflight,
+                    # pinning the worker until it is told)
+                    orphans.append((key, lid, tid))
+                else:
+                    if now - lease.get("_last_probe", 0.0) < probe_s:
+                        continue  # a long-RUNNING task is re-probed
+                        # once per probe period, not per pump tick
+                    groups.setdefault(
+                        (lease["addr"], lease["port"], lid, key),
+                        []).append(tid)
+            for (_a, _p, lid, key) in groups:
+                entry = self._lease_cache.get(key)
+                if entry is not None:
+                    for l in entry["leases"]:
+                        if l["lease_id"] == lid:
+                            l["_last_probe"] = now
+        by_lease: dict = {}
+        for key, lid, tid in orphans:
+            by_lease.setdefault((key, lid), []).append(tid)
+        for (key, lid), tids in by_lease.items():
+            self._fail_lost_lease_tasks(key, lid, tids)
+        for (addr, port, lid, key), tids in groups.items():
+            cli = self._peer_clients.get((addr, port))
+            if cli is None or cli.client.closed:
+                # No cached client. Usually the connection died after
+                # the push (eviction via _notify_peer_lost) — but it
+                # can also mean the FIRST connect from a submit thread
+                # is still in progress (the task is recorded before
+                # _lease_push's _peer() call); give that window extra
+                # probe periods before declaring the lease dead, or a
+                # slow connect double-executes every task on it.
+                with self._lease_lock:
+                    ages = [now - self._lease_tasks[t][2]
+                            for t in tids if t in self._lease_tasks]
+                if not ages or min(ages) < 3 * probe_s:
+                    continue
+                # connection gone for good: everything unacked on it is
+                # undeliverable — sweep the lease (same at-least-once
+                # contract as the worker-death lease_revoked failover)
+                self._fail_lost_lease_tasks(key, lid, tids)
+                continue
+            if (cli._fire_buf or cli.client._fire_out
+                    or cli.client._fire_drain_task is not None):
+                continue  # unflushed fires: barrier not valid yet
+            try:
+                res = await cli.client.call(
+                    "probe_tasks", {"task_ids": tids}, timeout=5.0)
+            except Exception:  # noqa: BLE001 — probe itself failed:
+                continue  # connection teardown will re-enter above
+            known = set(res.get("known", ()))
+            lost = [t for t in tids if t not in known]
+            if lost:
+                # the connection is ALIVE (the probe answered) and the
+                # barrier proved these frames never arrived: fail over
+                # ONLY the lost tasks and KEEP the lease — the known
+                # ones are delivered and running; sweeping them too
+                # would double-execute work the probe just confirmed
+                self._fail_lost_lease_tasks(key, lid, lost,
+                                            sweep=False)
+
+    def _fail_lost_lease_tasks(self, key, lease_id, tids: list[bytes],
+                               *, sweep: bool = True):
+        """Owner-side recovery for confirmed-lost pushes.
+
+        sweep=True (connection dead / lease being torn down): drop the
+        lease, sweep EVERY task recorded on it into the failover, tell
+        the agent (active set + pool_inflight scrub + lease return) —
+        the same at-least-once contract as worker-death revocation.
+
+        sweep=False (connection alive, probe isolated the losses): fail
+        over ONLY `tids`, decrement the lease's in-flight count for
+        them, and KEEP the lease serving its delivered tasks."""
+        drain: list[dict] = []
+        tids = list(tids)
+        with self._lease_lock:
+            if sweep and lease_id is not None:
+                # leaving younger tasks behind on a dropped lease would
+                # orphan them with the agent still pinning the worker
+                tids.extend(
+                    t for t, rec in self._lease_tasks.items()
+                    if rec[1] == lease_id and t not in tids)
+            for tid in tids:
+                self._lease_tasks.pop(tid, None)
+            if key is not None:
+                entry = self._lease_cache.get(key)
+                if entry is not None:
+                    if sweep:
+                        entry["leases"] = [
+                            l for l in entry["leases"]
+                            if l["lease_id"] != lease_id
+                        ]
+                        if not entry["leases"] and entry["pending"]:
+                            drain = entry["pending"]
+                            entry["pending"] = []
+                    else:
+                        for l in entry["leases"]:
+                            if l["lease_id"] == lease_id:
+                                # their results will never arrive to
+                                # decrement this
+                                l["inflight"] = max(
+                                    0, l["inflight"] - len(tids))
+        if lease_id is not None:
+            try:
+                self.agent.fire("lease_tasks_lost",
+                                {"lease_id": lease_id, "task_ids": tids})
+                if sweep:
+                    self.agent.fire("return_lease",
+                                    {"lease_id": lease_id})
+            except (rpc.ConnectionLost, rpc.RpcError):
+                pass
+        for s in drain:
+            self._enqueue_submit(s)
+        if not tids:
+            return  # lease dropped + agent told; nothing to fail over
+        logger.warning(
+            "lease liveness probe: %d task(s) lost on lease %s; "
+            "failing over to queued submission", len(tids),
+            lease_id.hex()[:8] if lease_id else "<dropped>")
+
+        def _failover(ts=list(tids)):
+            for tid in ts:
+                self._handle_task_failed(
+                    {"task_id": tid, "reason": "lease push lost",
+                     "retriable": True})
+        threading.Thread(target=_failover, daemon=True).start()
 
     async def _pump_grant_one(self, key: tuple, loop):
         import asyncio
@@ -1574,7 +1753,7 @@ class CoreWorker:
                 spec = e["pending"].pop(0)
                 e["pending_since"] = now
                 self._lease_tasks[spec["task_id"]] = (
-                    key, lease["lease_id"])
+                    key, lease["lease_id"], now)
         if spec is None:
             self.agent.fire("return_lease", {"lease_id": grant["lease_id"]})
             return
@@ -1602,8 +1781,8 @@ class CoreWorker:
                     drain.extend(entry["pending"])
                     entry["pending"] = []
             orphans.extend(
-                tid for tid, (_k, lid) in self._lease_tasks.items()
-                if lid in dead_ids
+                tid for tid, rec in self._lease_tasks.items()
+                if rec[1] in dead_ids
             )
         for s in drain:
             self._enqueue_submit(s)
@@ -1625,7 +1804,7 @@ class CoreWorker:
             rec = self._lease_tasks.pop(task_id, None)
             if rec is None:
                 return
-            key, lease_id = rec
+            key, lease_id = rec[0], rec[1]
             entry = self._lease_cache.get(key)
             if entry is None:
                 return
@@ -1658,7 +1837,8 @@ class CoreWorker:
                     while entry["pending"] and lease["inflight"] < depth:
                         s = entry["pending"].pop(0)
                         lease["inflight"] += 1
-                        self._lease_tasks[s["task_id"]] = (key, lease_id)
+                        self._lease_tasks[s["task_id"]] = (
+                            key, lease_id, time.monotonic())
                         refill.append(s)
                     if refill:
                         entry["pending_since"] = time.monotonic()

@@ -15,9 +15,73 @@ node types to launch:
 - "tpu-slice:<topology>" resources only fit node types declaring that
   label, which is how a pending TPU-slice gang maps to exactly the right
   accelerator node group (reference gcp/node.py:111 GCPNodeType.TPU).
+
+Serving-tier hook: `serve_replica_demand` converts an LLM pool's
+pressure signals (admission-queue depth, in-flight load, TTFT p99 vs
+SLO target) into a desired decode-replica count, and
+`replica_resource_demands` renders the delta as resource shapes this
+module's bin-packer can turn into node launches — the demand bridge
+between serve/llm_pool.py and the cluster autoscaler.
 """
 
 from __future__ import annotations
+
+
+def serve_replica_demand(
+    *,
+    queue_depth: int,
+    inflight: int,
+    n_replicas: int,
+    min_replicas: int,
+    max_replicas: int,
+    target_queue_per_replica: float = 4.0,
+    ttft_p99_s: float | None = None,
+    target_ttft_s: float | None = None,
+    slo_headroom: float = 0.5,
+) -> int:
+    """Desired decode-replica count for a serving pool.
+
+    Two pressure signals, the stronger wins:
+
+    - **load**: ceil((queue_depth + inflight) / target_queue_per_replica)
+      — the steady-state sizing, mirroring the controller's
+      target_num_ongoing_requests_per_replica policy;
+    - **SLO**: an observed TTFT p99 above `target_ttft_s` asks for one
+      replica MORE than current even when raw load says otherwise
+      (queue depth undercounts when requests are long, TTFT does not).
+
+    Scale-DOWN is hysteretic: only when load supports fewer replicas
+    AND the TTFT p99 sits under `slo_headroom * target_ttft_s` (or no
+    SLO is set) — a pool near its SLO boundary never sheds capacity.
+    Result is clamped to [min_replicas, max_replicas].
+    """
+    import math
+
+    min_replicas = max(1, min_replicas)
+    max_replicas = max(min_replicas, max_replicas)
+    load = max(0, queue_depth) + max(0, inflight)
+    desired = math.ceil(load / max(target_queue_per_replica, 1e-9))
+    slo_breached = (target_ttft_s is not None and ttft_p99_s is not None
+                    and ttft_p99_s > target_ttft_s)
+    if slo_breached:
+        desired = max(desired, n_replicas + 1)
+    if desired < n_replicas:
+        slo_near = (target_ttft_s is not None and ttft_p99_s is not None
+                    and ttft_p99_s > slo_headroom * target_ttft_s)
+        if slo_near:
+            desired = n_replicas  # hold: shrinking would risk the SLO
+    return max(min_replicas, min(max_replicas, desired))
+
+
+def replica_resource_demands(n_new: int,
+                             replica_resources: dict | None = None
+                             ) -> list[dict]:
+    """Render a replica-count delta as per-replica resource demand
+    shapes for `get_nodes_to_launch` (one dict per replica to place),
+    so a pool scale-up that exceeds current cluster capacity opens
+    exactly the node types that fit a decode replica."""
+    shape = dict(replica_resources or {"TPU": 1.0})
+    return [dict(shape) for _ in range(max(0, n_new))]
 
 
 def _fits(need: dict, cap: dict) -> bool:

@@ -1718,6 +1718,35 @@ class NodeAgent:
     async def rpc_return_lease(self, conn, p):
         return self._release_lease(p["lease_id"])
 
+    async def rpc_lease_tasks_lost(self, conn, p):
+        """Owner's liveness probe confirmed these direct-pushed tasks
+        never reached the leased worker (lost execute_task fire): drop
+        them from the lease's active set and `running` so the lease can
+        expire/reclaim normally instead of being extended forever for
+        tasks that will never run — the other half of the owner-side
+        failover (the owner resubmits them through the queue)."""
+        lease = self.leases.get(p["lease_id"])
+        now = time.monotonic()
+        for tid in p.get("task_ids", ()):
+            if lease is not None:
+                lease["active"].discard(tid)
+            spec = self.running.get(tid)
+            if spec is not None and spec.get("_lease_id") == p["lease_id"]:
+                self.running.pop(tid, None)
+            # a released lease migrates its actives to pool_inflight
+            # (_release_lease): scrub those too, or the worker stays
+            # pinned busy for a push that never arrived
+            for w in self.workers.values():
+                if tid in w.pool_inflight:
+                    w.pool_inflight.discard(tid)
+                    if not w.pool_inflight and w.busy_task is None:
+                        w.idle_since = now
+                        self._signal_worker_free()
+        if lease is not None:
+            lease["last_activity"] = now
+        self._kick_dispatch()
+        return True
+
     async def rpc_lease_tasks_started(self, conn, p):
         """Batched lease_task_started (owners buffer per burst: the
         per-frame dispatch cost on this loop is the multi-owner
@@ -1752,8 +1781,10 @@ class NodeAgent:
         lease = self.leases.pop(lease_id, None)
         if lease is None:
             return False
-        for r, v in lease["resources"].items():
-            self._release(r, v)
+        if not lease.pop("_blocked_released", None):
+            # blocked-borrow already released them (rpc_worker_blocked)
+            for r, v in lease["resources"].items():
+                self._release(r, v)
         w = self.workers.get(lease["worker_id"])
         if w is not None:
             w.busy_task = None
@@ -1856,6 +1887,21 @@ class NodeAgent:
                 self._free_task_resources(spec)
                 spec["_blocked_released"] = True
             self._signal_worker_free()  # a slot just opened
+            # A LEASED worker parked in a nested get holds its lease's
+            # resources with no per-task grant to borrow from — on a
+            # full node that starves the very producer task the parked
+            # one waits on (observed: 4 blocked reduce leases pinning
+            # all 4 CPUs while one map task sat queued forever).
+            # Borrow the LEASE's resources while any of its tasks is
+            # parked; re-taken on unblock, same temporary
+            # oversubscription contract as the per-task release above.
+            if w.busy_task and w.busy_task.startswith(b"__lease__"):
+                lease = self.leases.get(w.busy_task[len(b"__lease__"):])
+                if lease is not None \
+                        and not lease.get("_blocked_released"):
+                    for r, v in lease["resources"].items():
+                        self._release(r, v)
+                    lease["_blocked_released"] = True
             self._kick_dispatch()
             await self._reclaim_pipelined(w, p.get("task_id") or b"")
         return True
@@ -1900,6 +1946,16 @@ class NodeAgent:
             w.blocked -= 1
             if not w.blocked:
                 w._parked_tid = b""
+                if w.busy_task and w.busy_task.startswith(b"__lease__"):
+                    lease = self.leases.get(
+                        w.busy_task[len(b"__lease__"):])
+                    if lease is not None \
+                            and lease.pop("_blocked_released", None):
+                        # re-take even into negative availability: the
+                        # leased tasks resume NOW (mirror of the
+                        # per-task re-take below)
+                        self._take(lease["resources"],
+                                   self.resources_available)
         spec = self.running.get(p.get("task_id") or b"")
         if spec is not None and spec.pop("_blocked_released", None):
             # re-take even if it drives availability negative: the task

@@ -15,6 +15,7 @@ import sys
 import threading
 import time
 import traceback
+from collections import deque
 
 from ray_tpu._private import rpc, serialization, task_spec
 from ray_tpu._private import trace as _trace
@@ -73,6 +74,16 @@ class Executor(CoreWorker):
         self._event_buf_lock = threading.Lock()
         self._event_buf_t0 = time.monotonic()
         self._done_buf: list[bytes] = []  # leased task_done batch
+        # Every task id this process has ever been asked to execute, in
+        # frame-ingress order (bounded ring). Owners probe this set to
+        # distinguish "push delivered (running/done)" from "push lost in
+        # the write path" — same-connection FIFO makes a probe reply a
+        # delivery barrier for every earlier execute_task frame.
+        self._seen_tids: set[bytes] = set()
+        self._seen_order: deque = deque()
+        self._backfill_lock = threading.Lock()
+        self._backfill_threads = 0
+        self._blocked_count = 0
         self._result_buf: dict[tuple, list] = {}  # owner -> result msgs
         self._result_buf_lock = threading.Lock()
         # Async-actor event loop + per-concurrency-group pools (reference
@@ -105,22 +116,70 @@ class Executor(CoreWorker):
             t.start()
             self._exec_threads.append(t)
 
+    def _dispatch_exec(self, kind, payload, reply):
+        try:
+            if kind == "task":
+                self._execute_task(payload)
+            elif kind == "actor_create":
+                try:
+                    self._create_actor(payload)
+                    reply.set_result(True)
+                except BaseException as e:  # noqa: BLE001
+                    reply.set_exception(e)
+            elif kind == "actor_call":
+                self._execute_actor_call(payload)
+        except Exception:
+            logger.exception("executor loop error")
+
     def _exec_loop(self):
         while True:
             kind, payload, reply = self._exec_queue.get()
-            try:
-                if kind == "task":
-                    self._execute_task(payload)
-                elif kind == "actor_create":
-                    try:
-                        self._create_actor(payload)
-                        reply.set_result(True)
-                    except BaseException as e:  # noqa: BLE001
-                        reply.set_exception(e)
-                elif kind == "actor_call":
-                    self._execute_actor_call(payload)
-            except Exception:
-                logger.exception("executor loop error")
+            self._dispatch_exec(kind, payload, reply)
+
+    # -- blocked-exec backfill --------------------------------------
+    # Direct-pushed lease tasks live in THIS process's exec queue; the
+    # agent's _reclaim_pipelined cannot requeue them (it only holds
+    # their slim specs). If the exec thread parks in a nested get() ON
+    # one of those queued tasks' results, the queue would deadlock
+    # behind it forever (the second face of the owner-lease liveness
+    # wedge). While any task is blocked, transient backfill threads
+    # drain the queue — resource-consistent, since the agent released
+    # the blocked task's CPUs on worker_blocked.
+
+    BACKFILL_MAX = 16
+
+    def _maybe_backfill_exec(self):
+        if self._actor is not None:
+            # actor workers promise serial execution (max_concurrency
+            # aside): never run their queued calls concurrently with a
+            # blocked one — lease pipelining (the deadlock this exists
+            # for) only targets plain pool workers anyway
+            return
+        with self._backfill_lock:
+            if (self._exec_queue.empty()
+                    or self._backfill_threads >= self.BACKFILL_MAX):
+                return
+            self._backfill_threads += 1
+        threading.Thread(target=self._backfill_loop, daemon=True,
+                         name="ray_tpu-exec-backfill").start()
+
+    def _backfill_loop(self):
+        try:
+            while True:
+                try:
+                    kind, payload, reply = self._exec_queue.get_nowait()
+                except queue.Empty:
+                    return
+                if kind != "task":
+                    # actor_create racing a blocked task: hand it back
+                    # to the serial exec thread (order vs plain tasks
+                    # is not guaranteed anyway) and stop draining
+                    self._exec_queue.put((kind, payload, reply))
+                    return
+                self._dispatch_exec(kind, payload, reply)
+        finally:
+            with self._backfill_lock:
+                self._backfill_threads -= 1
 
     # blocked-in-get notifications (reference
     # NotifyDirectCallTaskBlocked): the agent backfills this worker's
@@ -135,11 +194,19 @@ class Executor(CoreWorker):
                 "worker_id": self.worker_id,
                 "task_id": getattr(self._cur_task, "tid", None),
             })
-            return True
-        except Exception:  # noqa: BLE001 — agent teardown
+        except Exception:  # noqa: BLE001 — agent teardown: callers
+            # skip _notify_unblocked on False, so do not bump the
+            # blocked count either (it would never be decremented and
+            # every future push would spawn backfill concurrency)
             return False
+        with self._backfill_lock:
+            self._blocked_count += 1
+        self._maybe_backfill_exec()
+        return True
 
     def _notify_unblocked(self) -> None:
+        with self._backfill_lock:
+            self._blocked_count = max(0, self._blocked_count - 1)
         try:
             self.agent.fire("worker_unblocked", {
                 "worker_id": self.worker_id,
@@ -150,6 +217,27 @@ class Executor(CoreWorker):
 
     # ---------- RPC endpoints (called by agent / owners) ----------
 
+    SEEN_TIDS_MAX = 65536
+
+    def _record_seen(self, spec) -> None:
+        tid = spec.get("task_id") if isinstance(spec, dict) else None
+        if not isinstance(tid, bytes):
+            return
+        self._seen_tids.add(tid)
+        self._seen_order.append(tid)
+        while len(self._seen_order) > self.SEEN_TIDS_MAX:
+            self._seen_tids.discard(self._seen_order.popleft())
+
+    async def rpc_probe_tasks(self, conn, p):
+        """Owner-side lease liveness probe: which of these task ids has
+        this worker ever seen (queued, executing, or done)? Recorded at
+        frame ingress, BEFORE any validation/queueing, so an 'unknown'
+        reply means the execute_task frame never arrived — the owner
+        can fail the task over without double-execution risk."""
+        seen = self._seen_tids
+        return {"known": [t for t in p.get("task_ids", ())
+                          if t in seen]}
+
     async def rpc_execute_task(self, conn, spec):
         # Executing-process boundary: same schema the owner built against.
         # This handler is reached via fire/oneway (no reply path), so a
@@ -157,6 +245,7 @@ class Executor(CoreWorker):
         # worker marked busy — instead poison the spec and let the normal
         # execution error path push a RayTaskError to the owner and
         # report done to the agent.
+        self._record_seen(spec)
         try:
             spec = task_spec.TaskSpec.from_wire_trusted(spec)
         except task_spec.InvalidTaskSpec as e:
@@ -165,6 +254,11 @@ class Executor(CoreWorker):
                 logger.error("unroutable malformed task spec: %s", e)
                 return False
         self._exec_queue.put(("task", spec, None))
+        if self._blocked_count > 0:
+            # a push landing AFTER the exec thread parked in a nested
+            # get would otherwise wait for the blocked task it may
+            # itself be a dependency of
+            self._maybe_backfill_exec()
         return True
 
     async def rpc_create_actor(self, conn, p):
@@ -363,16 +457,32 @@ class Executor(CoreWorker):
             payload = args_spec["payload"]
             args, kwargs = serialization.unpack_payload(payload)
         # top-level ObjectRef args are awaited + replaced by their values
-        # (reference semantics; nested refs pass through untouched)
+        # (reference semantics; nested refs pass through untouched).
+        # A not-yet-ready ref (an __owner__-marked pending result a
+        # lease push legitimately carries) parks this exec thread: it
+        # MUST count as blocked — agent slot freed, backfill threads
+        # draining the queue — or tasks pipelined behind it (possibly
+        # including this very dep's producer) deadlock the worker: the
+        # second face of the owner-lease liveness wedge.
         from ray_tpu._private.api import ObjectRef
 
+        blocked = False
+
         def _resolve(x):
+            nonlocal blocked
             if isinstance(x, ObjectRef):
-                return self._get_one(x.binary(), None)
+                oid = x.binary()
+                if not blocked and not self._entry(oid).ready:
+                    blocked = self._notify_blocked()
+                return self._get_one(oid, None)
             return x
 
-        args = tuple(_resolve(a) for a in args)
-        kwargs = {k: _resolve(v) for k, v in kwargs.items()}
+        try:
+            args = tuple(_resolve(a) for a in args)
+            kwargs = {k: _resolve(v) for k, v in kwargs.items()}
+        finally:
+            if blocked:
+                self._notify_unblocked()
         return args, kwargs
 
     def _push_one(self, owner, spec, oid: bytes, value=None, error=None,

@@ -66,6 +66,17 @@ class _JobSupervisor:
     def logs(self) -> str:
         return "".join(self._lines)
 
+    def logs_since(self, offset: int) -> dict:
+        """Incremental log read for tailing: lines [offset:] plus the
+        new offset and a terminal flag, so clients poll without
+        re-shipping the whole buffer each time."""
+        lines = self._lines[offset:]
+        return {
+            "lines": lines,
+            "offset": offset + len(lines),
+            "terminal": self._status in (SUCCEEDED, FAILED, STOPPED),
+        }
+
     def stop(self) -> bool:
         if self._proc is not None and self._proc.poll() is None:
             self._status = STOPPED
@@ -111,6 +122,31 @@ class JobSubmissionClient:
 
     def get_job_logs(self, sid: str) -> str:
         return ray_tpu.get(self._sup(sid).logs.remote(), timeout=30)
+
+    def tail_job_logs(self, sid: str, *, poll_s: float = 0.25,
+                      timeout: float = 600.0):
+        """Generator of log chunks as the job emits them — the
+        job-submission face of token streaming: a driver script that
+        prints tokens (e.g. consuming a serve stream) tails out to the
+        submitting client live. Yields strings; returns when the job
+        reaches a terminal status and the buffer is drained."""
+        import time
+
+        sup = self._sup(sid)
+        deadline = time.monotonic() + timeout
+        offset = 0
+        while True:
+            out = ray_tpu.get(sup.logs_since.remote(offset), timeout=30)
+            if out["lines"]:
+                offset = out["offset"]
+                yield "".join(out["lines"])
+            if out["terminal"] and not out["lines"]:
+                return
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"job {sid} still streaming after {timeout}s")
+            if not out["lines"]:
+                time.sleep(poll_s)
 
     def stop_job(self, sid: str) -> bool:
         return ray_tpu.get(self._sup(sid).stop.remote(), timeout=30)

@@ -6,4 +6,4 @@ Every model module exposes:
 Params are plain pytrees; sharding comes from ray_tpu.parallel rules.
 """
 
-from ray_tpu.models import llama, mlp  # noqa: F401
+from ray_tpu.models import kv_prefix_cache, llama, mlp  # noqa: F401

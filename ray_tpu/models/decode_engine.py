@@ -31,6 +31,34 @@ from ray_tpu.models import llama
 from ray_tpu.models.llama import LlamaConfig
 
 
+_metrics = None
+
+
+def _get_metrics():
+    """Lazy Prometheus-style gauges (collective/ring.py idiom): one
+    family per engine signal, tagged by engine name."""
+    global _metrics
+    if _metrics is None:
+        from ray_tpu.util import metrics as M
+
+        _metrics = {
+            "active": M.Gauge(
+                "decode_engine_active_slots",
+                "decode slots currently occupied", tag_keys=("engine",)),
+            "queued": M.Gauge(
+                "decode_engine_queue_depth",
+                "streams waiting for a free slot", tag_keys=("engine",)),
+            "tps": M.Gauge(
+                "decode_engine_tokens_per_sec",
+                "tokens/s over the recent window", tag_keys=("engine",)),
+            "hit_rate": M.Gauge(
+                "decode_prefix_cache_hit_rate",
+                "prefix-cache hit rate since start",
+                tag_keys=("engine",)),
+        }
+    return _metrics
+
+
 def init_ragged_cache(cfg: LlamaConfig, slots: int, max_len: int) -> dict:
     shape = (cfg.n_layers, slots, max_len, cfg.n_kv_heads, cfg.head_dim)
     cdt = cfg.compute_dtype
@@ -162,6 +190,65 @@ def _prefill_batch_into_slots(params, prompts, true_lens, slots,
     return cache, cur_tok.at[slots].set(toks0, mode="drop"), toks0
 
 
+@functools.partial(jax.jit, static_argnames=("cfg", "slot_len"))
+def prefill_kv(params, prompts, true_lens, cfg: LlamaConfig,
+               slot_len: int):
+    """Prefill WITHOUT a slot: run [F, P] right-padded prompts through a
+    fresh slot_len cache and return the raw KV rows + first greedy
+    tokens ((k, v) [L, F, S, Hkv, D], toks0 [F]). This is the dedicated
+    prefill worker's op (serve/llm_pool.py): the rows travel through the
+    object store and a decode replica adopts them into a slot with
+    `RaggedDecoder.submit_prefilled` — same math as
+    `_prefill_batch_into_slots` (init_cache + forward_with_cache), so
+    the adopted stream's greedy continuation is identical to an
+    inline-prefilled one."""
+    f = prompts.shape[0]
+    tmp = llama.init_cache(cfg, f, slot_len)
+    logits, tmp = llama.forward_with_cache(params, prompts, cfg, tmp)
+    toks0 = jnp.argmax(
+        logits[jnp.arange(f), true_lens - 1], axis=-1).astype(jnp.int32)
+    return tmp["k"], tmp["v"], toks0
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",),
+                   donate_argnames=("cache", "cur_tok"))
+def _adopt_kv_into_slot(k_rows, v_rows, true_len, tok0, slot, cache,
+                        cur_tok, cfg: LlamaConfig):
+    """Scatter externally-prefilled KV rows ([L, S, Hkv, D], S == the
+    slot cache length — FULL-SLOT-OVERWRITE, see
+    _prefill_batch_into_slots) into `slot` and seed its current token."""
+    cache = {
+        "k": cache["k"].at[:, slot].set(k_rows),
+        "v": cache["v"].at[:, slot].set(v_rows),
+        "pos": cache["pos"].at[slot].set(true_len),
+    }
+    return cache, cur_tok.at[slot].set(tok0)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",),
+                   donate_argnames=("cache", "cur_tok"))
+def _prefill_suffix_into_slot(params, pref_k, pref_v, n_prefix, suffix,
+                              suffix_len, slot, cache, cur_tok,
+                              cfg: LlamaConfig):
+    """Prefix-cache warm path: seed a temp cache with the cached prefix
+    rows (pref_k/v: [L, S, Hkv, D] zero-padded to the slot length),
+    prefill only the suffix ([SB] right-padded static bucket) at
+    pos=n_prefix, then full-slot-scatter into `slot`. Row independence
+    + exact softmax masking make the result identical to a cold full
+    prefill of the whole prompt (kv_prefix_cache.py docstring)."""
+    tmp = {"k": pref_k[:, None], "v": pref_v[:, None], "pos": n_prefix}
+    logits, tmp = llama.forward_with_cache(
+        params, suffix[None, :], cfg, tmp)
+    tok0 = jnp.argmax(logits[0, suffix_len - 1], axis=-1).astype(jnp.int32)
+    true_len = n_prefix + suffix_len
+    cache = {
+        "k": cache["k"].at[:, slot].set(tmp["k"][:, 0]),
+        "v": cache["v"].at[:, slot].set(tmp["v"][:, 0]),
+        "pos": cache["pos"].at[slot].set(true_len),
+    }
+    return cache, cur_tok.at[slot].set(tok0), tok0
+
+
 @dataclass
 class _Stream:
     sid: int
@@ -171,6 +258,8 @@ class _Stream:
     token_times: list = field(default_factory=list)  # perf_counter stamps
     submitted: float = 0.0
     done: bool = False
+    taken: int = 0  # tokens already handed out via take_tokens()
+    prefilled: dict | None = None  # external KV payload (k/v/first_token)
 
 
 class RaggedDecoder:
@@ -184,8 +273,18 @@ class RaggedDecoder:
 
     def __init__(self, params, cfg: LlamaConfig, *, slots: int = 8,
                  max_len: int = 512, chunk_tokens: int = 32,
-                 prompt_buckets: tuple = (32, 64, 128, 256)):
+                 prompt_buckets: tuple = (32, 64, 128, 256),
+                 prefix_cache=None, name: str = "default",
+                 chunk_delay_s: float = 0.0):
         self.params = params
+        # Emulated per-chunk device dispatch latency for benchmarking
+        # the SERVING tier on hosts without an accelerator: on a real
+        # TPU each chunk waits on the device (the axon tunnel adds
+        # ~10-20ms/dispatch), time that overlaps perfectly across
+        # replicas — a sleep is the CPU stand-in for it, same idiom as
+        # the injected per-chunk latency in the pipelined-pull floor
+        # test (loopback cannot exhibit cross-host RTT either).
+        self.chunk_delay_s = chunk_delay_s
         self.cfg = cfg
         self.slots = slots
         self.max_len = max_len
@@ -199,6 +298,14 @@ class RaggedDecoder:
         self.finished: dict[int, _Stream] = {}
         # (stream, device tok0) fetched with the next chunk's device_get
         self._pending_first: list = []
+        # sid -> stream for every not-yet-purged stream (streaming reads)
+        self._by_sid: dict[int, _Stream] = {}
+        self.prefix_cache = prefix_cache  # models.kv_prefix_cache or None
+        self.name = name
+        self._total_tokens = 0
+        # (stamp, n_tokens) per pump for the tokens/s scaling signal
+        self._rate_window: collections.deque = collections.deque()
+        self._metrics_t = 0.0
 
     # -- submission boundary --
 
@@ -219,10 +326,65 @@ class RaggedDecoder:
                     submitted=time.perf_counter())
         self._next_sid += 1
         self.queue.append(s)
+        self._by_sid[s.sid] = s
+        return s.sid
+
+    def submit_prefilled(self, prompt_tokens, max_new: int,
+                         kv: dict) -> int:
+        """Enqueue a stream whose prefill already happened elsewhere
+        (a dedicated prefill worker, serve/llm_pool.py). `kv`:
+        {"k"/"v": [n_layers, S, n_kv_heads, head_dim] with S == this
+        engine's max_len, "first_token": int, "true_len": int}.
+        Admission is a pure slot scatter — no prefill dispatch."""
+        prompt = np.asarray(prompt_tokens, np.int32)
+        k = np.asarray(kv["k"])
+        if k.shape[1] != self.max_len:
+            raise ValueError(
+                f"prefilled KV has {k.shape[1]} rows; this engine's "
+                f"slots hold {self.max_len} (prefill and decode pools "
+                f"must agree on max_len)")
+        if int(kv["true_len"]) != len(prompt):
+            raise ValueError("prefilled true_len != prompt length")
+        room = self.max_len - len(prompt) - 1
+        if room < 1:
+            raise ValueError(
+                f"prompt of {len(prompt)} tokens leaves no decode room "
+                f"in a max_len={self.max_len} cache")
+        s = _Stream(self._next_sid, prompt, min(max_new, room),
+                    submitted=time.perf_counter(),
+                    prefilled={"k": k, "v": np.asarray(kv["v"]),
+                               "first_token": int(kv["first_token"])})
+        self._next_sid += 1
+        self.queue.append(s)
+        self._by_sid[s.sid] = s
         return s.sid
 
     def pop_finished(self, sid: int) -> _Stream | None:
+        self._by_sid.pop(sid, None)
         return self.finished.pop(sid, None)
+
+    def purge(self, sid: int) -> None:
+        """Drop a finished/abandoned stream's bookkeeping."""
+        self._by_sid.pop(sid, None)
+        self.finished.pop(sid, None)
+
+    def take_tokens(self, sid: int) -> tuple[list, bool]:
+        """Streaming read: tokens appended since the last take, plus a
+        done flag. Safe to call from a handler thread while the pump
+        appends (list append/slice are atomic under the GIL; the pump
+        only ever appends). A fully-drained finished stream is purged
+        on the way out."""
+        s = self._by_sid.get(sid)
+        if s is None:
+            return [], True
+        n = len(s.tokens)
+        new = s.tokens[s.taken:n]
+        s.taken = n
+        done = s.done and s.sid in self.finished
+        if done and s.taken >= len(s.tokens):
+            self.purge(sid)
+            return new, True
+        return new, False
 
     # -- engine internals --
 
@@ -240,8 +402,31 @@ class RaggedDecoder:
             grabbed.append((free.pop(), self.queue.popleft()))
         if not grabbed:
             return
-        by_bucket: dict[int, list] = {}
+        cold: list[tuple[int, _Stream]] = []
+        t_now = time.perf_counter()
         for slot, s in grabbed:
+            if s.prefilled is not None:
+                # disaggregated path: the KV rows were computed by a
+                # prefill worker; admission is one scatter dispatch and
+                # the first token is already known host-side
+                p = s.prefilled
+                self.cache, self.cur_tok = _adopt_kv_into_slot(
+                    jnp.asarray(p["k"], self.cfg.compute_dtype),
+                    jnp.asarray(p["v"], self.cfg.compute_dtype),
+                    np.int32(len(s.prompt)),
+                    np.int32(p["first_token"]), np.int32(slot),
+                    self.cache, self.cur_tok, self.cfg)
+                s.tokens.append(p["first_token"])
+                s.token_times.append(t_now)
+                s.prefilled = None  # free the host slab
+                self.slot_stream[slot] = s
+            elif self.prefix_cache is not None and self._admit_warm(
+                    slot, s):
+                pass  # adopted a cached prefix + suffix prefill
+            else:
+                cold.append((slot, s))
+        by_bucket: dict[int, list] = {}
+        for slot, s in cold:
             by_bucket.setdefault(
                 self._bucket(len(s.prompt)), []).append((slot, s))
         f = self.slots  # static prefill width: one compile per bucket
@@ -264,6 +449,64 @@ class RaggedDecoder:
             for i, (slot, s) in enumerate(entries):
                 self._pending_first.append((s, toks0[i]))
                 self.slot_stream[slot] = s
+            if self.prefix_cache is not None:
+                self._insert_prefixes(entries)
+
+    def _admit_warm(self, slot: int, s: _Stream) -> bool:
+        """Try the prefix-cache warm path for one stream: adopt the
+        longest cached block-aligned prefix and prefill only the
+        suffix. Returns False (cold path) on a miss, a sub-block hit,
+        or when no suffix bucket fits the remaining cache rows. The
+        miss depth is remembered on the stream so the post-prefill
+        insert fetches only rows the cache lacks."""
+        pc = self.prefix_cache
+        n_pref, entry = pc.match(s.prompt)
+        s.__dict__["_pc_have"] = n_pref
+        if entry is None:
+            pc.record_outcome(False)
+            return False
+        suffix = s.prompt[n_pref:]
+        try:
+            sb = self._bucket(len(suffix))
+        except ValueError:
+            pc.record_outcome(False)  # matched but unusable: cold path
+            return False
+        if n_pref + sb > self.max_len:
+            # the static suffix write window would clamp into the prefix
+            pc.record_outcome(False)
+            return False
+        pad_k = np.zeros(
+            (self.cfg.n_layers, self.max_len, self.cfg.n_kv_heads,
+             self.cfg.head_dim), dtype=entry["k"].dtype)
+        pad_v = np.zeros_like(pad_k)
+        pad_k[:, :n_pref] = entry["k"][:, :n_pref]
+        pad_v[:, :n_pref] = entry["v"][:, :n_pref]
+        suf = np.zeros((sb,), np.int32)
+        suf[:len(suffix)] = suffix
+        self.cache, self.cur_tok, tok0 = _prefill_suffix_into_slot(
+            self.params, jnp.asarray(pad_k, self.cfg.compute_dtype),
+            jnp.asarray(pad_v, self.cfg.compute_dtype),
+            np.int32(n_pref), jnp.asarray(suf),
+            np.int32(len(suffix)), np.int32(slot),
+            self.cache, self.cur_tok, self.cfg)
+        self._pending_first.append((s, tok0))
+        self.slot_stream[slot] = s
+        pc.record_outcome(True)  # cached rows actually served
+        return True
+
+    def _insert_prefixes(self, entries) -> None:
+        """After a cold batched prefill, capture each stream's
+        block-aligned prefix rows into the prefix cache. Costs one
+        device_get per stream that actually has uncached blocks — the
+        amortized price of never prefilling that prefix again."""
+        pc = self.prefix_cache
+        for slot, s in entries:
+            n_ins = ((len(s.prompt) - 1) // pc.block) * pc.block
+            if n_ins < pc.block or s.__dict__.get("_pc_have", 0) >= n_ins:
+                continue
+            k, v = jax.device_get((self.cache["k"][:, slot, :n_ins],
+                                   self.cache["v"][:, slot, :n_ins]))
+            pc.insert(s.prompt[:n_ins], k, v)
 
     def pump(self) -> int:
         """Admit + advance one chunk; returns number of active slots.
@@ -280,25 +523,86 @@ class RaggedDecoder:
         toks, self.cache, self.cur_tok = decode_chunk(
             self.params, self.cache, self.cur_tok,
             active_mask, self.cfg, self.chunk)
+        if self.chunk_delay_s:
+            time.sleep(self.chunk_delay_s)  # see __init__: emulated
+            # device dispatch latency (GIL released; replicas overlap)
         firsts, self._pending_first = self._pending_first, []
         toks, pos_np, first_toks = jax.device_get(
             (toks, self.cache["pos"], [t for _, t in firsts]))
         t_now = time.perf_counter()
+        delivered = 0
         for (s, _), t0 in zip(firsts, first_toks):
             s.tokens.append(int(t0))
             s.token_times.append(t_now)
+            delivered += 1
         for slot, s in enumerate(self.slot_stream):
             if s is None:
                 continue
             take = min(self.chunk, s.max_new - len(s.tokens))
             s.tokens.extend(int(t) for t in toks[slot, :take])
             s.token_times.extend([t_now] * take)
+            delivered += take
             if len(s.tokens) >= s.max_new \
                     or int(pos_np[slot]) >= self.max_len - 1:
                 s.done = True
                 self.finished[s.sid] = s
                 self.slot_stream[slot] = None  # slot freed THIS chunk
+        self._account(t_now, delivered)
         return int(active_mask.sum())
+
+    RATE_WINDOW_S = 5.0
+    METRICS_PERIOD_S = 1.0
+
+    def _account(self, t_now: float, delivered: int) -> None:
+        self._total_tokens += delivered
+        w = self._rate_window
+        w.append((t_now, delivered))
+        while w and t_now - w[0][0] > self.RATE_WINDOW_S:
+            w.popleft()
+        if t_now - self._metrics_t >= self.METRICS_PERIOD_S:
+            self._metrics_t = t_now
+            self._export_metrics(self.stats())
+
+    def tokens_per_sec(self) -> float:
+        w = self._rate_window
+        if len(w) < 2:
+            return 0.0
+        span = w[-1][0] - w[0][0]
+        return sum(n for _, n in w) / span if span > 0 else 0.0
+
+    def stats(self) -> dict:
+        """Scaling signals for the serving pool (serve/llm_pool.py):
+        per-slot occupancy, queue depth, and recent tokens/s — also
+        exported as Prometheus gauges (util/metrics.py) alongside the
+        collective OpStats family."""
+        occupancy = [st.sid if st is not None else None
+                     for st in self.slot_stream]
+        active = sum(1 for st in self.slot_stream if st is not None)
+        out = {
+            "slots": self.slots,
+            "active": active,
+            "occupancy": occupancy,
+            "utilization": active / self.slots if self.slots else 0.0,
+            "queued": len(self.queue),
+            "tokens_per_sec": round(self.tokens_per_sec(), 1),
+            "total_tokens": self._total_tokens,
+        }
+        if self.prefix_cache is not None:
+            out["prefix_cache"] = self.prefix_cache.stats()
+        return out
+
+    def _export_metrics(self, st: dict) -> None:
+        try:
+            m = _get_metrics()
+            tags = {"engine": self.name}
+            m["active"].set(st["active"], tags)
+            m["queued"].set(st["queued"], tags)
+            m["tps"].set(st["tokens_per_sec"], tags)
+            pc = st.get("prefix_cache")
+            if pc is not None:
+                m["hit_rate"].set(pc["hit_rate"], tags)
+        except Exception:  # noqa: BLE001 — telemetry never breaks decode
+            pass
 
     def drain(self, deadline_s: float = 600.0) -> None:
         t0 = time.monotonic()

@@ -1,0 +1,161 @@
+"""Block-granular prefix/KV cache for repeated prompt prefixes.
+
+Serving traffic is dominated by requests that share a long fixed head
+(system prompt, few-shot preamble) followed by a short unique tail.
+Re-running prefill over the shared head burns the prefill budget on
+work whose result is identical every time: causal attention makes a
+token's k/v depend only on tokens at or before it, so the KV rows for
+a shared prefix are the same array for every request that starts with
+it (vLLM's automatic prefix caching / SGLang's RadixAttention make the
+same observation).
+
+This cache keys KV rows by a CHAIN HASH over token blocks: block i's
+key folds block i-1's key with block i's token bytes, so a lookup walks
+the prompt block by block and the deepest hit is the longest cached
+block-aligned prefix. Values are host-side numpy row slabs
+([n_layers, n_tokens, n_kv_heads, head_dim] for k and v) captured from
+a completed prefill; adoption writes them back into a decode slot and
+prefills only the remaining suffix. Eviction is LRU bounded by a byte
+budget (the HBM/host budget the serving tier grants the cache).
+
+Bit-exactness: k/v rows are row-independent functions of the prefix
+(per-position dense ops; causal attention over an identical, exactly
+softmax-masked prefix), so adopting cached rows and prefilling the
+suffix yields the same greedy tokens as a cold full prefill — asserted
+by tests/test_serve_llm_pool.py numerics tests.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+
+def _block_key(prev_key: bytes, block_tokens: np.ndarray) -> bytes:
+    h = hashlib.blake2b(digest_size=16)
+    h.update(prev_key)
+    h.update(np.ascontiguousarray(block_tokens, dtype=np.int32).tobytes())
+    return h.digest()
+
+
+def chain_keys(tokens: np.ndarray, block: int) -> list[bytes]:
+    """Chain hash per complete block: keys[i] covers tokens[: (i+1)*block]."""
+    keys: list[bytes] = []
+    prev = b"kvpc"
+    for start in range(0, (len(tokens) // block) * block, block):
+        prev = _block_key(prev, tokens[start:start + block])
+        keys.append(prev)
+    return keys
+
+
+class PrefixCache:
+    """LRU KV-prefix store. Thread-safe (the decode pump inserts while
+    handler threads may query stats).
+
+    Entries are keyed by the chain hash of their covered blocks; one
+    entry per distinct block-aligned prefix length, so a long shared
+    head costs one slab per block depth actually observed, and lookup
+    returns the deepest cached depth.
+    """
+
+    def __init__(self, block: int = 32, max_bytes: int = 256 * 2**20):
+        if block < 1:
+            raise ValueError(f"block must be >= 1, got {block}")
+        self.block = block
+        self.max_bytes = max_bytes
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[bytes, dict] = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.inserts = 0
+
+    # -- lookup / insert --
+
+    def match(self, tokens) -> tuple[int, dict | None]:
+        """Longest cached block-aligned prefix of `tokens`, capped at
+        len(tokens)-1 so at least the final prompt token always goes
+        through suffix prefill (its logits produce the first generated
+        token; the cache stores KV only). Does NOT count hit/miss —
+        the caller records the OUTCOME (record_outcome) once it knows
+        whether the match was actually served, so the exported hit
+        rate measures real reuse, not lookups."""
+        toks = np.asarray(tokens, np.int32)
+        usable = len(toks) - 1
+        best: dict | None = None
+        with self._lock:
+            for i, key in enumerate(chain_keys(toks, self.block)):
+                n = (i + 1) * self.block
+                if n > usable:
+                    break
+                e = self._entries.get(key)
+                if e is None:
+                    break  # chain broken: deeper keys can't exist either
+                self._entries.move_to_end(key)
+                best = e
+        return (best["n"], best) if best is not None else (0, None)
+
+    def record_outcome(self, hit: bool) -> None:
+        """One admission's outcome: True when cached rows were adopted,
+        False when the request prefilled cold (miss, or a match the
+        engine could not use)."""
+        with self._lock:
+            if hit:
+                self.hits += 1
+            else:
+                self.misses += 1
+
+    def insert(self, tokens, k_rows: np.ndarray, v_rows: np.ndarray,
+               *, min_blocks: int = 1) -> int:
+        """Cache every block-aligned prefix depth of `tokens` not already
+        present. k_rows/v_rows: [n_layers, >=n, n_kv_heads, head_dim]
+        host arrays covering at least the hashed prefix. Returns the
+        number of NEW entries inserted."""
+        toks = np.asarray(tokens, np.int32)
+        new = 0
+        with self._lock:
+            for i, key in enumerate(chain_keys(toks, self.block)):
+                n = (i + 1) * self.block
+                if i + 1 < min_blocks or n > k_rows.shape[1]:
+                    continue
+                if key in self._entries:
+                    self._entries.move_to_end(key)
+                    continue
+                k = np.ascontiguousarray(k_rows[:, :n])
+                v = np.ascontiguousarray(v_rows[:, :n])
+                nbytes = k.nbytes + v.nbytes
+                self._entries[key] = {"n": n, "k": k, "v": v,
+                                      "nbytes": nbytes}
+                self._bytes += nbytes
+                self.inserts += 1
+                new += 1
+            while self._bytes > self.max_bytes and self._entries:
+                _, old = self._entries.popitem(last=False)
+                self._bytes -= old["nbytes"]
+                self.evictions += 1
+        return new
+
+    # -- introspection --
+
+    def stats(self) -> dict:
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "max_bytes": self.max_bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "inserts": self.inserts,
+                "evictions": self.evictions,
+                "hit_rate": (self.hits / total) if total else 0.0,
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0

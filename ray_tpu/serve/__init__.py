@@ -42,3 +42,13 @@ from ray_tpu.serve.multiplex import (  # noqa: F401
     get_multiplexed_model_id,
     multiplexed,
 )
+
+
+def __getattr__(name):
+    # llm_pool pulls in jax via serve.llm; keep `import ray_tpu.serve`
+    # light for non-LLM users by resolving the pool surface lazily
+    if name in ("LLMPool", "PrefillWorker", "run_llm_pool"):
+        from ray_tpu.serve import llm_pool
+
+        return getattr(llm_pool, name)
+    raise AttributeError(f"module 'ray_tpu.serve' has no attribute {name!r}")

@@ -374,10 +374,12 @@ class _Controller:
     # -- autoscaling --
 
     @ray_tpu.method(concurrency_group="metrics")
-    def report_metrics(self, name: str, handle_id: str, in_flight: int):
+    def report_metrics(self, name: str, handle_id: str, in_flight: int,
+                       ttft_p99_s: float | None = None):
         import time as t
 
-        self._metrics.setdefault(name, {})[handle_id] = (t.time(), in_flight)
+        self._metrics.setdefault(name, {})[handle_id] = (
+            t.time(), in_flight, ttft_p99_s)
 
     def _autoscale_loop(self):
         while not self._stop.wait(self.AUTOSCALE_PERIOD_S):
@@ -395,7 +397,6 @@ class _Controller:
                     logger.exception("autoscale failed for %s", name)
 
     def _autoscale_deployment(self, name: str, d: dict):
-        import math
         import time as t
 
         import ray_tpu as rt
@@ -403,13 +404,24 @@ class _Controller:
         cfg = d.get("autoscaling")
         if not cfg:
             return
+        from ray_tpu.autoscaler.demand_scheduler import (
+            serve_replica_demand,
+        )
+
         now = t.time()
-        reports = self._metrics.get(name, {})
-        total = sum(n for (ts, n) in reports.values() if now - ts < 5.0)
-        target = cfg.get("target_num_ongoing_requests_per_replica", 2)
-        desired = math.ceil(total / max(target, 1e-9))
-        desired = max(cfg.get("min_replicas", 1),
-                      min(cfg.get("max_replicas", 8), desired))
+        fresh = [r for r in self._metrics.get(name, {}).values()
+                 if now - r[0] < 5.0]
+        total = sum(r[1] for r in fresh)
+        ttfts = [r[2] for r in fresh if len(r) > 2 and r[2] is not None]
+        desired = serve_replica_demand(
+            queue_depth=0, inflight=total,
+            n_replicas=len(d["replicas"]),
+            min_replicas=cfg.get("min_replicas", 1),
+            max_replicas=cfg.get("max_replicas", 8),
+            target_queue_per_replica=cfg.get(
+                "target_num_ongoing_requests_per_replica", 2),
+            ttft_p99_s=max(ttfts) if ttfts else None,
+            target_ttft_s=cfg.get("target_ttft_s"))
         cur = len(d["replicas"])
         if desired > cur:
             new = [
@@ -502,13 +514,26 @@ class Deployment:
 
     def __init__(self, cls, *, num_replicas=1, max_concurrent_queries=8,
                  resources=None, name=None, route_prefix=None,
-                 autoscaling_config=None, user_config=None):
+                 autoscaling_config=None, user_config=None,
+                 min_replicas=None, max_replicas=None,
+                 target_ttft_s=None):
         self._cls = cls
         self.num_replicas = num_replicas
         self.max_concurrent_queries = max_concurrent_queries
         self.resources = resources or {"CPU": 0}
         self.name = name or cls.__name__
         self.route_prefix = route_prefix
+        # first-class serving-tier knobs fold into autoscaling_config
+        # (the controller's scale loop and the LLM pool both read them)
+        if (min_replicas is not None or max_replicas is not None
+                or target_ttft_s is not None):
+            autoscaling_config = dict(autoscaling_config or {})
+            if min_replicas is not None:
+                autoscaling_config["min_replicas"] = min_replicas
+            if max_replicas is not None:
+                autoscaling_config["max_replicas"] = max_replicas
+            if target_ttft_s is not None:
+                autoscaling_config["target_ttft_s"] = target_ttft_s
         self.autoscaling_config = autoscaling_config
         self.user_config = user_config
 

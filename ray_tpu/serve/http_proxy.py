@@ -12,6 +12,14 @@ GET /prefix?a=1 -> handle.remote({query params})
 POST /prefix    -> handle.remote(json_body)
 Response: JSON-encoded return value, 200; unknown route 404; user
 exception 500 with the error string.
+
+Token streaming: a POST body with {"stream": true} switches the
+response to HTTP/1.1 chunked transfer-encoding. The proxy calls the
+deployment's `submit_stream(body)` (-> {"rid"|"sid"}), then loops
+`poll_stream(id)` and writes each non-empty token batch as one chunk
+(a JSON line `{"tokens": [...]}`), ending with `{"done": true}` — the
+serve-side analog of job_submission log tailing, built for
+serve/llm.py and serve/llm_pool.py streams.
 """
 
 from __future__ import annotations
@@ -98,6 +106,24 @@ class _ProxyServer:
                 n = int(headers.get("content-length", 0))
                 if n:
                     body = await reader.readexactly(n)
+                req = None
+                if body:
+                    try:
+                        req = json.loads(body)
+                    except json.JSONDecodeError:
+                        req = None
+                if isinstance(req, dict) and req.get("stream"):
+                    handled = await self._serve_stream(writer, target,
+                                                       req)
+                    if handled:
+                        if headers.get("connection",
+                                       "").lower() == "close":
+                            break
+                        continue
+                    # not a streaming-capable deployment (submit_stream
+                    # missing/failed before any bytes went out): fall
+                    # through to the normal dispatch so schemas that
+                    # happen to carry a "stream" key keep working
                 status, payload = await asyncio.get_running_loop() \
                     .run_in_executor(None, self._dispatch, method,
                                      target, body)
@@ -118,6 +144,82 @@ class _ProxyServer:
                 writer.close()
             except Exception:  # noqa: BLE001
                 pass
+
+    async def _serve_stream(self, writer, target: str,
+                            req: dict) -> bool:
+        """Chunked-transfer token streaming (see module docstring).
+        Submit/poll run on the executor pool (they block on actor
+        calls); only the writes happen on the loop. Returns False —
+        with NOTHING written — when the route is missing or the
+        deployment cannot accept the stream, so the caller falls back
+        to the normal dispatch path."""
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        parts = urlsplit(target)
+        route = _match_route(self.routes, parts.path)
+
+        def _chunk(payload: dict) -> bytes:
+            data = (json.dumps(payload) + "\n").encode()
+            return f"{len(data):x}\r\n".encode() + data + b"\r\n"
+
+        if route is None:
+            return False  # normal dispatch owns the 404
+        name = self.routes[route]
+        try:
+            handle = self._handle_for(name)
+            # submit and every poll must land on the SAME replica (the
+            # stream state lives there); the multiplex model-id hint
+            # pins both to one preferred replica when the deployment
+            # runs more than one (best-effort under backpressure — the
+            # LLM pool architecture keeps its pool deployment at one
+            # replica precisely so this can never diverge)
+            import os as _os
+
+            skey = _os.urandom(8).hex()
+            sub = await loop.run_in_executor(
+                None, lambda: ray_tpu.get(
+                    handle.options(multiplexed_model_id=skey,
+                                   method_name="submit_stream")
+                    .remote(req),
+                    timeout=120))
+            rid = sub.get("rid", sub.get("sid"))
+        except Exception as e:  # noqa: BLE001 — submit failed before
+            # any response bytes: let the normal dispatch serve it
+            logger.debug("stream submit to %s failed (%s); falling "
+                         "back to plain dispatch", name, e)
+            return False
+        writer.write(
+            "HTTP/1.1 200 OK\r\n"
+            "Content-Type: application/x-ndjson\r\n"
+            "Transfer-Encoding: chunked\r\n"
+            "Connection: keep-alive\r\n\r\n".encode())
+        await writer.drain()
+        try:
+            while True:
+                out = await loop.run_in_executor(
+                    None, lambda: ray_tpu.get(
+                        handle.options(multiplexed_model_id=skey,
+                                       method_name="poll_stream")
+                        .remote(rid),
+                        timeout=120))
+                if out["tokens"]:
+                    writer.write(_chunk({"tokens": out["tokens"]}))
+                    await writer.drain()
+                if out["done"]:
+                    break
+                await asyncio.sleep(0.02)
+            writer.write(_chunk({"done": True}))
+        except Exception as e:  # noqa: BLE001 — mid-stream failure:
+            # status already went out; signal in-band and terminate
+            logger.warning("stream to %s failed: %s", name, e)
+            try:
+                writer.write(_chunk({"error": str(e)}))
+            except Exception:  # noqa: BLE001
+                pass
+        writer.write(b"0\r\n\r\n")
+        await writer.drain()
+        return True
 
     def _dispatch(self, method: str, target: str, body: bytes):
         """Blocking route->handle call; runs on the executor pool."""

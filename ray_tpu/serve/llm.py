@@ -9,12 +9,64 @@ threads (the replica runs with actor max_concurrency) only enqueue and
 wait; every device step happens on the ONE pump thread, so concurrent
 HTTP requests ride the same slot batch — admission into free slots at
 chunk boundaries, not a new batch per request.
+
+Multi-replica serving (serve/llm_pool.py LLMPool) builds on the extras
+here: `params_blob` lets every replica adopt ONE published weight blob
+(a single object-store put, pulled via the pipelined multi-source
+path) instead of re-serializing per replica; `adopt_prefilled` admits
+KV computed by a dedicated prefill worker; `submit_stream`/
+`poll_stream` expose token streaming; `shutdown()` is the
+deterministic drain used on replica downscale.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+
+
+def build_model(model_size: str = "tiny", *, max_len: int = 512,
+                vocab_size: int = 32128, seed: int = 0,
+                params_blob=None):
+    """(params, cfg) for a serving model — shared by decode replicas
+    and prefill workers so both pools run the identical network. When
+    `params_blob` (a host tree published through the object store) is
+    given, weights are adopted instead of re-initialized: one shared
+    put serves every replica via the multi-source pull path."""
+    import jax
+
+    from ray_tpu.models import llama
+
+    import ray_tpu
+
+    if isinstance(params_blob, ray_tpu.ObjectRef):
+        # actor CONSTRUCTOR args ship as an opaque payload (no dep
+        # staging, unlike method calls) — resolve the published weight
+        # ref here, via the pipelined multi-source pull
+        params_blob = ray_tpu.get(params_blob, timeout=600)
+
+    if model_size == "tiny":  # test-sized config
+        cfg = llama.LlamaConfig(
+            vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+            n_kv_heads=2, d_ff=128, max_seq_len=max_len,
+            dtype="float32", remat=False)
+    elif model_size == "tiny-wide":  # bench-sized: compute-bound on CPU
+        cfg = llama.LlamaConfig(
+            vocab_size=512, d_model=256, n_layers=4, n_heads=8,
+            n_kv_heads=4, d_ff=512, max_seq_len=max_len,
+            dtype="float32", remat=False)
+    else:
+        base = llama.llama2_size(model_size)
+        cfg = llama.LlamaConfig(**{
+            **base.__dict__, "vocab_size": vocab_size,
+            "max_seq_len": max_len, "dtype": "bfloat16",
+            "remat": False,
+        })
+    if params_blob is not None:
+        params = jax.tree_util.tree_map(jax.numpy.asarray, params_blob)
+    else:
+        params = llama.init_params(cfg, jax.random.PRNGKey(seed))
+    return params, cfg
 
 
 class LLMServer:
@@ -25,10 +77,15 @@ class LLMServer:
     thread until the stream finishes and returns tokens + per-token
     latency stamps, so the caller can compute p50/p99."""
 
+    STREAM_IDLE_PURGE_S = 120.0  # abandoned streaming sids
+
     def __init__(self, model_size: str = "tiny", *, slots: int = 8,
                  max_len: int = 512, chunk_tokens: int = 16,
                  vocab_size: int = 32128, seed: int = 0,
-                 prompt_buckets: tuple = (32, 64, 128, 256)):
+                 prompt_buckets: tuple = (32, 64, 128, 256),
+                 params_blob=None, prefix_cache_block: int = 0,
+                 prefix_cache_mb: int = 256, engine_name: str = "",
+                 chunk_delay_s: float = 0.0):
         import os
 
         import jax
@@ -38,28 +95,30 @@ class LLMServer:
             # every process; the env var alone is silently ignored
             jax.config.update("jax_platforms", "cpu")
 
-        from ray_tpu.models import llama
         from ray_tpu.models.decode_engine import RaggedDecoder
 
-        if model_size == "tiny":  # test-sized config
-            cfg = llama.LlamaConfig(
-                vocab_size=256, d_model=64, n_layers=2, n_heads=4,
-                n_kv_heads=2, d_ff=128, max_seq_len=max_len,
-                dtype="float32", remat=False)
-        else:
-            base = llama.llama2_size(model_size)
-            cfg = llama.LlamaConfig(**{
-                **base.__dict__, "vocab_size": vocab_size,
-                "max_seq_len": max_len, "dtype": "bfloat16",
-                "remat": False,
-            })
-        params = llama.init_params(cfg, jax.random.PRNGKey(seed))
+        params, cfg = build_model(
+            model_size, max_len=max_len, vocab_size=vocab_size,
+            seed=seed, params_blob=params_blob)
+        prefix_cache = None
+        if prefix_cache_block > 0:
+            from ray_tpu.models.kv_prefix_cache import PrefixCache
+
+            prefix_cache = PrefixCache(
+                block=prefix_cache_block,
+                max_bytes=prefix_cache_mb * 2**20)
         self.engine = RaggedDecoder(
             params, cfg, slots=slots, max_len=max_len,
-            chunk_tokens=chunk_tokens, prompt_buckets=prompt_buckets)
+            chunk_tokens=chunk_tokens, prompt_buckets=prompt_buckets,
+            prefix_cache=prefix_cache, chunk_delay_s=chunk_delay_s,
+            name=engine_name or f"llm-{os.getpid()}")
         self._lock = threading.Lock()
         self._done_events: dict[int, threading.Event] = {}
+        # sids being consumed via poll_stream: the pump must NOT purge
+        # their finished entries (no _done_events waiter is registered)
+        self._stream_sids: dict[int, float] = {}  # sid -> last poll
         self._stop = False
+        self._draining = False
         self._pump_thread = threading.Thread(
             target=self._pump_loop, daemon=True,
             name="llm-decode-pump")
@@ -82,29 +141,42 @@ class LLMServer:
                 # rejects bad requests; this is the backstop)
                 logging.getLogger(__name__).exception("decode pump error")
                 busy = 0
+            now = time.monotonic()
             with self._lock:
                 for sid, ev in list(self._done_events.items()):
                     if sid in self.engine.finished:
                         ev.set()
                 for sid in list(self.engine.finished):
-                    if sid not in self._done_events:
+                    if sid not in self._done_events \
+                            and sid not in self._stream_sids:
                         # abandoned (handler timed out): don't pin the
                         # stream's tokens forever
-                        self.engine.finished.pop(sid, None)
+                        self.engine.purge(sid)
+                for sid, last in list(self._stream_sids.items()):
+                    if now - last > self.STREAM_IDLE_PURGE_S:
+                        # streaming client went away mid-stream
+                        self._stream_sids.pop(sid, None)
+                        self.engine.purge(sid)
             if not busy:
                 time.sleep(0.005)  # idle: don't spin the device
 
-    def generate(self, prompt_ids: list, max_tokens: int = 64) -> dict:
-        """Blocking single-request API (one handler thread per call;
-        all calls share the slot batch)."""
+    # -- blocking API --
+
+    def _submit_locked(self, submit_fn):
         ev = threading.Event()
         with self._lock:
+            if self._draining:
+                raise RuntimeError("replica draining: not admitting")
             # submit() validates (prompt fits a bucket, room for at
             # least one token) and raises HERE, in the handler — the
             # proxy maps it to a per-request 500 instead of the pump
             # thread dying on it
-            sid = self.engine.submit(prompt_ids, max_tokens)
+            sid = submit_fn()
             self._done_events[sid] = ev
+        return sid, ev
+
+    def _wait_result(self, sid: int, ev: threading.Event,
+                     max_tokens: int) -> dict:
         try:
             if not ev.wait(timeout=600):
                 raise TimeoutError(
@@ -122,6 +194,72 @@ class LLMServer:
             "token_times_s": s.token_times[:max_tokens],
         }
 
+    def generate(self, prompt_ids: list, max_tokens: int = 64) -> dict:
+        """Blocking single-request API (one handler thread per call;
+        all calls share the slot batch)."""
+        sid, ev = self._submit_locked(
+            lambda: self.engine.submit(list(prompt_ids), int(max_tokens)))
+        return self._wait_result(sid, ev, int(max_tokens))
+
+    def adopt_prefilled(self, kv: dict, prompt_ids: list,
+                        max_tokens: int = 64) -> dict:
+        """Blocking generate for a stream prefilled ELSEWHERE: `kv` is
+        the prefill worker's payload (decode_engine.prefill_kv rows +
+        first token), typically passed as an ObjectRef so the KV rows
+        ride the object store straight from the prefill worker's node
+        to this replica (pipelined multi-source pull), never through
+        the pool."""
+        sid, ev = self._submit_locked(
+            lambda: self.engine.submit_prefilled(
+                list(prompt_ids), int(max_tokens), kv))
+        return self._wait_result(sid, ev, int(max_tokens))
+
+    # -- streaming API --
+
+    def submit_stream(self, req: dict) -> dict:
+        """Start a stream; poll_stream drains it incrementally. `req`
+        may carry a prefilled KV payload under "kv"."""
+        prompt_ids = list(req["prompt_ids"])
+        max_tokens = int(req.get("max_tokens", 64))
+        with self._lock:
+            if self._draining:
+                raise RuntimeError("replica draining: not admitting")
+            if req.get("kv") is not None:
+                sid = self.engine.submit_prefilled(
+                    prompt_ids, max_tokens, req["kv"])
+            else:
+                sid = self.engine.submit(prompt_ids, max_tokens)
+            self._stream_sids[sid] = time.monotonic()
+        return {"sid": sid}
+
+    def submit_stream_prefilled(self, kv: dict, prompt_ids: list,
+                                max_tokens: int = 64) -> dict:
+        """submit_stream for an externally-prefilled stream. `kv` is a
+        dedicated TOP-LEVEL argument (not nested in a request dict) so
+        an ObjectRef passed here is resolved by the executor's arg
+        staging — the KV rows ride the object store from the prefill
+        worker's node, never through the caller."""
+        with self._lock:
+            if self._draining:
+                raise RuntimeError("replica draining: not admitting")
+            sid = self.engine.submit_prefilled(
+                list(prompt_ids), int(max_tokens), kv)
+            self._stream_sids[sid] = time.monotonic()
+        return {"sid": sid}
+
+    def poll_stream(self, sid: int) -> dict:
+        """New tokens since the last poll + done flag. The final poll
+        (done=True) releases the stream."""
+        sid = int(sid)
+        with self._lock:
+            if sid not in self._stream_sids:
+                return {"tokens": [], "done": True}
+            self._stream_sids[sid] = time.monotonic()
+            new, done = self.engine.take_tokens(sid)
+            if done:
+                self._stream_sids.pop(sid, None)
+        return {"tokens": new, "done": done}
+
     def __call__(self, req: dict) -> dict:
         """HTTP entrypoint (serve http_proxy: POST body -> __call__):
         {"prompt_ids": [...], "max_tokens": N} -> generate()."""
@@ -130,12 +268,39 @@ class LLMServer:
 
     def stats(self) -> dict:
         with self._lock:
-            return {
-                "queued": len(self.engine.queue),
-                "active": sum(1 for x in self.engine.slot_stream
-                              if x is not None),
-                "slots": self.engine.slots,
-            }
+            st = self.engine.stats()
+            st["draining"] = self._draining
+            st["waiters"] = len(self._done_events)
+            return st
+
+    def health(self) -> bool:
+        return not self._stop
+
+    # -- lifecycle --
+
+    def shutdown(self, drain_s: float = 30.0) -> bool:
+        """Deterministic teardown for graceful replica drain (the pool
+        calls this on downscale): reject new admits, let in-flight
+        streams finish (bounded by drain_s), then stop and join the
+        pump thread. Returns True when everything drained in time."""
+        with self._lock:
+            self._draining = True
+        deadline = time.monotonic() + max(0.0, drain_s)
+        drained = True
+        while time.monotonic() < deadline:
+            with self._lock:
+                busy = (self.engine.queue
+                        or any(s is not None
+                               for s in self.engine.slot_stream)
+                        or self._done_events or self._stream_sids)
+            if not busy:
+                break
+            time.sleep(0.02)
+        else:
+            drained = False
+        self._stop = True
+        self._pump_thread.join(timeout=10.0)
+        return drained and not self._pump_thread.is_alive()
 
     def __del__(self):
         self._stop = True

@@ -1,0 +1,643 @@
+"""LLMPool: multi-replica continuous-batching decode service.
+
+The heavy-traffic serving tier. One pool deployment fronts N LLMServer
+decode replicas behind a shared admission queue:
+
+    proxy/handles ──> LLMPool ──admission queue──> decode replicas
+                         │                            ▲
+                         └──> prefill workers ──KV via object store┘
+
+- **Replica scaling.** A background loop feeds queue depth, in-flight
+  load, and the observed TTFT p99 into
+  `autoscaler.demand_scheduler.serve_replica_demand` and reconciles the
+  replica set between `min_replicas`/`max_replicas`; downscale drains a
+  replica (no new admits, in-flight streams finish, explicit
+  `LLMServer.shutdown()`) before killing it.
+- **Prefill/decode disaggregation (Podracer-style pool
+  specialization).** Prompts at or above `prefill_threshold` are
+  prefilled by dedicated PrefillWorker actors
+  (`decode_engine.prefill_kv`); the KV rows + first token travel as an
+  object-store ref straight from the prefill worker to the adopting
+  decode replica (PR-9 pipelined pull), so long prompts never stall a
+  decode pump's chunk cadence.
+- **One-put weight publishing.** The pool builds the model once,
+  `ray_tpu.put`s the host weight tree, and every replica (and prefill
+  worker) constructor adopts the same ref — replicas added by the
+  autoscaler pull from any node already holding the blob (multi-source
+  striped pull), never from a per-replica serialization.
+- **Failover.** A replica death re-queues its in-flight requests to
+  survivors with no client-visible error (greedy decode is
+  deterministic, so re-decoded streams resume with already-emitted
+  tokens de-duplicated by offset).
+- **Streaming.** submit_stream/poll_stream mirror the replica API and
+  ride the HTTP proxy's chunked-response path.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+import ray_tpu
+from ray_tpu.serve.llm import LLMServer, build_model
+
+logger = logging.getLogger(__name__)
+
+
+class PrefillWorker:
+    """Dedicated prefill pool member: computes KV rows + the first
+    greedy token for a prompt and returns them as the task result —
+    which lands in the object store on THIS worker's node, so the
+    adopting decode replica pulls it point-to-point."""
+
+    def __init__(self, model_size: str = "tiny", *, max_len: int = 512,
+                 vocab_size: int = 32128, seed: int = 0,
+                 prompt_buckets: tuple = (32, 64, 128, 256),
+                 params_blob=None):
+        import os
+
+        import jax
+
+        if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+            jax.config.update("jax_platforms", "cpu")
+        self.params, self.cfg = build_model(
+            model_size, max_len=max_len, vocab_size=vocab_size,
+            seed=seed, params_blob=params_blob)
+        self.max_len = max_len
+        self.buckets = tuple(sorted(prompt_buckets))
+
+    def prefill(self, prompt_ids: list) -> dict:
+        """-> {"k", "v", "first_token", "true_len"} — the payload
+        `RaggedDecoder.submit_prefilled` adopts."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ray_tpu.models.decode_engine import prefill_kv
+
+        prompt = np.asarray(prompt_ids, np.int32)
+        bucket = next((b for b in self.buckets if len(prompt) <= b), None)
+        if bucket is None:
+            raise ValueError(
+                f"prompt of {len(prompt)} tokens exceeds the largest "
+                f"bucket {self.buckets[-1]}")
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :len(prompt)] = prompt
+        k, v, toks0 = prefill_kv(
+            self.params, jnp.asarray(padded),
+            jnp.asarray([len(prompt)], jnp.int32), self.cfg,
+            self.max_len)
+        k, v, tok0 = jax.device_get((k[:, 0], v[:, 0], toks0[0]))
+        return {"k": k, "v": v, "first_token": int(tok0),
+                "true_len": len(prompt)}
+
+    def health(self) -> bool:
+        return True
+
+
+# actor wrappers (num_cpus=0: pool members are pinned by the pool's own
+# replica budget, not the CPU bin-packer — mirrors serve's replicas)
+_DecodeReplica = ray_tpu.remote(num_cpus=0)(LLMServer)
+_PrefillActor = ray_tpu.remote(num_cpus=0)(PrefillWorker)
+
+
+class _Replica:
+    """Pool-side record of one decode replica."""
+
+    __slots__ = ("handle", "inflight", "draining", "dead", "name")
+
+    def __init__(self, handle, name: str):
+        self.handle = handle
+        self.inflight = 0
+        self.draining = False
+        self.dead = False
+        self.name = name
+
+
+_pool_metrics = None
+
+
+def _get_pool_metrics():
+    global _pool_metrics
+    if _pool_metrics is None:
+        from ray_tpu.util import metrics as M
+
+        _pool_metrics = {
+            "replicas": M.Gauge(
+                "llm_pool_replicas", "live decode replicas"),
+            "queue": M.Gauge(
+                "llm_pool_queue_depth", "requests awaiting a replica"),
+            "ttft_p99": M.Gauge(
+                "llm_pool_ttft_p99_s", "TTFT p99 over the recent window"),
+        }
+    return _pool_metrics
+
+
+class LLMPool:
+    """Deployable pool (serve.run(Deployment(LLMPool, ...)) or direct).
+
+    All configuration flows through the constructor; `min_replicas`/
+    `max_replicas`/`target_ttft_s` mirror the serve deployment options
+    of the same names (serve/api.py) — `run_llm_pool` plumbs them."""
+
+    ACQUIRE_TIMEOUT_S = 120.0
+    AUTOSCALE_PERIOD_S = 1.0
+    TTFT_WINDOW_S = 30.0
+    DRAIN_POLL_S = 0.1
+    # one spawn wave per cooldown: the TTFT window holds breach samples
+    # for up to TTFT_WINDOW_S after a transient spike, and without a
+    # cooldown the +1-per-tick SLO rule would ratchet straight to
+    # max_replicas before new capacity could absorb anything
+    SCALE_UP_COOLDOWN_S = 5.0
+
+    def __init__(self, model_size: str = "tiny", *, slots: int = 8,
+                 max_len: int = 512, chunk_tokens: int = 16,
+                 vocab_size: int = 32128, seed: int = 0,
+                 prompt_buckets: tuple = (32, 64, 128, 256),
+                 min_replicas: int = 1, max_replicas: int = 4,
+                 target_ttft_s: float | None = None,
+                 target_queue_per_replica: float = 4.0,
+                 prefill_workers: int = 0,
+                 prefill_threshold: int | None = None,
+                 prefix_cache_block: int = 0,
+                 prefix_cache_mb: int = 256,
+                 max_inflight_per_replica: int | None = None,
+                 autoscale: bool = True, chunk_delay_s: float = 0.0):
+        import jax
+        import numpy as np
+
+        self._model_kwargs = dict(
+            model_size=model_size, max_len=max_len,
+            vocab_size=vocab_size, seed=seed)
+        self._replica_kwargs = dict(
+            model_size=model_size, slots=slots, max_len=max_len,
+            chunk_tokens=chunk_tokens, vocab_size=vocab_size, seed=seed,
+            prompt_buckets=tuple(prompt_buckets),
+            prefix_cache_block=prefix_cache_block,
+            prefix_cache_mb=prefix_cache_mb, chunk_delay_s=chunk_delay_s)
+        self.slots = slots
+        self.min_replicas = max(1, min_replicas)
+        self.max_replicas = max(self.min_replicas, max_replicas)
+        self.target_ttft_s = target_ttft_s
+        self.target_queue_per_replica = target_queue_per_replica
+        self.prefill_threshold = prefill_threshold
+        self._max_inflight = (max_inflight_per_replica
+                              or max(slots * 2, slots + 4))
+
+        # ONE weight build + ONE object-store put; every pool member
+        # adopts the ref (multi-source pull on later replicas)
+        params, _cfg = build_model(model_size, max_len=max_len,
+                                   vocab_size=vocab_size, seed=seed)
+        host_tree = jax.tree_util.tree_map(
+            lambda a: np.asarray(jax.device_get(a)), params)
+        self._params_ref = ray_tpu.put(host_tree)
+        del params, host_tree
+
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._replicas: list[_Replica] = []
+        self._waiting = 0
+        self._n_spawned = 0
+        self._ttfts: list = []  # (wall stamp, ttft_s)
+        self._streams: dict[str, dict] = {}
+        self._next_rid = 0
+        self._last_scale_up = 0.0
+        self._stop = False
+
+        for _ in range(self.min_replicas):
+            self._replicas.append(self._spawn_replica())
+        ray_tpu.get([r.handle.health.remote() for r in self._replicas],
+                    timeout=600)
+
+        self._prefill: list = []
+        if prefill_workers > 0:
+            self._prefill = [
+                _PrefillActor.remote(
+                    **self._model_kwargs,
+                    prompt_buckets=tuple(prompt_buckets),
+                    params_blob=self._params_ref)
+                for _ in range(prefill_workers)
+            ]
+            ray_tpu.get([p.health.remote() for p in self._prefill],
+                        timeout=600)
+            if self.prefill_threshold is None:
+                # default: disaggregate the top prompt bucket
+                self.prefill_threshold = max(prompt_buckets)
+        self._prefill_rr = 0
+
+        if autoscale:
+            threading.Thread(target=self._autoscale_loop, daemon=True,
+                             name="llm-pool-autoscale").start()
+
+    # ---------- replica lifecycle ----------
+
+    def _spawn_replica(self) -> _Replica:
+        self._n_spawned += 1
+        name = f"decode-{self._n_spawned}"
+        h = _DecodeReplica.options(
+            max_concurrency=self._max_inflight + 8,
+        ).remote(**self._replica_kwargs, params_blob=self._params_ref,
+                 engine_name=name)
+        return _Replica(h, name)
+
+    def _mark_dead(self, rep: _Replica):
+        with self._lock:
+            if rep.dead:
+                return
+            rep.dead = True
+            if rep in self._replicas:
+                self._replicas.remove(rep)
+            self._cond.notify_all()
+        logger.warning("llm_pool: replica %s died; %d remain",
+                       rep.name, len(self._replicas))
+
+    def _alive(self) -> list[_Replica]:
+        return [r for r in self._replicas if not r.dead]
+
+    # ---------- admission ----------
+
+    def _acquire(self) -> _Replica:
+        """Block until some live, non-draining replica has an in-flight
+        slot. The count of blocked handler threads IS the shared
+        admission queue — its depth feeds the autoscaler."""
+        deadline = time.monotonic() + self.ACQUIRE_TIMEOUT_S
+        with self._cond:
+            self._waiting += 1
+            try:
+                while True:
+                    cands = [r for r in self._replicas
+                             if not r.draining and not r.dead
+                             and r.inflight < self._max_inflight]
+                    if cands:
+                        rep = min(cands, key=lambda r: r.inflight)
+                        rep.inflight += 1
+                        return rep
+                    if not self._cond.wait(
+                            timeout=max(0.0,
+                                        deadline - time.monotonic())):
+                        raise TimeoutError(
+                            f"no decode replica admitted the request "
+                            f"within {self.ACQUIRE_TIMEOUT_S}s "
+                            f"({len(self._replicas)} replicas)")
+            finally:
+                self._waiting -= 1
+
+    def _release(self, rep: _Replica):
+        with self._cond:
+            rep.inflight = max(0, rep.inflight - 1)
+            self._cond.notify_all()
+
+    def _record_ttft(self, out: dict, queue_wait_s: float = 0.0):
+        """TTFT as the CLIENT experiences it: pool admission-queue wait
+        PLUS the replica-side submit->first-token gap (replica stamps
+        alone are blind to admission collapse — the very signal the
+        SLO scaler exists to catch)."""
+        stamps = out.get("token_times_s") or []
+        if stamps and out.get("submitted_s") is not None:
+            with self._lock:
+                now = time.monotonic()
+                self._ttfts.append(
+                    (now,
+                     queue_wait_s + stamps[0] - out["submitted_s"]))
+                cut = now - self.TTFT_WINDOW_S
+                while self._ttfts and self._ttfts[0][0] < cut:
+                    self._ttfts.pop(0)
+
+    def ttft_p99(self) -> float | None:
+        with self._lock:
+            vals = sorted(t for _, t in self._ttfts)
+        if not vals:
+            return None
+        return vals[min(len(vals) - 1, int(0.99 * len(vals)))]
+
+    # ---------- request paths ----------
+
+    def _maybe_prefill(self, prompt_ids: list):
+        """Route long prompts to the prefill pool; returns an
+        ObjectRef of the KV payload, or None for inline prefill."""
+        if (not self._prefill or self.prefill_threshold is None
+                or len(prompt_ids) < self.prefill_threshold):
+            return None
+        with self._lock:
+            self._prefill_rr += 1
+            pw = self._prefill[self._prefill_rr % len(self._prefill)]
+        try:
+            # NOT resolved here: the ref flows straight into the decode
+            # replica's adopt call, so the KV rows move prefill-node ->
+            # decode-node through the object store, never via the pool
+            return pw.prefill.remote(list(prompt_ids))
+        except Exception:  # noqa: BLE001 — prefill pool degraded:
+            return None  # decode replicas prefill inline instead
+
+    def generate(self, prompt_ids: list, max_tokens: int = 64) -> dict:
+        """Blocking generate with transparent replica failover."""
+        prompt_ids = list(prompt_ids)
+        max_tokens = int(max_tokens)
+        kv_ref = self._maybe_prefill(prompt_ids)
+        last_err: Exception | None = None
+        t_enqueue = time.monotonic()
+        for _ in range(self.max_replicas + 2):
+            rep = self._acquire()
+            queue_wait = time.monotonic() - t_enqueue
+            try:
+                if kv_ref is not None:
+                    ref = rep.handle.adopt_prefilled.remote(
+                        kv_ref, prompt_ids, max_tokens)
+                else:
+                    ref = rep.handle.generate.remote(
+                        prompt_ids, max_tokens)
+                out = ray_tpu.get(ref, timeout=600)
+                self._record_ttft(out, queue_wait)
+                return out
+            except ray_tpu.RayActorError as e:
+                # replica died mid-request: re-queue to a survivor —
+                # the client never sees this (chaos-test contract)
+                last_err = e
+                self._mark_dead(rep)
+                if kv_ref is not None:
+                    # the KV payload may have died with the replica's
+                    # node — recompute rather than depend on lineage
+                    kv_ref = self._maybe_prefill(prompt_ids)
+                continue
+            finally:
+                self._release(rep)
+        raise RuntimeError(
+            f"request failed over too many dead replicas: {last_err}")
+
+    def __call__(self, req: dict) -> dict:
+        return self.generate(list(req["prompt_ids"]),
+                             int(req.get("max_tokens", 64)))
+
+    # ---------- streaming ----------
+
+    STREAM_TTL_S = 120.0  # abandoned-client purge (frees the replica
+    # in-flight slot the stream holds; mirrors LLMServer's sid purge)
+
+    def _sweep_streams(self):
+        now = time.monotonic()
+        for rid, rec in list(self._streams.items()):
+            if now - rec.get("last_poll", now) <= self.STREAM_TTL_S:
+                continue
+            self._streams.pop(rid, None)
+            rep = rec.get("rep")
+            if rep is not None:
+                self._release(rep)
+
+    def submit_stream(self, req: dict) -> dict:
+        self._sweep_streams()
+        prompt_ids = list(req["prompt_ids"])
+        max_tokens = int(req.get("max_tokens", 64))
+        with self._lock:
+            self._next_rid += 1
+            rid = f"s{self._next_rid}"
+        rec = {"prompt_ids": prompt_ids, "max_tokens": max_tokens,
+               "emitted": 0, "rep": None, "sid": None, "done": False,
+               "last_poll": time.monotonic(),
+               "kv_ref": self._maybe_prefill(prompt_ids)}
+        self._streams[rid] = rec
+        try:
+            self._assign_stream(rec)
+        except BaseException:
+            self._streams.pop(rid, None)
+            raise
+        return {"rid": rid}
+
+    def _assign_stream(self, rec: dict):
+        rep = self._acquire()
+        try:
+            body = {"prompt_ids": rec["prompt_ids"],
+                    "max_tokens": rec["max_tokens"]}
+            sid = None
+            if rec["kv_ref"] is not None and rec["emitted"] == 0:
+                # adopt path only for a fresh stream (KV as a TOP-LEVEL
+                # arg so the ref resolves executor-side); failover
+                # restarts re-decode from the prompt (offset dedup)
+                try:
+                    sid = ray_tpu.get(
+                        rep.handle.submit_stream_prefilled.remote(
+                            rec["kv_ref"], rec["prompt_ids"],
+                            rec["max_tokens"]),
+                        timeout=600)["sid"]
+                except ray_tpu.RayActorError:
+                    raise
+                except Exception:  # noqa: BLE001 — KV ref unusable:
+                    sid = None  # fall through to inline prefill
+            if sid is None:
+                sid = ray_tpu.get(rep.handle.submit_stream.remote(body),
+                                  timeout=600)["sid"]
+            rec["rep"], rec["sid"] = rep, sid
+        except BaseException:
+            self._release(rep)
+            raise
+
+    def poll_stream(self, rid: str) -> dict:
+        rec = self._streams.get(rid)
+        if rec is None or rec["done"]:
+            self._streams.pop(rid, None)
+            return {"tokens": [], "done": True}
+        rec["last_poll"] = time.monotonic()
+        if rec["rep"] is None:
+            # an earlier failover found no survivor yet: keep retrying
+            # on every poll instead of surfacing an error (the TTL
+            # sweep bounds how long an unassignable stream lingers)
+            try:
+                self._assign_stream(rec)
+            except Exception:  # noqa: BLE001
+                return {"tokens": [], "done": False}
+        rep = rec["rep"]
+        try:
+            out = ray_tpu.get(rep.handle.poll_stream.remote(rec["sid"]),
+                              timeout=120)
+        except ray_tpu.RayActorError:
+            # mid-stream death: re-queue onto a survivor and skip the
+            # tokens the client already has (greedy == deterministic)
+            self._mark_dead(rep)
+            self._release(rep)
+            rec["rep"] = rec["sid"] = None
+            rec["replayed"] = 0  # replacement stream replays from 0
+            try:
+                self._assign_stream(rec)
+            except Exception:  # noqa: BLE001 — retried next poll
+                pass
+            return {"tokens": [], "done": False}
+        new = out["tokens"]
+        skip = 0
+        # after failover the replacement stream replays from token 0
+        if rec.get("replayed", 0) < rec["emitted"]:
+            skip = min(len(new), rec["emitted"] - rec.get("replayed", 0))
+            rec["replayed"] = rec.get("replayed", 0) + skip
+        fresh = new[skip:]
+        rec["emitted"] += len(fresh)
+        rec["replayed"] = rec.get("replayed", 0) + len(fresh)
+        if out["done"]:
+            rec["done"] = True
+            self._release(rep)
+            self._streams.pop(rid, None)
+        return {"tokens": fresh, "done": out["done"]}
+
+    # ---------- autoscaling ----------
+
+    def _autoscale_loop(self):
+        while not self._stop:
+            time.sleep(self.AUTOSCALE_PERIOD_S)
+            try:
+                self._autoscale_once()
+            except Exception:  # noqa: BLE001
+                logger.exception("llm_pool autoscale tick failed")
+
+    def _autoscale_once(self):
+        from ray_tpu.autoscaler.demand_scheduler import (
+            serve_replica_demand,
+        )
+
+        self._sweep_streams()
+        with self._lock:
+            n = len([r for r in self._replicas if not r.draining])
+            waiting = self._waiting
+            inflight = sum(r.inflight for r in self._replicas)
+        ttft = self.ttft_p99()
+        desired = serve_replica_demand(
+            queue_depth=waiting, inflight=inflight, n_replicas=n,
+            min_replicas=self.min_replicas,
+            max_replicas=self.max_replicas,
+            target_queue_per_replica=self.target_queue_per_replica,
+            ttft_p99_s=ttft, target_ttft_s=self.target_ttft_s)
+        try:
+            m = _get_pool_metrics()
+            m["replicas"].set(n)
+            m["queue"].set(waiting)
+            if ttft is not None:
+                m["ttft_p99"].set(ttft)
+        except Exception:  # noqa: BLE001
+            pass
+        if desired > n:
+            if (time.monotonic() - self._last_scale_up
+                    < self.SCALE_UP_COOLDOWN_S):
+                return
+            fresh = [self._spawn_replica() for _ in range(desired - n)]
+            try:
+                ray_tpu.get([r.handle.health.remote() for r in fresh],
+                            timeout=600)
+            except Exception:  # noqa: BLE001 — reap, retry next tick
+                for r in fresh:
+                    try:
+                        ray_tpu.kill(r.handle)
+                    except Exception:  # noqa: BLE001
+                        pass
+                raise
+            with self._cond:
+                self._replicas.extend(fresh)
+                self._cond.notify_all()
+            self._last_scale_up = time.monotonic()
+            logger.info("llm_pool: scaled up to %d replicas",
+                        len(self._replicas))
+        elif desired < n:
+            self._drain_one()
+
+    def _drain_one(self):
+        with self._lock:
+            cands = [r for r in self._replicas
+                     if not r.draining and not r.dead]
+            if len(cands) <= self.min_replicas:
+                return
+            victim = min(cands, key=lambda r: r.inflight)
+            victim.draining = True  # no new admissions
+
+        def _drain():
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline and victim.inflight > 0:
+                time.sleep(self.DRAIN_POLL_S)
+            try:
+                # explicit deterministic teardown (LLMServer.shutdown):
+                # finish in-flight decode, stop the pump thread
+                ray_tpu.get(victim.handle.shutdown.remote(30.0),
+                            timeout=60)
+            except Exception:  # noqa: BLE001 — dead already
+                pass
+            with self._lock:
+                if victim in self._replicas:
+                    self._replicas.remove(victim)
+            try:
+                ray_tpu.kill(victim.handle)
+            except Exception:  # noqa: BLE001
+                pass
+            logger.info("llm_pool: drained + retired %s (now %d)",
+                        victim.name, len(self._replicas))
+
+        threading.Thread(target=_drain, daemon=True).start()
+
+    # ---------- introspection / lifecycle ----------
+
+    def stats(self) -> dict:
+        with self._lock:
+            reps = list(self._replicas)
+            waiting = self._waiting
+        per_replica = {}
+        for r in reps:
+            try:
+                per_replica[r.name] = ray_tpu.get(
+                    r.handle.stats.remote(), timeout=30)
+            except Exception as e:  # noqa: BLE001
+                per_replica[r.name] = {"error": str(e)[:100]}
+        agg_tps = sum(s.get("tokens_per_sec", 0.0)
+                      for s in per_replica.values()
+                      if isinstance(s, dict))
+        pc = [s["prefix_cache"] for s in per_replica.values()
+              if isinstance(s, dict) and s.get("prefix_cache")]
+        hits = sum(p["hits"] for p in pc)
+        total = hits + sum(p["misses"] for p in pc)
+        return {
+            "replicas": len(reps),
+            "queue_depth": waiting,
+            "inflight": sum(r.inflight for r in reps),
+            "tokens_per_sec": round(agg_tps, 1),
+            "ttft_p99_s": self.ttft_p99(),
+            "prefill_workers": len(self._prefill),
+            "prefix_cache_hit_rate": (hits / total) if total else None,
+            "per_replica": per_replica,
+        }
+
+    def health(self) -> bool:
+        return not self._stop
+
+    def shutdown(self) -> bool:
+        self._stop = True
+        with self._lock:
+            reps = list(self._replicas)
+            self._replicas = []
+        for r in reps:
+            try:
+                ray_tpu.get(r.handle.shutdown.remote(5.0), timeout=30)
+            except Exception:  # noqa: BLE001
+                pass
+            try:
+                ray_tpu.kill(r.handle)
+            except Exception:  # noqa: BLE001
+                pass
+        for p in self._prefill:
+            try:
+                ray_tpu.kill(p)
+            except Exception:  # noqa: BLE001
+                pass
+        self._prefill = []
+        return True
+
+
+def run_llm_pool(name: str = "llm", *, route_prefix: str | None = None,
+                 max_concurrent_queries: int = 128, **pool_kwargs):
+    """Deploy an LLMPool behind serve (controller-managed, HTTP-routable)
+    and return its handle. min_replicas/max_replicas/target_ttft_s go
+    to the POOL (init kwargs): the pool scales its own decode replicas.
+    The pool deployment itself stays at ONE serve replica — NEVER give
+    it deployment-level autoscaling (a second pool replica would split
+    the admission queue, duplicate the decode fleet, and break
+    submit_stream/poll_stream affinity across pool instances)."""
+    from ray_tpu import serve
+    from ray_tpu.serve.api import Deployment
+
+    dep = Deployment(
+        LLMPool, num_replicas=1,
+        max_concurrent_queries=max_concurrent_queries,
+        resources={"CPU": 0}, route_prefix=route_prefix or f"/{name}")
+    return serve.run(dep, name=name, init_kwargs=pool_kwargs)
