@@ -1,0 +1,130 @@
+"""Randomized-seed chaos plans over the deterministic fault-injection
+harness.
+
+`fault_injection.py` makes one fault reproducible; this module makes the
+fault SPACE sweepable: :func:`gen_fault_plan` expands a seed into a
+site-weighted, fully deterministic set of injection specs across every
+instrumented site a long-running training/serving stack actually
+exercises — ring chunk sends/recvs, collective frames, checkpoint
+save/restore, agent heartbeats, object-chunk serving, lease pushes. The
+same seed ALWAYS yields the same plan (plain `random.Random(seed)`, no
+ambient entropy), so a failing soak seed replays exactly from its logged
+spec: `RAY_TPU_FAULT_SPEC='<json>'` (or re-running the seed).
+
+Plans are split by fault locality:
+
+- ``worker_specs`` trip inside training worker processes (ring/
+  collective/checkpoint sites). The soak's train loop arms them via
+  `fault_injection.configure` on its FIRST incarnation only
+  (`session.get_resume_seq() == 0`), so respawned processes do not
+  re-arm exhausted kills and every plan is finite → every seed must
+  converge.
+- ``driver_specs`` trip in the driver/agent process (heartbeat, object
+  chunk, lease push — in-process node agents in the test cluster), where
+  one `configure` covers the whole run.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from dataclasses import dataclass, field
+
+# sites weighted by how often production failures land there: the hot
+# per-chunk collective path dominates; control-plane/data-plane noise and
+# checkpoint I/O are rarer but must stay covered.
+SITE_WEIGHTS: dict[str, float] = {
+    "ring.send": 3.0,
+    "ring.recv": 1.5,
+    "collective.send": 1.5,
+    "checkpoint.save": 1.0,
+    "checkpoint.restore": 0.75,
+    "agent.heartbeat": 0.5,
+    "object.read_chunk": 0.75,
+    "worker.lease_push": 0.5,
+}
+
+# per-site action palette (weighted): hard process death and in-process
+# crashes concentrate on the ring path; checkpoint sites exercise torn
+# writes / detected bitrot; the driver-side sites inject recoverable
+# noise (their recovery machinery is exercised, not the train loop's).
+SITE_ACTIONS: dict[str, list[tuple[str, float]]] = {
+    "ring.send": [("exit", 3.0), ("die", 2.0), ("drop", 1.0),
+                  ("delay", 1.0)],
+    "ring.recv": [("die", 2.0), ("exit", 1.0), ("delay", 1.0)],
+    "collective.send": [("die", 2.0), ("drop", 1.0), ("delay", 1.0)],
+    "checkpoint.save": [("drop", 2.0), ("die", 1.0), ("delay", 1.0)],
+    "checkpoint.restore": [("drop", 2.0), ("delay", 1.0)],
+    "agent.heartbeat": [("drop", 1.0), ("delay", 1.0)],
+    "object.read_chunk": [("drop", 2.0), ("delay", 1.0)],
+    "worker.lease_push": [("drop", 1.0)],
+}
+
+# sites that fire in the driver/agent process rather than a train worker
+DRIVER_SITES = frozenset(
+    {"agent.heartbeat", "object.read_chunk", "worker.lease_push"})
+
+
+@dataclass
+class FaultPlan:
+    """One seed's expansion: everything needed to run — and replay — a
+    chaos episode."""
+
+    seed: int
+    worker_specs: list[dict] = field(default_factory=list)
+    driver_specs: list[dict] = field(default_factory=list)
+
+    @property
+    def specs(self) -> list[dict]:
+        return self.worker_specs + self.driver_specs
+
+    def env_value(self) -> str:
+        """The exact `RAY_TPU_FAULT_SPEC` value that replays this plan
+        (log this for any failing seed)."""
+        return json.dumps(self.specs, sort_keys=True)
+
+    def describe(self) -> str:
+        parts = [f"{s['site']}:{s['action']}"
+                 f"@{s.get('match', {})}+{s.get('after', 0)}"
+                 for s in self.specs]
+        return f"seed={self.seed} [{'; '.join(parts)}]"
+
+
+def _weighted(rng: random.Random, pairs) -> str:
+    return rng.choices([v for v, _ in pairs],
+                       weights=[w for _, w in pairs])[0]
+
+
+def gen_fault_plan(seed: int, *, world_size: int = 2,
+                   max_faults: int = 2,
+                   sites: dict[str, float] | None = None) -> FaultPlan:
+    """Deterministically expand ``seed`` into 1..max_faults specs.
+
+    ``match`` pins rank-scoped sites to a specific rank (so a kill hits
+    one member, not whichever rank reaches the site first on a loaded
+    box), ``after`` spreads trips across the run's occurrence timeline,
+    and ``count=1`` keeps every plan finite. ``sites`` overrides the
+    default site weighting (e.g. to soak only the checkpoint path).
+    """
+    rng = random.Random(seed)
+    weights = list((sites or SITE_WEIGHTS).items())
+    plan = FaultPlan(seed=seed)
+    for _ in range(rng.randint(1, max_faults)):
+        site = _weighted(rng, weights)
+        action = _weighted(rng, SITE_ACTIONS[site])
+        spec: dict = {"site": site, "action": action, "count": 1}
+        if site.startswith("ring.") or site == "collective.send":
+            spec["match"] = {"rank": rng.randrange(world_size)}
+            # ring sites fire per chunk: spread trips over the first
+            # steps' worth of occurrences so kills land mid-step at
+            # different points of the schedule per seed
+            spec["after"] = rng.randrange(0, 10)
+        elif site.startswith("checkpoint."):
+            spec["after"] = rng.randrange(0, 4)
+        else:
+            spec["after"] = rng.randrange(0, 6)
+        if action == "delay":
+            spec["delay_s"] = round(rng.uniform(0.05, 0.3), 3)
+        (plan.driver_specs if site in DRIVER_SITES
+         else plan.worker_specs).append(spec)
+    return plan

@@ -140,6 +140,16 @@ _DEFS: dict[str, Any] = {
     "collective_abort_poll_s": 0.5,
     # rendezvous deadline for reform_group after a membership change
     "collective_reform_timeout_s": 120.0,
+    # -- elastic training (JaxTrainer + BackendExecutor) --
+    # resume a collective-abort failure IN-PLACE when the backend
+    # supports it (backend="dcn"): survivors keep their processes, JIT
+    # caches, and device state; heal/reform/rebalance instead of a full
+    # gang restart. False forces the legacy gang-restart path.
+    "train_inplace_resume": True,
+    # how long the in-place path waits for each survivor's old train
+    # thread to unwind (after abort_all_local wakes it) before declaring
+    # the survivor wedged and falling back to a gang restart
+    "train_quiesce_timeout_s": 30.0,
     # -- fault injection (chaos tests) --
     # JSON list of injection specs (see _private/fault_injection.py);
     # declared here so set_system_config propagates it to spawned

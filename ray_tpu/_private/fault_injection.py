@@ -39,8 +39,14 @@ event loop via :func:`fire_async` so one slow chunk does not freeze
 every other transfer on the node), ``worker.lease_push`` (per
 direct-pushed lease task, ctx: task; ``drop`` skips the execute_task
 fire while keeping owner bookkeeping — the exact "lost fire" wedge the
-lease liveness probe exists to recover). Sites are zero-overhead when
-no spec is configured (one module-flag check, no lock).
+lease liveness probe exists to recover), ``checkpoint.save`` (per
+written checkpoint member, ctx: path/file; ``drop`` is a torn write —
+half the bytes land while the recorded crc32 names the full payload)
+and ``checkpoint.restore`` (per restore, ctx: path; ``drop`` surfaces
+as a typed ``CheckpointCorruptError``, i.e. detected bitrot). Sites are
+zero-overhead when no spec is configured (one module-flag check, no
+lock). :mod:`ray_tpu._private.chaos` sweeps the whole site space from
+randomized seeds.
 
 Every tripped spec is appended to an in-process hit log queryable via
 :func:`hits` — chaos tests assert determinism by comparing logs across

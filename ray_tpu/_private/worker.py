@@ -23,6 +23,7 @@ known are lineage-reconstructed by resubmitting the task
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import os
 import threading
@@ -133,7 +134,13 @@ class CoreWorker:
         self.store = (ObjectStoreClient.attach(store_name)
                       if store_name is not None else None)
         self.memory: dict[bytes, _ResultEntry] = {}
-        self._mem_lock = threading.Lock()
+        # RLock, not Lock: ObjectRef.__del__ (→ remove_local_ref) can run
+        # REENTRANTLY on whatever thread triggers GC — including the io
+        # thread while it already holds this lock inside _entry(). With a
+        # plain Lock that is a single-thread self-deadlock that freezes
+        # the whole io loop (observed: actor-death storms in the elastic
+        # chaos tests wedged every sync RPC forever).
+        self._mem_lock = threading.RLock()
         self.task_counter = _Counter()
         self.put_counter = _Counter()
         self._func_cache: dict[bytes, Any] = {}
@@ -218,7 +225,16 @@ class CoreWorker:
         # per-oid local count; 0<->1 transitions reported to the directory,
         # which frees cluster copies when no process holds a reference.
         self._local_refs: dict[bytes, int] = {}
-        self._refs_lock = threading.Lock()
+        # RLock for the same GC-reentrancy reason as _mem_lock: __del__
+        # may fire mid-critical-section on the owning thread
+        self._refs_lock = threading.RLock()
+        # decrefs that arrived (via GC) while this thread held a ref/mem
+        # lock: applied on the next clean remove_local_ref call (deque:
+        # append/popleft are thread-safe without a lock)
+        import collections as _collections
+
+        self._deferred_decrefs: "_collections.deque[bytes]" = \
+            _collections.deque()
         # task_id -> dep oids pinned for the task's lifetime (submitted-task
         # references, reference_count.h:115)
         self._task_pins: dict[bytes, list[bytes]] = {}
@@ -592,6 +608,39 @@ class CoreWorker:
                 pass
 
     def remove_local_ref(self, oid: bytes):
+        # GC can run ObjectRef.__del__ → here while THIS thread already
+        # holds one of these (reentrant) locks mid-critical-section; a
+        # reentrant pop could then corrupt an in-flight iteration
+        # ("dict changed size during iteration"). Defer the decref to
+        # the next clean call instead of mutating under the caller.
+        self._deferred_decrefs.append(oid)
+        if self._refs_lock._is_owned() or self._mem_lock._is_owned():
+            # can't apply under the caller's critical section — and the
+            # process may never drop another ref, so don't wait for a
+            # future call here: the io loop drains once the owner
+            # unwinds (lock sections are tiny dict ops, never RPCs, so
+            # the loop blocks at most momentarily)
+            try:
+                self.io.loop.call_soon_threadsafe(self._drain_decrefs)
+            except RuntimeError:
+                pass  # loop closed at shutdown: nothing left to pin
+            return
+        self._drain_decrefs()
+
+    def _drain_decrefs(self):
+        if self._refs_lock._is_owned() or self._mem_lock._is_owned():
+            return  # re-entered under a lock; a scheduled drain retries
+        # drain until empty AFTER the last application: an application
+        # can itself trigger GC and defer more decrefs — exiting before
+        # re-checking would strand them (pinning cluster copies)
+        while True:
+            try:
+                deferred = self._deferred_decrefs.popleft()
+            except IndexError:
+                return
+            self._remove_local_ref_now(deferred)
+
+    def _remove_local_ref_now(self, oid: bytes):
         with self._refs_lock:
             n = self._local_refs.get(oid, 0) - 1
             if n <= 0:
@@ -2022,10 +2071,19 @@ class CoreWorker:
         self._actor_pending.get(actor_id, set()).discard(task_id)
 
     def kill_actor(self, actor_id: bytes, no_restart: bool = True,
-                   blocking: bool = True):
+                   blocking: bool = True, timeout: float = 60.0):
         msg = {"actor_id": actor_id, "no_restart": no_restart}
         if blocking and threading.current_thread() is not self.io.thread:
-            self.head.call("kill_actor", msg)
+            try:
+                self.head.call("kill_actor", msg, timeout=timeout)
+            except (TimeoutError, asyncio.TimeoutError):
+                # a wedged kill path must not hang teardown forever:
+                # downgrade to fire-and-forget (the head applies it when
+                # it can; reap/escalation owns the process itself)
+                logger.warning("kill_actor %s timed out after %.0fs; "
+                               "downgrading to fire-and-forget",
+                               actor_id.hex()[:12], timeout)
+                self.head.fire("kill_actor", msg)
         else:
             self.head.fire("kill_actor", msg)
 

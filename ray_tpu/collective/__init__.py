@@ -17,11 +17,13 @@ with error feedback) or ``star`` (the legacy rank-0 tree fallback).
 """
 
 from ray_tpu.collective.collective import (  # noqa: F401
+    abort_all_local,
     allgather,
     allreduce,
     barrier,
     broadcast,
     CollectiveAbortError,
+    CollectiveTimeoutError,
     CollectiveActorMixin,
     create_collective_group,
     destroy_collective_group,

@@ -62,6 +62,15 @@ class CollectiveAbortError(RuntimeError):
         )
 
 
+class CollectiveTimeoutError(TimeoutError):
+    """A collective op rode out its deadline waiting for a peer frame.
+
+    A TimeoutError subclass (existing handlers keep working), but TYPED:
+    the trainer classifies it as a retriable infra failure — a stranded
+    ring after lost frames — without also swallowing unrelated
+    TimeoutErrors raised by user training code."""
+
+
 class _Aborted(Exception):
     """Internal mailbox-wakeup signal; surfaces as CollectiveAbortError."""
 
@@ -231,7 +240,7 @@ class Group:
                 a.info["reason"], origin_rank=a.info.get("origin")
             ) from None
         except TimeoutError:
-            raise TimeoutError(
+            raise CollectiveTimeoutError(
                 f"collective group '{self.name}' rank {self.rank}: "
                 f"op '{op or tag}' timed out after {timeout}s waiting for "
                 f"rank {src_rank} (seq {seq}, tag {tag!r})"
@@ -492,7 +501,7 @@ def _poll_peers(w, group: Group, key_prefix: str, incs: dict,
             if moved is not None:
                 raise _EpochMoved(moved)
         if time.monotonic() > deadline:
-            raise TimeoutError(
+            raise CollectiveTimeoutError(
                 f"collective rendezvous '{key_prefix}': "
                 f"{len(group.peers)}/{group.world_size} ranks adopted "
                 f"before the deadline"
@@ -777,6 +786,21 @@ def destroy_collective_group(group_name: str = "default"):
                 })
             except Exception:  # noqa: BLE001 — teardown is best-effort
                 pass
+
+
+def abort_all_local(reason: str) -> int:
+    """Abort every live group incarnation in THIS process — no frames to
+    peers. The in-place-resume quiesce hook: before warm-restarting a
+    survivor, the driver fires this so any thread still blocked in a
+    doomed incarnation's recv wakes with CollectiveAbortError immediately
+    instead of riding out the op timeout. Reform builds fresh incarnations
+    afterwards, so the sticky abort never outlives the quiesce. Returns
+    how many groups were newly aborted."""
+    n = 0
+    for g in list(_groups.values()):
+        if g.local_abort(reason):
+            n += 1
+    return n
 
 
 def get_rank(group_name: str = "default") -> int:

@@ -19,9 +19,11 @@ from ray_tpu.train.step import (  # noqa: F401
 )
 from ray_tpu.train.checkpoint import (  # noqa: F401
     Checkpoint,
+    CheckpointCorruptError,
     CheckpointManager,
     save_state,
     restore_state,
+    verify_checkpoint,
 )
 from ray_tpu.train.trainer import (  # noqa: F401
     JaxTrainer,

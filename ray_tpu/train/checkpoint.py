@@ -11,17 +11,191 @@ Format (one directory per checkpoint):
     meta.msgpack             tree structure, leaf shapes/dtypes/sharding
     shards_p{k}.npz          process k's addressable shards
     user.pkl                 non-array user payload (cloudpickle)
+    checksums_*.json         per-writer crc32 of every file it wrote
+
+Integrity: every writer records a crc32 per file it writes
+(`checksums_p{k}.json` for process k's collective save,
+`checksums_d.json` for dict-style checkpoints); restores verify before
+deserializing, so a torn or bit-rotted checkpoint surfaces as a typed
+:class:`CheckpointCorruptError` (the trainer falls back to the previous
+checkpoint) instead of a pickle/zip traceback. The fault-injection sites
+``checkpoint.save`` (``drop`` = torn write: half the bytes hit disk, the
+checksum records the intended ones) and ``checkpoint.restore`` (``drop``
+= detected bitrot) make both paths deterministically testable.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import pickle
 import shutil
 import tempfile
+import zlib
 from typing import Any
 
 import numpy as np
+
+from ray_tpu._private import fault_injection
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint failed integrity verification (missing file, crc32
+    mismatch, or an injected bitrot): callers fall back to the previous
+    checkpoint instead of crashing on a deserialization traceback."""
+
+    def __init__(self, path: str, detail: str):
+        self.path = path
+        self.detail = detail
+        super().__init__(f"corrupt checkpoint at {path}: {detail}")
+
+
+def _crc32_file(path: str, chunk: int = 4 * 1024 * 1024) -> int:
+    """Incremental crc32 — never buffers a multi-GB member in memory."""
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                return crc
+            crc = zlib.crc32(block, crc)
+
+
+def _write_with_checksum(path: str, fname: str, data: bytes,
+                         sums: dict) -> None:
+    """Write one checkpoint member, recording its intended crc32.
+
+    The ``checkpoint.save`` site's ``drop`` action simulates a torn
+    write: only half the bytes land while the checksum still records the
+    full payload — exactly the partial-flush crash a restore must catch.
+    """
+    act = None
+    if fault_injection.enabled():
+        act = fault_injection.fire("checkpoint.save", path=path, file=fname)
+    sums[fname] = zlib.crc32(data)
+    with open(os.path.join(path, fname), "wb") as f:
+        f.write(data[: len(data) // 2] if act == "drop" else data)
+
+
+def _checksum_saved_file(path: str, fname: str, sums: dict) -> None:
+    """Checksum a member already STREAMED to disk (the shards npz — too
+    big to buffer in memory just for a crc). Same site semantics as
+    :func:`_write_with_checksum`: ``drop`` tears the file after the
+    checksum recorded the full content."""
+    act = None
+    if fault_injection.enabled():
+        act = fault_injection.fire("checkpoint.save", path=path, file=fname)
+    full = os.path.join(path, fname)
+    sums[fname] = _crc32_file(full)
+    if act == "drop":
+        with open(full, "r+b") as f:
+            f.truncate(os.path.getsize(full) // 2)
+
+
+def _flush_checksums(path: str, suffix: str, sums: dict) -> None:
+    with open(os.path.join(path, f"checksums_{suffix}.json"), "w") as f:
+        json.dump(sums, f)
+
+
+def _read_checksums(path: str) -> dict[str, int]:
+    """All recorded member crcs, merged across writers' records."""
+    if not os.path.isdir(path):
+        raise CheckpointCorruptError(path, "checkpoint directory missing")
+    merged: dict[str, int] = {}
+    for fn in sorted(os.listdir(path)):
+        if not (fn.startswith("checksums_") and fn.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(path, fn)) as f:
+                merged.update(json.load(f))
+        except (ValueError, OSError) as e:
+            # a torn checksum record is itself checkpoint corruption —
+            # it must trigger the typed fallback, not a JSON traceback
+            raise CheckpointCorruptError(
+                path, f"{fn} unreadable: {e}") from None
+    # writer-manifest check: records merge from whatever files EXIST, so
+    # without this a checkpoint that lost an entire writer's pair
+    # (shards_p{k}.npz + checksums_p{k}.json) would verify vacuously and
+    # then restore silently wrong — _load_device_shard zero-fills
+    # uncovered regions. meta.msgpack records how many writers saved.
+    meta_fn = os.path.join(path, "meta.msgpack")
+    if os.path.exists(meta_fn):
+        import msgpack
+
+        try:
+            with open(meta_fn, "rb") as f:
+                n_writers = int(msgpack.unpackb(f.read())
+                                .get("n_writers", 0))
+        except Exception as e:  # noqa: BLE001 — typed, not a traceback
+            raise CheckpointCorruptError(
+                path, f"meta.msgpack unreadable: {e}") from None
+        lost = [k for k in range(n_writers)
+                if not os.path.exists(
+                    os.path.join(path, f"checksums_p{k}.json"))]
+        if lost:
+            raise CheckpointCorruptError(
+                path, f"writer record(s) {lost} missing "
+                      f"({n_writers} writers saved)")
+    return merged
+
+
+def _verify_member(path: str, member: str, crc: int) -> None:
+    member_path = os.path.join(path, member)
+    if not os.path.exists(member_path):
+        raise CheckpointCorruptError(path, f"{member} missing")
+    got = _crc32_file(member_path)
+    if got != crc:
+        raise CheckpointCorruptError(
+            path, f"{member} crc32 {got:#x} != recorded {crc:#x}")
+
+
+def verify_checkpoint(path: str, members=None) -> None:
+    """Check recorded members against their crc32s.
+
+    ``members`` restricts verification to the files the caller will
+    actually read — at N processes a full verify on every reader would
+    re-read every other process's multi-GB shard archive (O(N²) recovery
+    I/O). None = verify everything (the driver's once-per-resume check).
+    Raises :class:`CheckpointCorruptError` on a missing or mismatched
+    file; checkpoints written before checksums existed (no
+    ``checksums_*.json``) pass vacuously."""
+    sums = _read_checksums(path)
+    for member, crc in sums.items():
+        if members is not None and member not in members:
+            continue
+        _verify_member(path, member, crc)
+
+
+def verify_checkpoint_light(path: str) -> dict[str, int]:
+    """Read-proportional integrity check: full crc32 on the small
+    members (meta/treedef/user payloads), existence-only for the
+    shards_p*.npz archives — their crcs verify lazily, per reader, on
+    first read (:meth:`_ShardReader.load`), so a driver-side check
+    before every resume costs O(small members) instead of re-reading
+    every multi-GB shard archive that each worker will re-verify
+    anyway. Returns the merged checksum record for the caller's reuse.
+    """
+    sums = _read_checksums(path)
+    for member, crc in sums.items():
+        if member.startswith("shards_p"):
+            # a vanished shard archive would otherwise silently
+            # assemble zeros for its pieces; existence is cheap eagerly
+            if not os.path.exists(os.path.join(path, member)):
+                raise CheckpointCorruptError(path, f"{member} missing")
+        else:
+            _verify_member(path, member, crc)
+    return sums
+
+
+def _fire_restore(path: str) -> None:
+    """``checkpoint.restore`` site: ``die`` raises, ``delay``/``stall``
+    sleep, ``drop`` surfaces as detected bitrot (typed, not a pickle
+    traceback)."""
+    if not fault_injection.enabled():
+        return
+    act = fault_injection.fire("checkpoint.restore", path=path)
+    if act == "drop":
+        raise CheckpointCorruptError(path, "injected bitrot (drop)")
 
 
 class Checkpoint:
@@ -41,11 +215,16 @@ class Checkpoint:
     def from_dict(cls, data: dict, path: str | None = None) -> "Checkpoint":
         path = path or tempfile.mkdtemp(prefix="ray_tpu_ckpt_")
         os.makedirs(path, exist_ok=True)
-        with open(os.path.join(path, "user.pkl"), "wb") as f:
-            pickle.dump(data, f)
+        sums: dict[str, int] = {}
+        _write_with_checksum(path, "user.pkl", pickle.dumps(data), sums)
+        _flush_checksums(path, "d", sums)
         return cls(path)
 
     def to_dict(self) -> dict:
+        _fire_restore(self.path)
+        # only the member actually read — not every shard archive that
+        # may share the directory
+        verify_checkpoint(self.path, members={"user.pkl"})
         with open(os.path.join(self.path, "user.pkl"), "rb") as f:
             return pickle.load(f)
 
@@ -95,25 +274,33 @@ def save_state(state: Any, path: str, *, process_index: int | None = None,
                     for sl in s.index
                 )
                 shards[key] = np.asarray(s.data)
+    sums: dict[str, int] = {}
+    # stream the (potentially multi-GB) shard archive straight to disk;
+    # the crc is computed incrementally from the file afterwards
     np.savez(os.path.join(path, f"shards_p{pid}.npz"), **shards)
+    _checksum_saved_file(path, f"shards_p{pid}.npz", sums)
 
     if pid == 0:
         meta = {
             "leaves": [_leaf_meta(leaf) for leaf in leaves],
             "n_leaves": len(leaves),
+            # the writer manifest: verification requires a checksum
+            # record from every one of these, or a wholly-lost writer
+            # would pass vacuously and restore as silent zeros
+            "n_writers": jax.process_count(),
         }
-        with open(os.path.join(path, "meta.msgpack"), "wb") as f:
-            f.write(msgpack.packb(meta))
-        with open(os.path.join(path, "treedef.pkl"), "wb") as f:
-            pickle.dump(
+        _write_with_checksum(path, "meta.msgpack", msgpack.packb(meta), sums)
+        _write_with_checksum(
+            path, "treedef.pkl",
+            pickle.dumps(
                 (treedef,
                  [leaf if not _is_jax_array(leaf) else None
-                  for leaf in leaves]),
-                f,
-            )
+                  for leaf in leaves])),
+            sums,
+        )
         if extra is not None:
-            with open(os.path.join(path, "user.pkl"), "wb") as f:
-                pickle.dump(extra, f)
+            _write_with_checksum(path, "user.pkl", pickle.dumps(extra), sums)
+    _flush_checksums(path, f"p{pid}", sums)
     return Checkpoint(path)
 
 
@@ -131,14 +318,27 @@ class _ShardReader:
     the requested shard's bytes — the property the shard-local restore
     relies on. `bytes_read` is the restore's read accounting."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, sums: dict[str, int] | None = None):
+        self._path = path
         self._zips = {}
+        self._sums = sums or {}
+        self._verified: set[str] = set()
         self.by_leaf: dict[int, list[tuple[str, str, str]]] = {}
         self.bytes_read = 0
         for fn in sorted(os.listdir(path)):
             if not fn.startswith("shards_p"):
                 continue
-            z = np.load(os.path.join(path, fn))
+            try:
+                z = np.load(os.path.join(path, fn))
+            except Exception as e:  # noqa: BLE001 — BadZipFile/OSError/…
+                # a write torn at the zip central directory fails right
+                # here, before the lazy per-member crc check in load()
+                # ever runs — it must still surface as the TYPED error
+                # (fallback to the previous checkpoint), not a zip
+                # traceback the trainer classifies as a user bug
+                raise CheckpointCorruptError(
+                    path, f"{fn} unreadable: {type(e).__name__}: {e}"
+                ) from None
             self._zips[fn] = z
             for key in z.files:
                 leaf_i, _, idx = key.partition("/")
@@ -146,6 +346,12 @@ class _ShardReader:
                     (idx, fn, key))
 
     def load(self, fn: str, key: str) -> np.ndarray:
+        # verify a shard archive the FIRST time a piece is read from it:
+        # shard-local restores keep reading ~1/N of the checkpoint
+        # instead of crc-scanning every other process's archive
+        if fn in self._sums and fn not in self._verified:
+            _verify_member(self._path, fn, self._sums[fn])
+            self._verified.add(fn)
         arr = self._zips[fn][key]
         self.bytes_read += arr.nbytes
         return arr
@@ -223,12 +429,17 @@ def restore_state(path: str, mesh=None, shardings=None, *,
     from jax.sharding import NamedSharding, PartitionSpec
     from jax.tree_util import tree_flatten, tree_unflatten
 
+    _fire_restore(path)
+    # small members verify upfront; shard archives verify lazily on
+    # first read inside _ShardReader (each process touches only its own
+    # ~1/N of the checkpoint — the shard-local property)
+    sums = verify_checkpoint_light(path)
     with open(os.path.join(path, "meta.msgpack"), "rb") as f:
         meta = msgpack.unpackb(f.read())
     with open(os.path.join(path, "treedef.pkl"), "rb") as f:
         treedef, py_leaves = pickle.load(f)
 
-    reader = _ShardReader(path)
+    reader = _ShardReader(path, sums)
 
     if shardings is not None:
         # Keep None placeholders for non-array leaves so indices align with
@@ -326,6 +537,46 @@ class CheckpointManager:
             return None
         path = max(self._registered, key=lambda t: t[1])[2]
         return Checkpoint(path)
+
+    def owns(self, ckpt: "Checkpoint | str") -> bool:
+        """Whether this manager registered the checkpoint — the guard
+        that keeps :meth:`discard` (an rmtree) off user-owned paths."""
+        path = ckpt.path if isinstance(ckpt, Checkpoint) else \
+            os.path.abspath(ckpt)
+        return any(t[2] == path for t in self._registered)
+
+    def discard(self, ckpt: "Checkpoint | str") -> None:
+        """Drop a (corrupt) checkpoint from the registry and disk, so
+        `latest`/`latest_valid` fall back to the one before it."""
+        path = ckpt.path if isinstance(ckpt, Checkpoint) else \
+            os.path.abspath(ckpt)
+        self._registered = [t for t in self._registered if t[2] != path]
+        shutil.rmtree(path, ignore_errors=True)
+
+    def latest_valid(self, *, full: bool = True) -> Checkpoint | None:
+        """Newest checkpoint that passes integrity verification; corrupt
+        ones are discarded on the way down (the resume path's fallback
+        chain — a torn write costs one checkpoint, not the run).
+        ``full=False`` runs the read-proportional check (small members +
+        shard-archive existence): right for the resume path, where shard
+        crcs verify lazily worker-side and a corrupt shard surfaces as a
+        typed restore failure on the next iteration anyway."""
+        while True:
+            c = self.latest
+            if c is None:
+                return None
+            try:
+                if full:
+                    verify_checkpoint(c.path)
+                else:
+                    verify_checkpoint_light(c.path)
+                return c
+            except CheckpointCorruptError as e:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "discarding corrupt checkpoint: %s", e)
+                self.discard(c)
 
     def latest_dict(self) -> dict | None:
         """Payload of the newest dict-style checkpoint, or None when

@@ -5,6 +5,14 @@ Reference: `python/ray/train/_internal/session.py` (`_TrainSession:73`,
 worker; `report(metrics, checkpoint=...)` hands results to a bounded queue
 that the driver drains one step at a time, keeping workers in lockstep at
 report boundaries.
+
+Elastic additions: the session also carries this incarnation's collective
+group (`get_collective_group`), the in-place-resume counter
+(`get_resume_seq`), and the rank's dataset shards
+(`get_dataset_shard`). :class:`DataShard` objects live in the hosting
+actor's state, so a warm resume (same process, new session) preserves a
+survivor's iterator position — rebalancing after a membership change
+re-splits assignments without restarting anyone from epoch 0.
 """
 
 from __future__ import annotations
@@ -15,6 +23,83 @@ from dataclasses import dataclass, field
 from typing import Any, Optional
 
 
+class DataShard:
+    """One rank's slice of a named dataset: an assigned subset of the
+    dataset's blocks plus an epoch cursor.
+
+    Iterating yields the not-yet-consumed blocks of the CURRENT epoch
+    (marking each consumed); draining the assignment completely bumps
+    the epoch and clears the consumed-set, so `for block in shard:` is
+    one epoch pass and calling it again starts the next epoch.
+
+    Elasticity: :meth:`reassign` installs a rebalanced index assignment
+    while keeping the epoch and the consumed-set for indices this rank
+    retains — a survivor of an in-place resume continues exactly where
+    it was. Indices adopted from a dead rank start unconsumed (its
+    cursor died with it), giving at-least-once delivery of at most one
+    epoch's worth of the adopted blocks.
+    """
+
+    def __init__(self, name: str, blocks, indices):
+        self.name = name
+        self._blocks = blocks  # full index-addressed block list
+        self.indices = list(indices)
+        self.epoch = 0
+        self._consumed: set[int] = set()
+
+    def __len__(self) -> int:
+        return len(self.indices)
+
+    def assigned_indices(self) -> list[int]:
+        """The block indices currently assigned to this rank (the
+        world-size-invariant handle: the union over ranks is always the
+        whole dataset, disjoint)."""
+        return list(self.indices)
+
+    def state(self) -> dict:
+        """Snapshot of the cursor — checkpoint it NEXT TO the model state
+        and restore with :meth:`load_state`, so a rollback to the
+        checkpoint rewinds the data cursor too (otherwise blocks consumed
+        after the checkpoint but before a failure are skipped for the
+        rest of their epoch when the model state rolls back)."""
+        return {"epoch": self.epoch, "consumed": sorted(self._consumed)}
+
+    def load_state(self, state: dict) -> None:
+        """Restore a cursor captured by :meth:`state` (warm-resume
+        rollback). Consumed entries for indices this rank no longer owns
+        are dropped, mirroring :meth:`reassign`."""
+        self.epoch = int(state.get("epoch", 0))
+        self._consumed = set(state.get("consumed", ())) & set(self.indices)
+
+    def reassign(self, indices, blocks=None) -> None:
+        if blocks is not None:
+            self._blocks = blocks
+        new = set(indices)
+        self._consumed &= new  # drop cursor state for indices we lost
+        self.indices = list(indices)
+
+    def __iter__(self):
+        # a consumer that broke out ON the final block left everything
+        # consumed without reaching the post-loop boundary below; roll
+        # the epoch here or this pass would yield nothing, bump, and
+        # silently contribute an empty epoch
+        if self.indices and set(self.indices) <= self._consumed:
+            self.epoch += 1
+            self._consumed.clear()
+        for i in list(self.indices):
+            if i in self._consumed:
+                continue
+            self._consumed.add(i)
+            yield self._blocks[i]
+        # fully drained (not broken out of): epoch boundary. The
+        # `self.indices and` guard keeps an EMPTY assignment (fewer
+        # blocks than ranks after a rebalance) from bumping the epoch
+        # on every pass while consuming nothing.
+        if self.indices and set(self.indices) <= self._consumed:
+            self.epoch += 1
+            self._consumed.clear()
+
+
 @dataclass
 class _Session:
     world_rank: int
@@ -22,6 +107,13 @@ class _Session:
     local_rank: int = 0
     experiment_dir: str | None = None
     resume_checkpoint: Any = None  # Checkpoint | None
+    # name of the gang's DCN collective group ("dcn" backend), if any
+    collective_group: str | None = None
+    # how many resumes (in-place or gang) preceded this incarnation:
+    # 0 = first launch. Chaos harnesses key one-shot fault arming on it.
+    resume_seq: int = 0
+    # name -> DataShard (owned by the hosting actor; survives warm resume)
+    dataset_shards: dict = field(default_factory=dict)
     # queue(1): the user thread blocks in report() until the driver consumed
     # the previous result — the reference's backpressure behavior.
     results: "queue.Queue[Any]" = field(
@@ -68,6 +160,35 @@ def report(metrics: dict, checkpoint=None) -> None:
 def get_checkpoint():
     """The checkpoint to resume from, if the run was restored."""
     return _get_session().resume_checkpoint
+
+
+def get_dataset_shard(name: str = "train") -> DataShard:
+    """This rank's :class:`DataShard` of the trainer's `datasets[name]`.
+
+    After an elastic membership change the driver re-splits assignments;
+    the same object (with its preserved cursor) reflects the new split.
+    """
+    shards = _get_session().dataset_shards
+    if name not in shards:
+        raise KeyError(
+            f"no dataset shard {name!r}: pass datasets={{{name!r}: ...}} "
+            f"to JaxTrainer (available: {sorted(shards)})"
+        )
+    return shards[name]
+
+
+def get_collective_group() -> str | None:
+    """Name of the gang-wide DCN collective group the backend
+    rendezvoused (``backend="dcn"``), or None for the jax.distributed
+    backend (where the mesh is the collective)."""
+    return _get_session().collective_group
+
+
+def get_resume_seq() -> int:
+    """0 on the first launch; incremented by every trainer-driven resume
+    (in-place or gang). Lets a loop do first-incarnation-only work (e.g.
+    arming chaos faults exactly once)."""
+    return _get_session().resume_seq
 
 
 def get_world_rank() -> int:

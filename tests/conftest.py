@@ -37,6 +37,15 @@ assert jax.default_backend() == "cpu", (
 )
 
 
+def pytest_configure(config):
+    # tier-1 runs `-m "not slow"`; register the marker so long soaks
+    # (test_chaos_soak.py) opt out without unknown-mark warnings
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running soak/perf tests excluded from the tier-1 run",
+    )
+
+
 @pytest.fixture(autouse=True)
 def _hang_watchdog():
     """Convert silent suite wedges into diagnosed failures: if any single

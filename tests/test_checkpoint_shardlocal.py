@@ -85,7 +85,12 @@ def test_multi_writer_files_cover(tmp_path):
 
     path = str(tmp_path / "ck")
     save_state({"v": v}, path, process_index=0)
-    # split the single file into two to model multi-writer layout
+    # split the single file into two to model multi-writer layout; each
+    # writer also records its own checksum file (checksums_p{k}.json),
+    # so model that half of the format too
+    import json
+    import zlib
+
     z = np.load(os.path.join(path, "shards_p0.npz"))
     keys = list(z.files)
     half = len(keys) // 2
@@ -93,5 +98,16 @@ def test_multi_writer_files_cover(tmp_path):
              **{k: z[k] for k in keys[:half]})
     np.savez(os.path.join(path, "shards_p1.npz"),
              **{k: z[k] for k in keys[half:]})
+    with open(os.path.join(path, "checksums_p0.json")) as f:
+        sums0 = json.load(f)
+    for pid in (0, 1):
+        fn = f"shards_p{pid}.npz"
+        with open(os.path.join(path, fn), "rb") as f:
+            sums0[fn] = zlib.crc32(f.read())
+    sums1 = {"shards_p1.npz": sums0.pop("shards_p1.npz")}
+    with open(os.path.join(path, "checksums_p0.json"), "w") as f:
+        json.dump(sums0, f)
+    with open(os.path.join(path, "checksums_p1.json"), "w") as f:
+        json.dump(sums1, f)
     out = restore_state(path, mesh=mesh)
     np.testing.assert_array_equal(np.asarray(out["v"]), np.arange(64))
