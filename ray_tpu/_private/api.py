@@ -675,8 +675,15 @@ def method(**kwargs):
 
 # ---------------- object API ----------------
 
-def put(value) -> ObjectRef:
-    return ObjectRef(_get_worker().put(value))
+def put(value, *, _inline: bool | None = None) -> ObjectRef:
+    """Store ``value``; ``_inline=False`` forces even a small value into
+    the shared object store (announced + directory-registered) instead
+    of the owner-inline fast path. Inline objects are resolvable only
+    through paths that carry owner info (task args/results); a ref that
+    travels a SIDE CHANNEL — actor state, a buffer/queue actor, a later
+    unrelated task result — needs the store copy for third processes to
+    fetch it (e.g. rl/experience.py trajectory handoff)."""
+    return ObjectRef(_get_worker().put(value, inline=_inline))
 
 
 class ObjectRefGenerator:

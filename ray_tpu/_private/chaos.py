@@ -62,21 +62,55 @@ SITE_ACTIONS: dict[str, list[tuple[str, float]]] = {
 
 # sites that fire in the driver/agent process rather than a train worker
 DRIVER_SITES = frozenset(
-    {"agent.heartbeat", "object.read_chunk", "worker.lease_push"})
+    {"agent.heartbeat", "object.read_chunk", "worker.lease_push",
+     "rl.rollout"})
+
+# ---- the serving-pool / RL-loop fault surface (profile="rl") ----
+#
+# These sites trip in SERVE-POOL actor processes (decode replicas,
+# prefill workers) or the driver's rollout threads — neither train
+# workers nor the base driver sites. Weights mirror the production
+# failure mix of a post-training deployment: replica churn dominates,
+# prefill death and rollout stalls are rarer, and the learner gang keeps
+# the ring/checkpoint sites from the train profile.
+RL_SITE_WEIGHTS: dict[str, float] = {
+    "serve.replica_pump": 3.0,   # decode replica death / stall mid-chunk
+    "serve.prefill": 1.0,        # prefill worker death mid-prefill
+    "rl.rollout": 1.0,           # rollout actor crash/stall pre-add
+    "ring.send": 2.0,            # learner rank death mid-allreduce
+    "ring.recv": 1.0,
+    "checkpoint.save": 0.75,
+    "checkpoint.restore": 0.5,
+}
+
+RL_SITE_ACTIONS: dict[str, list[tuple[str, float]]] = {
+    # "die" inside the pump is absorbed by the replica's pump backstop
+    # (logged, decode continues) — only exit/delay exercise recovery
+    "serve.replica_pump": [("exit", 3.0), ("delay", 1.0)],
+    "serve.prefill": [("exit", 2.0), ("die", 1.0), ("delay", 1.0)],
+    "rl.rollout": [("drop", 1.0), ("delay", 2.0)],
+}
+
+# serve-pool sites arm via the env-propagated RAY_TPU_FAULT_SPEC (the
+# pool's actor processes load it on first fire), not via train-loop
+# config or driver configure()
+SERVE_SITES = frozenset({"serve.replica_pump", "serve.prefill"})
 
 
 @dataclass
 class FaultPlan:
     """One seed's expansion: everything needed to run — and replay — a
-    chaos episode."""
+    chaos episode. ``serve_specs`` (profile="rl") arm inside serving-
+    pool actors through the env-propagated fault_spec config."""
 
     seed: int
     worker_specs: list[dict] = field(default_factory=list)
     driver_specs: list[dict] = field(default_factory=list)
+    serve_specs: list[dict] = field(default_factory=list)
 
     @property
     def specs(self) -> list[dict]:
-        return self.worker_specs + self.driver_specs
+        return self.worker_specs + self.driver_specs + self.serve_specs
 
     def env_value(self) -> str:
         """The exact `RAY_TPU_FAULT_SPEC` value that replays this plan
@@ -97,7 +131,11 @@ def _weighted(rng: random.Random, pairs) -> str:
 
 def gen_fault_plan(seed: int, *, world_size: int = 2,
                    max_faults: int = 2,
-                   sites: dict[str, float] | None = None) -> FaultPlan:
+                   sites: dict[str, float] | None = None,
+                   profile: str = "train",
+                   n_replicas: int = 2,
+                   n_prefill: int = 0,
+                   n_rollout: int = 1) -> FaultPlan:
     """Deterministically expand ``seed`` into 1..max_faults specs.
 
     ``match`` pins rank-scoped sites to a specific rank (so a kill hits
@@ -105,13 +143,33 @@ def gen_fault_plan(seed: int, *, world_size: int = 2,
     box), ``after`` spreads trips across the run's occurrence timeline,
     and ``count=1`` keeps every plan finite. ``sites`` overrides the
     default site weighting (e.g. to soak only the checkpoint path).
+
+    ``profile="rl"`` sweeps the actor–learner fault surface instead
+    (RL_SITE_WEIGHTS): decode-replica kills mid-rollout, prefill-worker
+    death, rollout-actor noise, plus learner ring/checkpoint faults —
+    ``world_size`` then means the LEARNER gang. Replica/prefill specs
+    pin one named pool member (names are ``decode-N``/``prefill-N``, N
+    from 1), so a respawned replacement (fresh name) never re-trips an
+    exhausted kill — plans stay finite. The default "train" profile is
+    byte-identical to the pre-RL expansion for every seed, keeping the
+    existing soak's fixed seeds replayable.
     """
     rng = random.Random(seed)
-    weights = list((sites or SITE_WEIGHTS).items())
+    if profile == "rl":
+        default_weights = dict(RL_SITE_WEIGHTS)
+        if n_prefill <= 0:
+            default_weights.pop("serve.prefill", None)
+        actions = {**SITE_ACTIONS, **RL_SITE_ACTIONS}
+    elif profile == "train":
+        default_weights = SITE_WEIGHTS
+        actions = SITE_ACTIONS
+    else:
+        raise ValueError(f"unknown chaos profile {profile!r}")
+    weights = list((sites or default_weights).items())
     plan = FaultPlan(seed=seed)
     for _ in range(rng.randint(1, max_faults)):
         site = _weighted(rng, weights)
-        action = _weighted(rng, SITE_ACTIONS[site])
+        action = _weighted(rng, actions[site])
         spec: dict = {"site": site, "action": action, "count": 1}
         if site.startswith("ring.") or site == "collective.send":
             spec["match"] = {"rank": rng.randrange(world_size)}
@@ -119,12 +177,29 @@ def gen_fault_plan(seed: int, *, world_size: int = 2,
             # steps' worth of occurrences so kills land mid-step at
             # different points of the schedule per seed
             spec["after"] = rng.randrange(0, 10)
+        elif site == "serve.replica_pump":
+            # pin ONE initial replica by engine name; the pump ticks
+            # continuously, so spread trips across a few seconds' worth
+            spec["match"] = {
+                "engine": f"decode-{rng.randrange(n_replicas) + 1}"}
+            spec["after"] = rng.randrange(5, 120)
+        elif site == "serve.prefill":
+            spec["match"] = {
+                "worker": f"prefill-{rng.randrange(n_prefill) + 1}"}
+            spec["after"] = rng.randrange(0, 4)
+        elif site == "rl.rollout":
+            spec["match"] = {"actor": rng.randrange(n_rollout)}
+            spec["after"] = rng.randrange(0, 8)
         elif site.startswith("checkpoint."):
             spec["after"] = rng.randrange(0, 4)
         else:
             spec["after"] = rng.randrange(0, 6)
         if action == "delay":
             spec["delay_s"] = round(rng.uniform(0.05, 0.3), 3)
-        (plan.driver_specs if site in DRIVER_SITES
-         else plan.worker_specs).append(spec)
+        if site in SERVE_SITES:
+            plan.serve_specs.append(spec)
+        elif site in DRIVER_SITES:
+            plan.driver_specs.append(spec)
+        else:
+            plan.worker_specs.append(spec)
     return plan

@@ -376,6 +376,141 @@ def run_serve_benchmarks(*, quick: bool = False) -> list[dict]:
     return results
 
 
+def run_rl_benchmarks(*, quick: bool = False) -> list[dict]:
+    """The `rl` family: the actor–learner loop's three data paths.
+
+    - rollout tokens/s: sampled streaming decode (temperature/top-p +
+      per-token logprobs) through the pool's experience surface
+      (submit_stream/poll_stream) — the Podracer rollout rate;
+    - experience bytes/s: trajectory handoff through the object store
+      (forced-plasma put → versioned buffer add → claim → learner-side
+      get), the zero-copy path the learner gang feeds from;
+    - publish-to-adoption: one-put weight broadcast → every replica's
+      engine has SWAPPED (not merely staged) the new version — the
+      staleness window the off-policy correction is sized against."""
+    import threading
+
+    import ray_tpu
+    from ray_tpu.rl.experience import ExperienceBuffer
+    from ray_tpu.serve.llm import build_model
+    from ray_tpu.serve.llm_pool import LLMPool
+
+    results = []
+    prompt_len, new_tokens, chunk_delay = 16, 96, 0.05
+    n_requests = 12 if quick else 24
+    pool = LLMPool(
+        model_size="tiny", slots=8, max_len=128, chunk_tokens=8,
+        prompt_buckets=(prompt_len,), min_replicas=2, max_replicas=2,
+        chunk_delay_s=chunk_delay, autoscale=False)
+    try:
+        # --- rollout tokens/s (sampled streaming + logprobs) ---
+        def stream_one(i, out):
+            rng = np.random.RandomState(2000 + i)
+            prompt = [int(x) for x in rng.randint(1, 250, prompt_len)]
+            sub = pool.submit_stream({
+                "prompt_ids": prompt, "max_tokens": new_tokens,
+                "temperature": 1.0, "top_p": 0.95,
+                "seed": 1000 + i})
+            toks, lps = [], []
+            while True:
+                r = pool.poll_stream(sub["rid"])
+                toks += r["tokens"]
+                lps += r["logprobs"]
+                if r["done"]:
+                    break
+                time.sleep(0.004)
+            assert len(toks) == len(lps)
+            out[i] = len(toks)
+
+        # warm BOTH replicas' compile caches (sampled kernel): two
+        # concurrent streams — least-loaded routing lands one on each
+        warm = [0, 0]
+        wts = [threading.Thread(target=stream_one, args=(i, warm))
+               for i in range(2)]
+        for t in wts:
+            t.start()
+        for t in wts:
+            t.join()
+        counts = [0] * n_requests
+        threads = [threading.Thread(target=stream_one, args=(i, counts))
+                   for i in range(n_requests)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        r = {"name": "rl rollout sampled stream (2 replicas)",
+             "per_s": round(sum(counts) / dt, 1), "unit": "tokens/s",
+             "replicas": 2, "n_requests": n_requests,
+             "new_tokens": new_tokens, "chunk_delay_s": chunk_delay}
+        results.append(r)
+        print(json.dumps(r), flush=True)
+
+        # --- experience bytes/s through the store ---
+        buf = ray_tpu.remote(num_cpus=0)(ExperienceBuffer).remote()
+        ray_tpu.get(buf.size.remote(), timeout=120)
+        traj_tokens = 4096  # a long-generation trajectory's arrays
+        traj = {
+            "prompt": np.arange(512, dtype=np.int32),
+            "tokens": np.zeros(traj_tokens, np.int32),
+            "logprobs": np.zeros(traj_tokens, np.float32),
+            "rewards": np.zeros(traj_tokens, np.float32),
+            "version": 0,
+        }
+        nbytes = sum(v.nbytes for v in traj.values()
+                     if isinstance(v, np.ndarray))
+        iters = 30 if quick else 100
+
+        def xfer_once(i):
+            ref = ray_tpu.put(traj, _inline=False)
+            ray_tpu.get(buf.add.remote(
+                {"key": (0, i), "version": 0, "traj": {"ref": ref}}),
+                timeout=60)
+            out = ray_tpu.get(buf.claim.remote("bench", 1, i + 1),
+                              timeout=60)
+            got = ray_tpu.get(out["entries"][0]["traj"]["ref"],
+                              timeout=60)
+            assert got["tokens"].nbytes == traj["tokens"].nbytes
+
+        xfer_once(-1)  # warm
+        t0 = time.perf_counter()
+        for i in range(iters):
+            xfer_once(i)
+        dt = time.perf_counter() - t0
+        r = {"name": "rl experience handoff (put+add+claim+get)",
+             "per_s": round(iters / dt, 1), "unit": "ops/s",
+             "traj_bytes": nbytes,
+             "mb_per_s": round(iters * nbytes / dt / 1e6, 1)}
+        results.append(r)
+        print(json.dumps(r), flush=True)
+        ray_tpu.kill(buf)
+
+        # --- publish-to-adoption latency ---
+        import jax
+
+        params, _ = build_model("tiny", max_len=128, seed=1)
+        host = jax.tree_util.tree_map(
+            lambda a: np.asarray(jax.device_get(a)), params)
+        lats = []
+        for i in range(3 if quick else 5):
+            t0 = time.perf_counter()
+            v = pool.publish_weights(host)
+            assert pool.wait_version(v, timeout=60.0), "adoption timed out"
+            lats.append(time.perf_counter() - t0)
+        lat = min(lats)
+        r = {"name": "rl weight publish-to-adoption (2 replicas)",
+             "per_s": round(1.0 / lat, 1), "unit": "ops/s",
+             "latency_s": round(lat, 4),
+             "weight_bytes": int(sum(
+                 a.nbytes for a in jax.tree_util.tree_leaves(host)))}
+        results.append(r)
+        print(json.dumps(r), flush=True)
+    finally:
+        pool.shutdown()
+    return results
+
+
 def run_benchmarks(*, quick: bool = False) -> list[dict]:
     results = []
     windows = 1 if quick else 3
@@ -474,6 +609,9 @@ def run_benchmarks(*, quick: bool = False) -> list[dict]:
     # ---- serving tier (LLM pool replica scaling + prefix cache) ----
     results.extend(run_serve_benchmarks(quick=quick))
 
+    # ---- rl (actor-learner rollout / experience / publish paths) ----
+    results.extend(run_rl_benchmarks(quick=quick))
+
     # ---- transfer (zero-copy put + pipelined cross-node pull) ----
     results.extend(run_transfer_benchmarks(quick=quick))
 
@@ -531,7 +669,8 @@ def main(argv=None):
     p.add_argument("--out", default=None, help="write results JSON here")
     p.add_argument("--quick", action="store_true")
     p.add_argument("--family", default="all",
-                   choices=["all", "collective", "transfer", "serve"],
+                   choices=["all", "collective", "transfer", "serve",
+                            "rl"],
                    help="run one workload family only")
     p.add_argument("--in-process", action="store_true",
                    help="head in the driver process (debug only)")
@@ -552,6 +691,8 @@ def main(argv=None):
             results = run_transfer_benchmarks(quick=args.quick)
         elif args.family == "serve":
             results = run_serve_benchmarks(quick=args.quick)
+        elif args.family == "rl":
+            results = run_rl_benchmarks(quick=args.quick)
         else:
             results = run_benchmarks(quick=args.quick)
     finally:

@@ -730,14 +730,18 @@ class CoreWorker:
 
     # ------------- put / get / wait -------------
 
-    def put(self, value) -> bytes:
+    def put(self, value, *, inline: bool | None = None) -> bytes:
         """Store a value; returns object id (we are the owner).
 
         Single-copy: serialization keeps pickle-5 buffers as memoryviews
         over the caller's arrays; the plasma path writes them straight
         into the shm segment (the ONLY copy), the inline path
         materializes once into the owner entry (the payload must not
-        alias caller buffers the user may mutate)."""
+        alias caller buffers the user may mutate). ``inline=False``
+        forces the plasma path regardless of size: only sealed store
+        objects are announced to the directory, so a ref handed to
+        third processes through a side channel (actor state, another
+        task's result) stays fetchable cluster-wide."""
         oid = ObjectID.for_put(
             WorkerID(self.worker_id), self.put_counter.next()
         ).binary()
@@ -757,7 +761,7 @@ class CoreWorker:
                 pass
         e = self._entry(oid)
         e.owned = True
-        if size <= INLINE_MAX:
+        if size <= INLINE_MAX and inline is not False:
             e.payload = [meta, [bytes(v) for v in views]]
         else:
             self._put_plasma(oid, [meta, views])

@@ -101,6 +101,92 @@ def _layer_decode_ragged(cfg: LlamaConfig, h, p, sin, cos, ck, cv, pos):
     return h, ck, cv
 
 
+def _sample_from_logits(logits, seeds, pos, temps, top_ps):
+    """Per-slot stateless sampling lane: the RNG key for the token
+    emitted from position `pos` of a stream is
+    fold_in(PRNGKey(seed), pos) — a pure function of (request seed,
+    sequence position), independent of slot index, batch composition,
+    and admission timing. That independence is what makes seed-replay
+    bit-exact: a replica-death failover re-decodes the same prompt with
+    the same seed on ANY replica and reproduces the identical token
+    sequence, so the pool's emitted-offset dedup survives sampling.
+
+    logits [B, V] f32; seeds [B] uint32; pos/temps/top_ps [B].
+    temperature == 0 selects the greedy token (bit-identical to the
+    legacy argmax path); its logprob is reported under the unscaled
+    distribution. Returns ([B] int32 tokens, [B] f32 logprobs under the
+    ACTUAL sampling distribution — temperature-scaled and
+    top-p-renormalized — i.e. the behavior policy an RL learner must
+    importance-correct against)."""
+    keys = jax.vmap(
+        lambda s, p: jax.random.fold_in(jax.random.PRNGKey(s), p)
+    )(seeds, pos)
+
+    def one(key, row, temp, top_p):
+        greedy = jnp.argmax(row)
+        greedy_lp = jax.nn.log_softmax(row)[greedy]
+        scaled = row / jnp.maximum(temp, 1e-6)
+        order = jnp.argsort(-scaled)
+        srt = scaled[order]
+        probs = jax.nn.softmax(srt)
+        cum = jnp.cumsum(probs)
+        # smallest set of tokens whose mass reaches top_p (the exclusive
+        # cumsum keeps at least the top token even for tiny top_p)
+        keep = (cum - probs) < top_p
+        filt = jnp.where(keep, srt, -jnp.inf)
+        idx = jax.random.categorical(key, filt)
+        lp = jax.nn.log_softmax(filt)[idx]
+        sampled = order[idx]
+        use = temp > 0.0
+        return (jnp.where(use, sampled, greedy).astype(jnp.int32),
+                jnp.where(use, lp, greedy_lp))
+
+    return jax.vmap(one)(keys, logits, temps, top_ps)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "chunk"),
+                   donate_argnames=("cache", "tok"))
+def decode_chunk_sampled(params, cache, tok, active, seeds, temps,
+                         top_ps, cfg: LlamaConfig, chunk: int):
+    """`decode_chunk` with per-slot sampling lanes and per-token
+    logprobs. seeds [B] uint32 / temps [B] / top_ps [B] ride alongside
+    the slot batch; a slot with temperature 0 decodes greedily
+    (bit-identical tokens to `decode_chunk`). Returns
+    ([B, chunk] tokens, [B, chunk] f32 logprobs, new cache, [B] last)."""
+    cdt = cfg.compute_dtype
+    w_out = (
+        params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    ).astype(cdt)
+    max_len = cache["k"].shape[2]
+
+    def one_step(carry, _):
+        t, k, v, pos = carry
+        sin, cos = llama.rotary_embedding(
+            pos[:, None], cfg.head_dim, cfg.rope_theta)
+        h = params["embed"].astype(cdt)[t[:, None]]  # [B, 1, D]
+
+        def body(h_, xs):
+            p_, ck, cv = xs
+            h_, ck, cv = _layer_decode_ragged(
+                cfg, h_, p_, sin, cos, ck, cv, pos)
+            return h_, (ck, cv)
+
+        h, (k, v) = jax.lax.scan(body, h, (params["layers"], k, v))
+        h = llama.rms_norm(h, params["final_norm"], cfg.rms_eps)
+        logits = (h[:, 0] @ w_out).astype(jnp.float32)  # [B, V]
+        nxt, lp = _sample_from_logits(logits, seeds, pos, temps, top_ps)
+        nxt = jnp.where(active, nxt, t)  # frozen slots hold their token
+        # pos clamp: see decode_chunk
+        pos = jnp.minimum(pos + active.astype(pos.dtype), max_len - 1)
+        return (nxt, k, v, pos), (nxt, lp)
+
+    (last, k, v, pos), (toks, lps) = jax.lax.scan(
+        one_step, (tok, cache["k"], cache["v"], cache["pos"]),
+        None, length=chunk)
+    return (jnp.moveaxis(toks, 0, 1), jnp.moveaxis(lps, 0, 1),
+            {"k": k, "v": v, "pos": pos}, last)
+
+
 @functools.partial(jax.jit, static_argnames=("cfg", "chunk"),
                    donate_argnames=("cache", "tok"))
 def decode_chunk(params, cache, tok, active, cfg: LlamaConfig,
@@ -152,6 +238,7 @@ def decode_chunk(params, cache, tok, active, cfg: LlamaConfig,
 @functools.partial(jax.jit, static_argnames=("cfg",),
                    donate_argnames=("cache", "cur_tok"))
 def _prefill_batch_into_slots(params, prompts, true_lens, slots,
+                              seeds, temps, top_ps,
                               cache, cur_tok, cfg: LlamaConfig):
     """Prefill a BATCH of streams ([F, P] RIGHT-padded tokens, one
     shared static bucket P) into their slots of the shared ragged cache
@@ -160,7 +247,9 @@ def _prefill_batch_into_slots(params, prompts, true_lens, slots,
     full fixed round-trip (~0.1-0.2s), which dominated admission when
     every stream prefilled individually. Unused rows carry an
     OUT-OF-RANGE slot index; mode='drop' makes their scatters no-ops.
-    Returns (new cache, new cur_tok, [F] first greedy tokens).
+    seeds/temps/top_ps [F] are the per-stream sampling lanes
+    (temperature 0 = greedy). Returns (new cache, new cur_tok,
+    [F] first tokens, [F] first-token logprobs).
 
     Right-padding is safe without a pad mask: causal attention means
     real tokens (a prefix) never see the pad garbage, the first token
@@ -179,15 +268,21 @@ def _prefill_batch_into_slots(params, prompts, true_lens, slots,
     slot_len = cache["k"].shape[2]
     tmp = llama.init_cache(cfg, f, slot_len)
     logits, tmp = llama.forward_with_cache(params, prompts, cfg, tmp)
-    toks0 = jnp.argmax(
-        logits[jnp.arange(f), true_lens - 1], axis=-1).astype(jnp.int32)
+    last_logits = logits[jnp.arange(f), true_lens - 1].astype(jnp.float32)
+    # the first token is emitted from position true_len-1 — the same
+    # (seed, position) RNG lane scheme as decode_chunk_sampled, so a
+    # failover replay reproduces it regardless of which prefill path
+    # (inline, suffix, disaggregated) the replacement replica takes
+    toks0, logp0 = _sample_from_logits(
+        last_logits, seeds, true_lens - 1, temps, top_ps)
     # tmp k/v: [L, F, S, Hkv, D] -> scatter rows onto the slot axis
     cache = {
         "k": cache["k"].at[:, slots].set(tmp["k"], mode="drop"),
         "v": cache["v"].at[:, slots].set(tmp["v"], mode="drop"),
         "pos": cache["pos"].at[slots].set(true_lens, mode="drop"),
     }
-    return cache, cur_tok.at[slots].set(toks0, mode="drop"), toks0
+    return (cache, cur_tok.at[slots].set(toks0, mode="drop"),
+            toks0, logp0)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "slot_len"))
@@ -210,6 +305,24 @@ def prefill_kv(params, prompts, true_lens, cfg: LlamaConfig,
     return tmp["k"], tmp["v"], toks0
 
 
+@functools.partial(jax.jit, static_argnames=("cfg", "slot_len"))
+def prefill_kv_sampled(params, prompts, true_lens, seeds, temps,
+                       top_ps, cfg: LlamaConfig, slot_len: int):
+    """:func:`prefill_kv` with the sampling lanes: the first token comes
+    from the same (seed, position true_len-1) RNG lane as an inline
+    prefill, and its behavior logprob rides the payload — so a
+    disaggregated-prefill stream is bit-identical to an inline one under
+    sampling too. Returns ((k, v) [L, F, S, Hkv, D], toks0 [F],
+    logp0 [F])."""
+    f = prompts.shape[0]
+    tmp = llama.init_cache(cfg, f, slot_len)
+    logits, tmp = llama.forward_with_cache(params, prompts, cfg, tmp)
+    last_logits = logits[jnp.arange(f), true_lens - 1].astype(jnp.float32)
+    toks0, logp0 = _sample_from_logits(
+        last_logits, seeds, true_lens - 1, temps, top_ps)
+    return tmp["k"], tmp["v"], toks0, logp0
+
+
 @functools.partial(jax.jit, static_argnames=("cfg",),
                    donate_argnames=("cache", "cur_tok"))
 def _adopt_kv_into_slot(k_rows, v_rows, true_len, tok0, slot, cache,
@@ -228,25 +341,31 @@ def _adopt_kv_into_slot(k_rows, v_rows, true_len, tok0, slot, cache,
 @functools.partial(jax.jit, static_argnames=("cfg",),
                    donate_argnames=("cache", "cur_tok"))
 def _prefill_suffix_into_slot(params, pref_k, pref_v, n_prefix, suffix,
-                              suffix_len, slot, cache, cur_tok,
-                              cfg: LlamaConfig):
+                              suffix_len, seed, temp, top_p, slot,
+                              cache, cur_tok, cfg: LlamaConfig):
     """Prefix-cache warm path: seed a temp cache with the cached prefix
     rows (pref_k/v: [L, S, Hkv, D] zero-padded to the slot length),
     prefill only the suffix ([SB] right-padded static bucket) at
     pos=n_prefix, then full-slot-scatter into `slot`. Row independence
     + exact softmax masking make the result identical to a cold full
-    prefill of the whole prompt (kv_prefix_cache.py docstring)."""
+    prefill of the whole prompt (kv_prefix_cache.py docstring); the
+    first token rides the (seed, true_len-1) sampling lane so warm and
+    cold admission sample identically too."""
     tmp = {"k": pref_k[:, None], "v": pref_v[:, None], "pos": n_prefix}
     logits, tmp = llama.forward_with_cache(
         params, suffix[None, :], cfg, tmp)
-    tok0 = jnp.argmax(logits[0, suffix_len - 1], axis=-1).astype(jnp.int32)
     true_len = n_prefix + suffix_len
+    last_logits = logits[0, suffix_len - 1].astype(jnp.float32)
+    tok0, logp0 = _sample_from_logits(
+        last_logits[None], seed[None], (true_len - 1)[None],
+        temp[None], top_p[None])
+    tok0, logp0 = tok0[0], logp0[0]
     cache = {
         "k": cache["k"].at[:, slot].set(tmp["k"][:, 0]),
         "v": cache["v"].at[:, slot].set(tmp["v"][:, 0]),
         "pos": cache["pos"].at[slot].set(true_len),
     }
-    return cache, cur_tok.at[slot].set(tok0), tok0
+    return cache, cur_tok.at[slot].set(tok0), tok0, logp0
 
 
 @dataclass
@@ -260,6 +379,16 @@ class _Stream:
     done: bool = False
     taken: int = 0  # tokens already handed out via take_tokens()
     prefilled: dict | None = None  # external KV payload (k/v/first_token)
+    # sampling lane (temperature 0 = greedy, the default serving mode)
+    temperature: float = 0.0
+    top_p: float = 1.0
+    seed: int = 0
+    logprobs: list = field(default_factory=list)  # parallel to tokens
+    # weight version the stream decodes under — None until admission
+    # stamps it (the ENGINE's version, which may lag a pool publish by
+    # the staleness window; the pool's splice guard needs the version
+    # the tokens were actually generated under, not the publish stamp)
+    version: int | None = None
 
 
 class RaggedDecoder:
@@ -275,7 +404,7 @@ class RaggedDecoder:
                  max_len: int = 512, chunk_tokens: int = 32,
                  prompt_buckets: tuple = (32, 64, 128, 256),
                  prefix_cache=None, name: str = "default",
-                 chunk_delay_s: float = 0.0):
+                 chunk_delay_s: float = 0.0, weights_version: int = 0):
         self.params = params
         # Emulated per-chunk device dispatch latency for benchmarking
         # the SERVING tier on hosts without an accelerator: on a real
@@ -292,6 +421,21 @@ class RaggedDecoder:
         self.buckets = tuple(sorted(prompt_buckets))
         self.cache = init_ragged_cache(cfg, slots, max_len)
         self.cur_tok = jnp.zeros((slots,), jnp.int32)
+        # per-slot sampling lanes, rewritten at admission; frozen slots'
+        # values are dead (their sampled token is overwritten anyway)
+        self._slot_seed = np.zeros((slots,), np.uint32)
+        self._slot_temp = np.zeros((slots,), np.float32)
+        self._slot_topp = np.ones((slots,), np.float32)
+        # sticky: flips at the first sampled submit and stays — a
+        # greedy-only engine (the serving default) keeps the legacy
+        # argmax kernel (no per-token argsort/log_softmax cost, token
+        # logprobs reported as 0.0); after any sampled request the
+        # engine pays for exact logprobs on every stream
+        self._sampling_seen = False
+        # weight-version bookkeeping: bumped by set_params(); streams
+        # stamp the version live at their admission
+        self.weights_version = int(weights_version)
+        self.pumps = 0  # engine steps — staleness windows count these
         self.slot_stream: list[_Stream | None] = [None] * slots
         self.queue: collections.deque[_Stream] = collections.deque()
         self._next_sid = 0
@@ -309,9 +453,13 @@ class RaggedDecoder:
 
     # -- submission boundary --
 
-    def submit(self, prompt_tokens, max_new: int) -> int:
+    def submit(self, prompt_tokens, max_new: int, *,
+               temperature: float = 0.0, top_p: float = 1.0,
+               seed: int = 0) -> int:
         """Validates HERE (caller's thread) so a bad request raises at
-        the submitter, never inside the pump loop."""
+        the submitter, never inside the pump loop. ``temperature`` 0 is
+        greedy decode; > 0 samples on the stream's (seed, position)
+        RNG lane with nucleus (top-p) filtering."""
         prompt = np.asarray(prompt_tokens, np.int32)
         self._bucket(len(prompt))  # raises if no bucket fits
         # clamp generation to the slot's cache capacity: past max_len
@@ -322,15 +470,22 @@ class RaggedDecoder:
             raise ValueError(
                 f"prompt of {len(prompt)} tokens leaves no decode room "
                 f"in a max_len={self.max_len} cache")
+        if not 0.0 < float(top_p) <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if float(temperature) > 0.0:
+            self._sampling_seen = True
         s = _Stream(self._next_sid, prompt, min(max_new, room),
-                    submitted=time.perf_counter())
+                    submitted=time.perf_counter(),
+                    temperature=float(temperature), top_p=float(top_p),
+                    seed=int(seed) & 0xFFFFFFFF)
         self._next_sid += 1
         self.queue.append(s)
         self._by_sid[s.sid] = s
         return s.sid
 
     def submit_prefilled(self, prompt_tokens, max_new: int,
-                         kv: dict) -> int:
+                         kv: dict, *, temperature: float = 0.0,
+                         top_p: float = 1.0, seed: int = 0) -> int:
         """Enqueue a stream whose prefill already happened elsewhere
         (a dedicated prefill worker, serve/llm_pool.py). `kv`:
         {"k"/"v": [n_layers, S, n_kv_heads, head_dim] with S == this
@@ -350,10 +505,21 @@ class RaggedDecoder:
             raise ValueError(
                 f"prompt of {len(prompt)} tokens leaves no decode room "
                 f"in a max_len={self.max_len} cache")
+        if not 0.0 < float(top_p) <= 1.0:
+            # same submit-time guard as submit(): an out-of-range top_p
+            # reaching the kernel filters EVERY logit to -inf (NaN
+            # logprobs, arbitrary tokens) instead of failing loudly
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if float(temperature) > 0.0:
+            self._sampling_seen = True
         s = _Stream(self._next_sid, prompt, min(max_new, room),
                     submitted=time.perf_counter(),
+                    temperature=float(temperature), top_p=float(top_p),
+                    seed=int(seed) & 0xFFFFFFFF,
                     prefilled={"k": k, "v": np.asarray(kv["v"]),
-                               "first_token": int(kv["first_token"])})
+                               "first_token": int(kv["first_token"]),
+                               "first_logprob":
+                                   float(kv.get("first_logprob", 0.0))})
         self._next_sid += 1
         self.queue.append(s)
         self._by_sid[s.sid] = s
@@ -363,28 +529,40 @@ class RaggedDecoder:
         self._by_sid.pop(sid, None)
         return self.finished.pop(sid, None)
 
+    def stream_version(self, sid: int) -> int | None:
+        """The weight version `sid`'s tokens are generated under (None
+        until admission) — what the serving layer reports so failover
+        decisions compare GENERATING versions, not publish stamps."""
+        s = self._by_sid.get(sid)
+        return None if s is None else s.version
+
     def purge(self, sid: int) -> None:
         """Drop a finished/abandoned stream's bookkeeping."""
         self._by_sid.pop(sid, None)
         self.finished.pop(sid, None)
 
-    def take_tokens(self, sid: int) -> tuple[list, bool]:
+    def take_tokens(self, sid: int, *, with_logprobs: bool = False):
         """Streaming read: tokens appended since the last take, plus a
-        done flag. Safe to call from a handler thread while the pump
-        appends (list append/slice are atomic under the GIL; the pump
-        only ever appends). A fully-drained finished stream is purged
-        on the way out."""
+        done flag — ``with_logprobs=True`` adds the parallel per-token
+        behavior logprobs ((tokens, logprobs, done) instead of
+        (tokens, done)), the RL experience surface. Safe to call from a
+        handler thread while the pump appends (list append/slice are
+        atomic under the GIL; the pump only ever appends; logprobs are
+        appended BEFORE tokens so the parallel slice below never runs
+        ahead of them). A fully-drained finished stream is purged on
+        the way out."""
         s = self._by_sid.get(sid)
         if s is None:
-            return [], True
+            return ([], [], True) if with_logprobs else ([], True)
         n = len(s.tokens)
         new = s.tokens[s.taken:n]
+        lps = s.logprobs[s.taken:n]
         s.taken = n
         done = s.done and s.sid in self.finished
         if done and s.taken >= len(s.tokens):
             self.purge(sid)
-            return new, True
-        return new, False
+            return (new, lps, True) if with_logprobs else (new, True)
+        return (new, lps, False) if with_logprobs else (new, False)
 
     # -- engine internals --
 
@@ -405,6 +583,8 @@ class RaggedDecoder:
         cold: list[tuple[int, _Stream]] = []
         t_now = time.perf_counter()
         for slot, s in grabbed:
+            s.version = self.weights_version
+            self._set_lane(slot, s)
             if s.prefilled is not None:
                 # disaggregated path: the KV rows were computed by a
                 # prefill worker; admission is one scatter dispatch and
@@ -416,6 +596,7 @@ class RaggedDecoder:
                     np.int32(len(s.prompt)),
                     np.int32(p["first_token"]), np.int32(slot),
                     self.cache, self.cur_tok, self.cfg)
+                s.logprobs.append(p.get("first_logprob", 0.0))
                 s.tokens.append(p["first_token"])
                 s.token_times.append(t_now)
                 s.prefilled = None  # free the host slab
@@ -434,23 +615,36 @@ class RaggedDecoder:
             prompts = np.zeros((f, pb), np.int32)
             lens = np.ones((f,), np.int32)
             slots_arr = np.full((f,), f + 1024, np.int32)  # OOB: dropped
+            seeds = np.zeros((f,), np.uint32)
+            temps = np.zeros((f,), np.float32)
+            topps = np.ones((f,), np.float32)
             for i, (slot, s) in enumerate(entries):
                 n = len(s.prompt)
                 prompts[i, :n] = s.prompt  # right-pad
                 lens[i] = n
                 slots_arr[i] = slot
-            self.cache, self.cur_tok, toks0 = _prefill_batch_into_slots(
+                seeds[i] = s.seed
+                temps[i] = s.temperature
+                topps[i] = s.top_p
+            (self.cache, self.cur_tok, toks0,
+             logp0) = _prefill_batch_into_slots(
                 self.params, jnp.asarray(prompts), jnp.asarray(lens),
-                jnp.asarray(slots_arr), self.cache, self.cur_tok,
-                self.cfg)
+                jnp.asarray(slots_arr), jnp.asarray(seeds),
+                jnp.asarray(temps), jnp.asarray(topps),
+                self.cache, self.cur_tok, self.cfg)
             # NO host sync here: first tokens ride the next chunk's
             # single device_get (a per-admission sync costs a full
             # dispatch round-trip over the tunnel)
             for i, (slot, s) in enumerate(entries):
-                self._pending_first.append((s, toks0[i]))
+                self._pending_first.append((s, toks0[i], logp0[i]))
                 self.slot_stream[slot] = s
             if self.prefix_cache is not None:
                 self._insert_prefixes(entries)
+
+    def _set_lane(self, slot: int, s: _Stream) -> None:
+        self._slot_seed[slot] = s.seed
+        self._slot_temp[slot] = s.temperature
+        self._slot_topp[slot] = s.top_p
 
     def _admit_warm(self, slot: int, s: _Stream) -> bool:
         """Try the prefix-cache warm path for one stream: adopt the
@@ -483,13 +677,14 @@ class RaggedDecoder:
         pad_v[:, :n_pref] = entry["v"][:, :n_pref]
         suf = np.zeros((sb,), np.int32)
         suf[:len(suffix)] = suffix
-        self.cache, self.cur_tok, tok0 = _prefill_suffix_into_slot(
+        self.cache, self.cur_tok, tok0, logp0 = _prefill_suffix_into_slot(
             self.params, jnp.asarray(pad_k, self.cfg.compute_dtype),
             jnp.asarray(pad_v, self.cfg.compute_dtype),
             np.int32(n_pref), jnp.asarray(suf),
-            np.int32(len(suffix)), np.int32(slot),
-            self.cache, self.cur_tok, self.cfg)
-        self._pending_first.append((s, tok0))
+            np.int32(len(suffix)), np.uint32(s.seed),
+            np.float32(s.temperature), np.float32(s.top_p),
+            np.int32(slot), self.cache, self.cur_tok, self.cfg)
+        self._pending_first.append((s, tok0, logp0))
         self.slot_stream[slot] = s
         pc.record_outcome(True)  # cached rows actually served
         return True
@@ -516,22 +711,39 @@ class RaggedDecoder:
         ~10-20ms/round-trip) any per-slot scalar read here would cost
         more than the chunk's compute."""
         self._admit()
+        self.pumps += 1
         active_mask = np.array(
             [st is not None for st in self.slot_stream])
         if not active_mask.any():
             return 0
-        toks, self.cache, self.cur_tok = decode_chunk(
-            self.params, self.cache, self.cur_tok,
-            active_mask, self.cfg, self.chunk)
+        if self._sampling_seen:
+            toks, lps, self.cache, self.cur_tok = decode_chunk_sampled(
+                self.params, self.cache, self.cur_tok, active_mask,
+                jnp.asarray(self._slot_seed),
+                jnp.asarray(self._slot_temp),
+                jnp.asarray(self._slot_topp), self.cfg, self.chunk)
+        else:
+            # greedy-only engine: the legacy argmax kernel — no
+            # per-token argsort/softmax; logprobs placeholder 0.0
+            toks, self.cache, self.cur_tok = decode_chunk(
+                self.params, self.cache, self.cur_tok, active_mask,
+                self.cfg, self.chunk)
+            lps = None
         if self.chunk_delay_s:
             time.sleep(self.chunk_delay_s)  # see __init__: emulated
             # device dispatch latency (GIL released; replicas overlap)
         firsts, self._pending_first = self._pending_first, []
-        toks, pos_np, first_toks = jax.device_get(
-            (toks, self.cache["pos"], [t for _, t in firsts]))
+        toks, lps, pos_np, first_toks, first_lps = jax.device_get(
+            (toks, lps, self.cache["pos"],
+             [t for _, t, _ in firsts], [lp for _, _, lp in firsts]))
+        if lps is None:
+            lps = np.zeros((self.slots, self.chunk), np.float32)
         t_now = time.perf_counter()
         delivered = 0
-        for (s, _), t0 in zip(firsts, first_toks):
+        for (s, _, _), t0, lp0 in zip(firsts, first_toks, first_lps):
+            # logprob first, token second: take_tokens slices both lists
+            # by len(tokens), so the parallel list must never lag it
+            s.logprobs.append(float(lp0))
             s.tokens.append(int(t0))
             s.token_times.append(t_now)
             delivered += 1
@@ -539,6 +751,7 @@ class RaggedDecoder:
             if s is None:
                 continue
             take = min(self.chunk, s.max_new - len(s.tokens))
+            s.logprobs.extend(float(p) for p in lps[slot, :take])
             s.tokens.extend(int(t) for t in toks[slot, :take])
             s.token_times.extend([t_now] * take)
             delivered += take
@@ -549,6 +762,20 @@ class RaggedDecoder:
                 self.slot_stream[slot] = None  # slot freed THIS chunk
         self._account(t_now, delivered)
         return int(active_mask.sum())
+
+    def set_params(self, params, version: int) -> None:
+        """Adopt published weights at a chunk boundary (call ONLY from
+        the pump owner's thread, between pump()s). The prefix cache is
+        dropped wholesale: its KV rows were computed under the old
+        weights and would poison warm admissions. In-flight streams
+        keep their already-computed KV (their continuation mixes
+        versions inside the bounded staleness window — their recorded
+        per-token logprobs stay exact regardless, which is what the RL
+        importance correction consumes)."""
+        self.params = params
+        self.weights_version = int(version)
+        if self.prefix_cache is not None:
+            self.prefix_cache.clear()
 
     RATE_WINDOW_S = 5.0
     METRICS_PERIOD_S = 1.0
@@ -586,6 +813,8 @@ class RaggedDecoder:
             "queued": len(self.queue),
             "tokens_per_sec": round(self.tokens_per_sec(), 1),
             "total_tokens": self._total_tokens,
+            "weights_version": self.weights_version,
+            "pumps": self.pumps,
         }
         if self.prefix_cache is not None:
             out["prefix_cache"] = self.prefix_cache.stats()

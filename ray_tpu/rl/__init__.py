@@ -22,6 +22,11 @@ from ray_tpu.rl.multi_agent import (  # noqa: F401
     SharedPolicyWrapper,
 )
 from ray_tpu.rl.vtrace import vtrace  # noqa: F401
+from ray_tpu.rl.experience import ExperienceBuffer  # noqa: F401
+from ray_tpu.rl.actor_learner import (  # noqa: F401
+    ActorLearnerConfig,
+    ActorLearnerLoop,
+)
 from ray_tpu.rl.sac import SAC, SACConfig, SACLearner  # noqa: F401
 from ray_tpu.rl.connectors import (  # noqa: F401
     ClipAction,

@@ -85,7 +85,7 @@ class LLMServer:
                  prompt_buckets: tuple = (32, 64, 128, 256),
                  params_blob=None, prefix_cache_block: int = 0,
                  prefix_cache_mb: int = 256, engine_name: str = "",
-                 chunk_delay_s: float = 0.0):
+                 chunk_delay_s: float = 0.0, weights_version: int = 0):
         import os
 
         import jax
@@ -111,7 +111,12 @@ class LLMServer:
             params, cfg, slots=slots, max_len=max_len,
             chunk_tokens=chunk_tokens, prompt_buckets=prompt_buckets,
             prefix_cache=prefix_cache, chunk_delay_s=chunk_delay_s,
-            name=engine_name or f"llm-{os.getpid()}")
+            name=engine_name or f"llm-{os.getpid()}",
+            weights_version=weights_version)
+        # (host params tree, version) staged by update_weights(); the
+        # pump thread adopts it at the next chunk boundary — engine
+        # params are touched only by the pump owner
+        self._pending_weights: tuple | None = None
         self._lock = threading.Lock()
         self._done_events: dict[int, threading.Event] = {}
         # sids being consumed via poll_stream: the pump must NOT purge
@@ -132,8 +137,26 @@ class LLMServer:
         # device work, so submissions land during the chunk wait
         import logging
 
+        from ray_tpu._private import fault_injection as _fi
+
         while not self._stop:
             try:
+                # chaos site: replica death / stall mid-decode (ctx
+                # carries the engine name so a plan can pin ONE replica)
+                _fi.fire("serve.replica_pump", engine=self.engine.name)
+                pending = None
+                with self._lock:
+                    pending, self._pending_weights = (
+                        self._pending_weights, None)
+                if pending is not None:
+                    import jax.numpy as jnp
+
+                    import jax as _jax
+
+                    tree, version = pending
+                    self.engine.set_params(
+                        _jax.tree_util.tree_map(jnp.asarray, tree),
+                        version)
                 busy = self.engine.pump()
             except Exception:  # noqa: BLE001 — the pump must survive:
                 # a dead pump thread bricks the replica for every
@@ -192,17 +215,25 @@ class LLMServer:
             "tokens": s.tokens[:max_tokens],
             "submitted_s": s.submitted,
             "token_times_s": s.token_times[:max_tokens],
+            "logprobs": s.logprobs[:max_tokens],
+            "weights_version": s.version,
         }
 
-    def generate(self, prompt_ids: list, max_tokens: int = 64) -> dict:
+    def generate(self, prompt_ids: list, max_tokens: int = 64, *,
+                 temperature: float = 0.0, top_p: float = 1.0,
+                 seed: int = 0) -> dict:
         """Blocking single-request API (one handler thread per call;
         all calls share the slot batch)."""
         sid, ev = self._submit_locked(
-            lambda: self.engine.submit(list(prompt_ids), int(max_tokens)))
+            lambda: self.engine.submit(
+                list(prompt_ids), int(max_tokens),
+                temperature=temperature, top_p=top_p, seed=seed))
         return self._wait_result(sid, ev, int(max_tokens))
 
     def adopt_prefilled(self, kv: dict, prompt_ids: list,
-                        max_tokens: int = 64) -> dict:
+                        max_tokens: int = 64, *,
+                        temperature: float = 0.0, top_p: float = 1.0,
+                        seed: int = 0) -> dict:
         """Blocking generate for a stream prefilled ELSEWHERE: `kv` is
         the prefill worker's payload (decode_engine.prefill_kv rows +
         first token), typically passed as an ObjectRef so the KV rows
@@ -211,29 +242,42 @@ class LLMServer:
         the pool."""
         sid, ev = self._submit_locked(
             lambda: self.engine.submit_prefilled(
-                list(prompt_ids), int(max_tokens), kv))
+                list(prompt_ids), int(max_tokens), kv,
+                temperature=temperature, top_p=top_p, seed=seed))
         return self._wait_result(sid, ev, int(max_tokens))
 
     # -- streaming API --
 
+    @staticmethod
+    def _sampling(req: dict) -> dict:
+        return {"temperature": float(req.get("temperature", 0.0)),
+                "top_p": float(req.get("top_p", 1.0)),
+                "seed": int(req.get("seed", 0))}
+
     def submit_stream(self, req: dict) -> dict:
         """Start a stream; poll_stream drains it incrementally. `req`
-        may carry a prefilled KV payload under "kv"."""
+        may carry a prefilled KV payload under "kv" and sampling knobs
+        under "temperature"/"top_p"/"seed"."""
         prompt_ids = list(req["prompt_ids"])
         max_tokens = int(req.get("max_tokens", 64))
+        sampling = self._sampling(req)
         with self._lock:
             if self._draining:
                 raise RuntimeError("replica draining: not admitting")
             if req.get("kv") is not None:
                 sid = self.engine.submit_prefilled(
-                    prompt_ids, max_tokens, req["kv"])
+                    prompt_ids, max_tokens, req["kv"], **sampling)
             else:
-                sid = self.engine.submit(prompt_ids, max_tokens)
+                sid = self.engine.submit(prompt_ids, max_tokens,
+                                         **sampling)
             self._stream_sids[sid] = time.monotonic()
         return {"sid": sid}
 
     def submit_stream_prefilled(self, kv: dict, prompt_ids: list,
-                                max_tokens: int = 64) -> dict:
+                                max_tokens: int = 64, *,
+                                temperature: float = 0.0,
+                                top_p: float = 1.0,
+                                seed: int = 0) -> dict:
         """submit_stream for an externally-prefilled stream. `kv` is a
         dedicated TOP-LEVEL argument (not nested in a request dict) so
         an ObjectRef passed here is resolved by the executor's arg
@@ -243,22 +287,50 @@ class LLMServer:
             if self._draining:
                 raise RuntimeError("replica draining: not admitting")
             sid = self.engine.submit_prefilled(
-                list(prompt_ids), int(max_tokens), kv)
+                list(prompt_ids), int(max_tokens), kv,
+                temperature=temperature, top_p=top_p, seed=seed)
             self._stream_sids[sid] = time.monotonic()
         return {"sid": sid}
 
     def poll_stream(self, sid: int) -> dict:
-        """New tokens since the last poll + done flag. The final poll
-        (done=True) releases the stream."""
+        """New tokens (+ parallel behavior logprobs) since the last
+        poll, plus a done flag. The final poll (done=True) releases the
+        stream."""
         sid = int(sid)
         with self._lock:
             if sid not in self._stream_sids:
-                return {"tokens": [], "done": True}
+                return {"tokens": [], "logprobs": [], "done": True,
+                        "version": None}
             self._stream_sids[sid] = time.monotonic()
-            new, done = self.engine.take_tokens(sid)
+            # read BEFORE take_tokens: the final (fully-drained) take
+            # purges the stream and with it the version record
+            version = self.engine.stream_version(sid)
+            new, lps, done = self.engine.take_tokens(
+                sid, with_logprobs=True)
             if done:
                 self._stream_sids.pop(sid, None)
-        return {"tokens": new, "done": done}
+        return {"tokens": new, "logprobs": lps, "done": done,
+                "version": version}
+
+    # -- weight publishing (actor-learner loop) --
+
+    def update_weights(self, params_blob, version: int) -> int:
+        """Adopt a published weight tree. ``params_blob`` is normally an
+        ObjectRef passed TOP-LEVEL by the pool, so the host tree arrives
+        via the multi-source pipelined pull before this method runs. The
+        swap itself happens on the pump thread at the next chunk
+        boundary — the bounded staleness window is one engine chunk —
+        so this returns as soon as the tree is staged."""
+        import ray_tpu
+
+        if isinstance(params_blob, ray_tpu.ObjectRef):
+            params_blob = ray_tpu.get(params_blob, timeout=600)
+        with self._lock:
+            self._pending_weights = (params_blob, int(version))
+        return int(version)
+
+    def weights_version(self) -> int:
+        return self.engine.weights_version
 
     def __call__(self, req: dict) -> dict:
         """HTTP entrypoint (serve http_proxy: POST body -> __call__):

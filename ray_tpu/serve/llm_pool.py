@@ -54,7 +54,7 @@ class PrefillWorker:
     def __init__(self, model_size: str = "tiny", *, max_len: int = 512,
                  vocab_size: int = 32128, seed: int = 0,
                  prompt_buckets: tuple = (32, 64, 128, 256),
-                 params_blob=None):
+                 params_blob=None, name: str = ""):
         import os
 
         import jax
@@ -66,16 +66,24 @@ class PrefillWorker:
             seed=seed, params_blob=params_blob)
         self.max_len = max_len
         self.buckets = tuple(sorted(prompt_buckets))
+        self.name = name or f"prefill-{os.getpid()}"
+        self._version = 0
 
-    def prefill(self, prompt_ids: list) -> dict:
-        """-> {"k", "v", "first_token", "true_len"} — the payload
-        `RaggedDecoder.submit_prefilled` adopts."""
+    def prefill(self, prompt_ids: list, *, temperature: float = 0.0,
+                top_p: float = 1.0, seed: int = 0) -> dict:
+        """-> {"k", "v", "first_token", "first_logprob", "true_len",
+        "version"} — the payload `RaggedDecoder.submit_prefilled`
+        adopts. The first token rides the stream's (seed, position)
+        sampling lane, identical to an inline prefill."""
         import jax
         import jax.numpy as jnp
         import numpy as np
 
-        from ray_tpu.models.decode_engine import prefill_kv
+        from ray_tpu._private import fault_injection as _fi
+        from ray_tpu.models.decode_engine import prefill_kv_sampled
 
+        # chaos site: prefill-worker death / stall mid-prefill
+        _fi.fire("serve.prefill", worker=self.name)
         prompt = np.asarray(prompt_ids, np.int32)
         bucket = next((b for b in self.buckets if len(prompt) <= b), None)
         if bucket is None:
@@ -84,13 +92,32 @@ class PrefillWorker:
                 f"bucket {self.buckets[-1]}")
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :len(prompt)] = prompt
-        k, v, toks0 = prefill_kv(
+        k, v, toks0, logp0 = prefill_kv_sampled(
             self.params, jnp.asarray(padded),
-            jnp.asarray([len(prompt)], jnp.int32), self.cfg,
+            jnp.asarray([len(prompt)], jnp.int32),
+            jnp.asarray([int(seed) & 0xFFFFFFFF], jnp.uint32),
+            jnp.asarray([float(temperature)], jnp.float32),
+            jnp.asarray([float(top_p)], jnp.float32), self.cfg,
             self.max_len)
-        k, v, tok0 = jax.device_get((k[:, 0], v[:, 0], toks0[0]))
+        k, v, tok0, lp0 = jax.device_get(
+            (k[:, 0], v[:, 0], toks0[0], logp0[0]))
         return {"k": k, "v": v, "first_token": int(tok0),
-                "true_len": len(prompt)}
+                "first_logprob": float(lp0), "true_len": len(prompt),
+                "version": self._version}
+
+    def update_weights(self, params_blob, version: int) -> int:
+        """Adopt a published weight tree (ObjectRef passed top-level by
+        the pool resolves before this runs — multi-source pull)."""
+        import jax
+        import jax.numpy as jnp
+
+        import ray_tpu
+
+        if isinstance(params_blob, ray_tpu.ObjectRef):
+            params_blob = ray_tpu.get(params_blob, timeout=600)
+        self.params = jax.tree_util.tree_map(jnp.asarray, params_blob)
+        self._version = int(version)
+        return self._version
 
     def health(self) -> bool:
         return True
@@ -204,6 +231,10 @@ class LLMPool:
         self._next_rid = 0
         self._last_scale_up = 0.0
         self._stop = False
+        # weight-publishing state: version 0 = the construction-time
+        # build; publish_weights bumps it and rebroadcasts
+        self._weights_version = 0
+        self._next_seed = 0
 
         for _ in range(self.min_replicas):
             self._replicas.append(self._spawn_replica())
@@ -216,8 +247,9 @@ class LLMPool:
                 _PrefillActor.remote(
                     **self._model_kwargs,
                     prompt_buckets=tuple(prompt_buckets),
-                    params_blob=self._params_ref)
-                for _ in range(prefill_workers)
+                    params_blob=self._params_ref,
+                    name=f"prefill-{i + 1}")
+                for i in range(prefill_workers)
             ]
             ray_tpu.get([p.health.remote() for p in self._prefill],
                         timeout=600)
@@ -235,10 +267,16 @@ class LLMPool:
     def _spawn_replica(self) -> _Replica:
         self._n_spawned += 1
         name = f"decode-{self._n_spawned}"
+        # late spawns adopt the LATEST published ref + version; read
+        # the pair under the lock — torn against a concurrent publish,
+        # a replica could be built on the OLD tree while REPORTING the
+        # new version, making wait_version's adoption signal lie
+        with self._lock:
+            ref, version = self._params_ref, self._weights_version
         h = _DecodeReplica.options(
             max_concurrency=self._max_inflight + 8,
-        ).remote(**self._replica_kwargs, params_blob=self._params_ref,
-                 engine_name=name)
+        ).remote(**self._replica_kwargs, params_blob=ref,
+                 engine_name=name, weights_version=version)
         return _Replica(h, name)
 
     def _mark_dead(self, rep: _Replica):
@@ -313,7 +351,24 @@ class LLMPool:
 
     # ---------- request paths ----------
 
-    def _maybe_prefill(self, prompt_ids: list):
+    def _assign_seed(self, temperature: float, seed) -> int:
+        """Per-request seed: the caller's if given, else a pool-assigned
+        deterministic lane (greedy requests keep seed 0 — it is dead).
+        The pool remembers the seed for the request's whole lifetime so
+        a failover re-submit replays the SAME lane — that, plus the
+        engine's (seed, position) RNG scheme, is what keeps
+        replica-death dedup bit-exact under sampling."""
+        if seed is not None:
+            return int(seed)
+        if temperature <= 0.0:
+            return 0
+        with self._lock:
+            self._next_seed += 1
+            n = self._next_seed
+        return (n * 0x9E3779B9) & 0x7FFFFFFF
+
+    def _maybe_prefill(self, prompt_ids: list, sampling: dict | None
+                       = None):
         """Route long prompts to the prefill pool; returns an
         ObjectRef of the KV payload, or None for inline prefill."""
         if (not self._prefill or self.prefill_threshold is None
@@ -326,15 +381,37 @@ class LLMPool:
             # NOT resolved here: the ref flows straight into the decode
             # replica's adopt call, so the KV rows move prefill-node ->
             # decode-node through the object store, never via the pool
-            return pw.prefill.remote(list(prompt_ids))
+            return pw.prefill.remote(list(prompt_ids),
+                                     **(sampling or {}))
         except Exception:  # noqa: BLE001 — prefill pool degraded:
             return None  # decode replicas prefill inline instead
 
-    def generate(self, prompt_ids: list, max_tokens: int = 64) -> dict:
+    def _replica_alive(self, rep: _Replica) -> bool:
+        """Cross-check before blaming a replica for a RayActorError: a
+        dead PREFILL worker's error surfaces through the decode
+        replica's adopt call (the KV ref resolves executor-side), and
+        marking the healthy decode replica dead for it would shrink the
+        pool for nothing. Only actor DEATH counts — a probe timeout on
+        a busy replica is slow ≠ dead (same rule as _reap_dead), since
+        a false 'dead' here permanently shrinks a non-autoscaling pool."""
+        try:
+            return bool(ray_tpu.get(rep.handle.health.remote(),
+                                    timeout=10))
+        except ray_tpu.RayActorError:
+            return False
+        except Exception:  # noqa: BLE001 — slow ≠ dead
+            return True
+
+    def generate(self, prompt_ids: list, max_tokens: int = 64, *,
+                 temperature: float = 0.0, top_p: float = 1.0,
+                 seed: int | None = None) -> dict:
         """Blocking generate with transparent replica failover."""
         prompt_ids = list(prompt_ids)
         max_tokens = int(max_tokens)
-        kv_ref = self._maybe_prefill(prompt_ids)
+        sampling = {"temperature": float(temperature),
+                    "top_p": float(top_p),
+                    "seed": self._assign_seed(float(temperature), seed)}
+        kv_ref = self._maybe_prefill(prompt_ids, sampling)
         last_err: Exception | None = None
         t_enqueue = time.monotonic()
         for _ in range(self.max_replicas + 2):
@@ -343,22 +420,30 @@ class LLMPool:
             try:
                 if kv_ref is not None:
                     ref = rep.handle.adopt_prefilled.remote(
-                        kv_ref, prompt_ids, max_tokens)
+                        kv_ref, prompt_ids, max_tokens, **sampling)
                 else:
                     ref = rep.handle.generate.remote(
-                        prompt_ids, max_tokens)
+                        prompt_ids, max_tokens, **sampling)
                 out = ray_tpu.get(ref, timeout=600)
                 self._record_ttft(out, queue_wait)
                 return out
             except ray_tpu.RayActorError as e:
+                last_err = e
+                if kv_ref is not None and self._replica_alive(rep):
+                    # the PREFILL worker died, not this replica —
+                    # re-routing to the prefill pool could land on the
+                    # same corpse (dead workers are not reaped), so
+                    # fall back to inline prefill on the healthy
+                    # decode replicas instead
+                    kv_ref = None
+                    continue
                 # replica died mid-request: re-queue to a survivor —
                 # the client never sees this (chaos-test contract)
-                last_err = e
                 self._mark_dead(rep)
                 if kv_ref is not None:
                     # the KV payload may have died with the replica's
                     # node — recompute rather than depend on lineage
-                    kv_ref = self._maybe_prefill(prompt_ids)
+                    kv_ref = self._maybe_prefill(prompt_ids, sampling)
                 continue
             finally:
                 self._release(rep)
@@ -366,8 +451,11 @@ class LLMPool:
             f"request failed over too many dead replicas: {last_err}")
 
     def __call__(self, req: dict) -> dict:
-        return self.generate(list(req["prompt_ids"]),
-                             int(req.get("max_tokens", 64)))
+        return self.generate(
+            list(req["prompt_ids"]), int(req.get("max_tokens", 64)),
+            temperature=float(req.get("temperature", 0.0)),
+            top_p=float(req.get("top_p", 1.0)),
+            seed=req.get("seed"))
 
     # ---------- streaming ----------
 
@@ -388,26 +476,33 @@ class LLMPool:
         self._sweep_streams()
         prompt_ids = list(req["prompt_ids"])
         max_tokens = int(req.get("max_tokens", 64))
+        temperature = float(req.get("temperature", 0.0))
+        sampling = {"temperature": temperature,
+                    "top_p": float(req.get("top_p", 1.0)),
+                    "seed": self._assign_seed(temperature,
+                                              req.get("seed"))}
         with self._lock:
             self._next_rid += 1
             rid = f"s{self._next_rid}"
         rec = {"prompt_ids": prompt_ids, "max_tokens": max_tokens,
                "emitted": 0, "rep": None, "sid": None, "done": False,
-               "last_poll": time.monotonic(),
-               "kv_ref": self._maybe_prefill(prompt_ids)}
+               "last_poll": time.monotonic(), "sampling": sampling,
+               "version": self._weights_version,
+               "kv_ref": self._maybe_prefill(prompt_ids, sampling)}
         self._streams[rid] = rec
         try:
             self._assign_stream(rec)
         except BaseException:
             self._streams.pop(rid, None)
             raise
-        return {"rid": rid}
+        return {"rid": rid, "seed": sampling["seed"],
+                "weights_version": rec["version"]}
 
     def _assign_stream(self, rec: dict):
         rep = self._acquire()
         try:
             body = {"prompt_ids": rec["prompt_ids"],
-                    "max_tokens": rec["max_tokens"]}
+                    "max_tokens": rec["max_tokens"], **rec["sampling"]}
             sid = None
             if rec["kv_ref"] is not None and rec["emitted"] == 0:
                 # adopt path only for a fresh stream (KV as a TOP-LEVEL
@@ -417,16 +512,31 @@ class LLMPool:
                     sid = ray_tpu.get(
                         rep.handle.submit_stream_prefilled.remote(
                             rec["kv_ref"], rec["prompt_ids"],
-                            rec["max_tokens"]),
+                            rec["max_tokens"], **rec["sampling"]),
                         timeout=600)["sid"]
                 except ray_tpu.RayActorError:
-                    raise
+                    if self._replica_alive(rep):
+                        # the prefill WORKER died, not this replica:
+                        # prefill inline here instead
+                        rec["kv_ref"] = None
+                        sid = None
+                    else:
+                        self._mark_dead(rep)
+                        raise
                 except Exception:  # noqa: BLE001 — KV ref unusable:
                     sid = None  # fall through to inline prefill
             if sid is None:
                 sid = ray_tpu.get(rep.handle.submit_stream.remote(body),
                                   timeout=600)["sid"]
             rec["rep"], rec["sid"] = rep, sid
+        except ray_tpu.RayActorError:
+            # a replica that died with NO call in flight is only ever
+            # discovered on the next request — take it out of rotation
+            # so retries land on survivors (and the autoscaler's reap +
+            # respawn path sees the true live count)
+            self._mark_dead(rep)
+            self._release(rep)
+            raise
         except BaseException:
             self._release(rep)
             raise
@@ -435,7 +545,7 @@ class LLMPool:
         rec = self._streams.get(rid)
         if rec is None or rec["done"]:
             self._streams.pop(rid, None)
-            return {"tokens": [], "done": True}
+            return {"tokens": [], "logprobs": [], "done": True}
         rec["last_poll"] = time.monotonic()
         if rec["rep"] is None:
             # an earlier failover found no survivor yet: keep retrying
@@ -444,37 +554,149 @@ class LLMPool:
             try:
                 self._assign_stream(rec)
             except Exception:  # noqa: BLE001
-                return {"tokens": [], "done": False}
+                return {"tokens": [], "logprobs": [], "done": False,
+                        "weights_version": rec["version"]}
         rep = rec["rep"]
         try:
             out = ray_tpu.get(rep.handle.poll_stream.remote(rec["sid"]),
                               timeout=120)
         except ray_tpu.RayActorError:
             # mid-stream death: re-queue onto a survivor and skip the
-            # tokens the client already has (greedy == deterministic)
+            # tokens the client already has — exact because the
+            # replacement replays the same (seed, position) RNG lanes
+            # against the same weight version. If weights were
+            # republished since this stream started AND tokens are
+            # already out, a replay would re-sample a DIFFERENT
+            # continuation under the new version; splicing that onto
+            # the emitted prefix would hand the client (and the RL
+            # experience path) a sequence no single policy produced —
+            # so the stream closes cleanly at the emitted prefix
+            # instead (a shorter but internally consistent trajectory).
             self._mark_dead(rep)
             self._release(rep)
             rec["rep"] = rec["sid"] = None
+            if rec["emitted"] > 0 \
+                    and rec["version"] != self._weights_version:
+                rec["done"] = True
+                self._streams.pop(rid, None)
+                return {"tokens": [], "logprobs": [], "done": True,
+                        "truncated": True,
+                        "weights_version": rec["version"]}
             rec["replayed"] = 0  # replacement stream replays from 0
+            if rec["emitted"] == 0:
+                # nothing delivered: free to restart under the current
+                # version (the trajectory is whatever the retry yields)
+                rec["version"] = self._weights_version
             try:
                 self._assign_stream(rec)
             except Exception:  # noqa: BLE001 — retried next poll
                 pass
-            return {"tokens": [], "done": False}
+            return {"tokens": [], "logprobs": [], "done": False,
+                    "weights_version": rec["version"]}
+        # pin the stream's version to the ENGINE version its tokens are
+        # actually generated under: a stream submitted inside the
+        # publish-to-adoption window carries the pool's NEW publish
+        # stamp while a lagging replica still decodes it under the old
+        # weights — the failover splice guard must compare generating
+        # versions, or that window replays across two policies
+        v_eng = out.get("version")
+        if v_eng is not None and rec["emitted"] == 0:
+            rec["version"] = v_eng
         new = out["tokens"]
+        lps = out.get("logprobs", [])
         skip = 0
         # after failover the replacement stream replays from token 0
         if rec.get("replayed", 0) < rec["emitted"]:
             skip = min(len(new), rec["emitted"] - rec.get("replayed", 0))
             rec["replayed"] = rec.get("replayed", 0) + skip
         fresh = new[skip:]
+        fresh_lps = lps[skip:] if lps else []
         rec["emitted"] += len(fresh)
         rec["replayed"] = rec.get("replayed", 0) + len(fresh)
         if out["done"]:
             rec["done"] = True
             self._release(rep)
             self._streams.pop(rid, None)
-        return {"tokens": fresh, "done": out["done"]}
+        return {"tokens": fresh, "logprobs": fresh_lps,
+                "done": out["done"],
+                "weights_version": rec["version"]}
+
+    # ---------- weight publishing (actor-learner loop) ----------
+
+    def publish_weights(self, params, version: int | None = None,
+                        timeout: float = 120.0) -> int:
+        """ONE-put weight broadcast: ``params`` is a host tree (put once
+        here) or an already-put ObjectRef (e.g. from a learner rank);
+        every decode replica and prefill worker adopts the SAME ref via
+        the multi-source pipelined pull. Replicas swap at their next
+        chunk boundary — the bounded staleness window — and new
+        replicas spawned later adopt this ref at construction. Returns
+        the published version."""
+        if not isinstance(params, ray_tpu.ObjectRef):
+            params = ray_tpu.put(params)
+        with self._lock:
+            version = int(version) if version is not None \
+                else self._weights_version + 1
+            self._weights_version = version
+            self._params_ref = params
+            reps = [r for r in self._replicas if not r.dead]
+            pws = list(self._prefill)
+        # fire ALL updates first, gather after: members pull the tree
+        # concurrently (multi-source), so the staleness window stays
+        # ~one pull, not pool-size x one pull
+        rep_refs = []
+        for r in reps:
+            try:
+                rep_refs.append(
+                    (r, r.handle.update_weights.remote(params, version)))
+            except Exception:  # noqa: BLE001
+                rep_refs.append((r, None))
+        pw_refs = []
+        for p in pws:
+            try:
+                pw_refs.append(p.update_weights.remote(params, version))
+            except Exception:  # noqa: BLE001
+                pass
+        for r, ref in rep_refs:
+            try:
+                if ref is not None:
+                    ray_tpu.get(ref, timeout=timeout)
+            except ray_tpu.RayActorError:
+                self._mark_dead(r)  # discovered dead on the broadcast
+            except Exception:  # noqa: BLE001 — a dying member misses
+                pass  # this version; failover/respawn re-adopts latest
+        for ref in pw_refs:
+            try:
+                ray_tpu.get(ref, timeout=timeout)
+            except Exception:  # noqa: BLE001
+                pass
+        return version
+
+    def wait_version(self, version: int, timeout: float = 60.0) -> bool:
+        """Block until every live replica's ENGINE reports >= version
+        (the pump actually swapped, not merely staged) — the
+        publish-to-adoption latency probe used by the staleness tests
+        and the rl bench family."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                reps = [r for r in self._replicas if not r.dead]
+            vs = []
+            ok = True
+            for r in reps:
+                try:
+                    vs.append(ray_tpu.get(
+                        r.handle.weights_version.remote(), timeout=10))
+                except ray_tpu.RayActorError:
+                    # a silently-dead replica must not make every
+                    # publish wait out the full adoption deadline
+                    self._mark_dead(r)
+                except Exception:  # noqa: BLE001 — churn: retry
+                    ok = False
+            if ok and vs and all(v >= version for v in vs):
+                return True
+            time.sleep(0.01)
+        return False
 
     # ---------- autoscaling ----------
 
@@ -484,7 +706,24 @@ class LLMPool:
             try:
                 self._autoscale_once()
             except Exception:  # noqa: BLE001
+                if not ray_tpu.is_initialized():
+                    return  # driver disconnected: the pool is history
                 logger.exception("llm_pool autoscale tick failed")
+
+    def _reap_dead(self):
+        """Health-probe the replica set: a replica that died with no
+        request in flight (chaos kill, OOM) is otherwise discovered
+        only when a request happens to land on it — the autoscale tick
+        probes so the pool heals back to min_replicas proactively."""
+        with self._lock:
+            reps = [r for r in self._replicas if not r.dead]
+        for r in reps:
+            try:
+                ray_tpu.get(r.handle.health.remote(), timeout=10)
+            except ray_tpu.RayActorError:
+                self._mark_dead(r)
+            except Exception:  # noqa: BLE001 — slow ≠ dead
+                pass
 
     def _autoscale_once(self):
         from ray_tpu.autoscaler.demand_scheduler import (
@@ -492,6 +731,7 @@ class LLMPool:
         )
 
         self._sweep_streams()
+        self._reap_dead()
         with self._lock:
             n = len([r for r in self._replicas if not r.draining])
             waiting = self._waiting
@@ -529,6 +769,17 @@ class LLMPool:
             with self._cond:
                 self._replicas.extend(fresh)
                 self._cond.notify_all()
+                cur_ref, cur_v = self._params_ref, self._weights_version
+            # close the spawn/publish race: a publish that landed while
+            # these replicas were constructing missed them (they were
+            # not in _replicas yet) — re-send the latest ref; a replica
+            # already current ignores the no-op re-stage
+            if cur_v > 0:
+                for r in fresh:
+                    try:
+                        r.handle.update_weights.remote(cur_ref, cur_v)
+                    except Exception:  # noqa: BLE001
+                        pass
             self._last_scale_up = time.monotonic()
             logger.info("llm_pool: scaled up to %d replicas",
                         len(self._replicas))
@@ -595,6 +846,7 @@ class LLMPool:
             "ttft_p99_s": self.ttft_p99(),
             "prefill_workers": len(self._prefill),
             "prefix_cache_hit_rate": (hits / total) if total else None,
+            "weights_version": self._weights_version,
             "per_replica": per_replica,
         }
 

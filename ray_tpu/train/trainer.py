@@ -133,6 +133,12 @@ class RunConfig:
     max_failures: int = 0
     checkpoint_num_to_keep: int = 2
     max_inplace_resumes: int = 8
+    # driver-side callback invoked once per completed lockstep step with
+    # rank 0's metrics dict, BEFORE it enters metrics_history — a
+    # streaming consumer (e.g. the actor-learner loop publishing the
+    # weights ref a learner reported) may mutate/pop keys it consumes.
+    # Exceptions are logged, never fatal to training.
+    on_report: Callable[[dict], None] | None = None
 
 
 @dataclass
@@ -436,6 +442,13 @@ class JaxTrainer:
             while all(pending):
                 step_reports = [q.popleft() for q in pending]
                 metrics = step_reports[0]["metrics"]  # true rank 0
+                cb = self.run_config.on_report
+                if cb is not None:
+                    try:
+                        cb(metrics)
+                    except Exception:  # noqa: BLE001 — a consumer bug
+                        logger.exception(  # must not kill training
+                            "RunConfig.on_report callback failed")
                 history.append(metrics)
                 final = metrics
                 ckpt = next(

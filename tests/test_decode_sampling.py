@@ -1,0 +1,205 @@
+"""Sampled decoding: temperature/top-p lanes, seed replay, logprobs.
+
+The invariants the RL rollout path leans on (ISSUE 12 satellite):
+
+- temperature 0 through the sampled kernel is BIT-IDENTICAL to greedy
+  decode (the serving default cannot regress);
+- a stream's tokens are a pure function of (weights, prompt, seed) —
+  independent of slot index, batch composition, and which engine decodes
+  it (seed-replay: what makes replica-death failover dedup exact under
+  sampling);
+- per-token logprobs match teacher-forced `llama.forward` log-softmax
+  (what the learner computes its importance ratios against);
+- the disaggregated-prefill path samples the same first token as inline.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from ray_tpu.models import llama  # noqa: E402
+from ray_tpu.models.decode_engine import (  # noqa: E402
+    RaggedDecoder,
+    prefill_kv_sampled,
+)
+
+TINY = llama.LlamaConfig(
+    vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+    d_ff=128, max_seq_len=64, dtype="float32", remat=False)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(TINY, jax.random.PRNGKey(0))
+
+
+def _greedy(params, prompt, n, max_len=64):
+    return np.asarray(llama.greedy_generate(
+        params, jnp.asarray(np.asarray(prompt)[None]), TINY, n,
+        max_len=max_len))[0, len(prompt):]
+
+
+def _run_stream(params, prompt, n, *, temperature, seed, top_p=1.0,
+                extra_streams=0, slots=2, chunk=4, rng_seed=99):
+    """Decode one stream (optionally amid unrelated concurrent
+    streams) and return (tokens, logprobs)."""
+    eng = RaggedDecoder(params, TINY, slots=slots, max_len=64,
+                        chunk_tokens=chunk, prompt_buckets=(8, 16))
+    rng = np.random.RandomState(rng_seed)
+    others = [eng.submit(rng.randint(1, 250, 6).astype(np.int32), n,
+                         temperature=0.7, seed=int(rng.randint(2**31)))
+              for _ in range(extra_streams)]
+    sid = eng.submit(np.asarray(prompt, np.int32), n,
+                     temperature=temperature, top_p=top_p, seed=seed)
+    eng.drain()
+    s = eng.pop_finished(sid)
+    for o in others:
+        eng.purge(o)
+    return (np.asarray(s.tokens[:n]),
+            np.asarray(s.logprobs[:n], np.float32))
+
+
+def test_temperature_zero_is_bit_identical_to_greedy(params):
+    rng = np.random.RandomState(1)
+    prompt = rng.randint(1, 250, 7).astype(np.int32)
+    toks, lps = _run_stream(params, prompt, 12, temperature=0.0, seed=5)
+    np.testing.assert_array_equal(toks, _greedy(params, prompt, 12))
+    assert len(lps) == len(toks)
+    assert np.all(lps <= 0.0)
+
+
+def test_sampling_is_deterministic_and_seed_sensitive(params):
+    rng = np.random.RandomState(2)
+    prompt = rng.randint(1, 250, 7).astype(np.int32)
+    a = _run_stream(params, prompt, 10, temperature=1.0, seed=123)
+    b = _run_stream(params, prompt, 10, temperature=1.0, seed=123)
+    np.testing.assert_array_equal(a[0], b[0])
+    np.testing.assert_array_equal(a[1], b[1])
+    c = _run_stream(params, prompt, 10, temperature=1.0, seed=124)
+    assert not np.array_equal(a[0], c[0])
+    # and sampling at high temperature actually deviates from greedy
+    assert not np.array_equal(a[0], _greedy(params, prompt, 10))
+
+
+def test_seed_replay_independent_of_batch_composition(params):
+    """The failover contract: the SAME (prompt, seed) decoded alone on
+    one engine and amid 3 unrelated sampled streams on another yields
+    identical tokens AND logprobs — RNG lanes are (seed, position),
+    never slot- or batch-dependent."""
+    rng = np.random.RandomState(3)
+    prompt = rng.randint(1, 250, 7).astype(np.int32)
+    alone = _run_stream(params, prompt, 10, temperature=0.9, seed=777,
+                        slots=2, extra_streams=0)
+    crowded = _run_stream(params, prompt, 10, temperature=0.9, seed=777,
+                          slots=4, extra_streams=3, rng_seed=41)
+    np.testing.assert_array_equal(alone[0], crowded[0])
+    np.testing.assert_allclose(alone[1], crowded[1], atol=1e-5)
+
+
+def test_tiny_top_p_recovers_greedy(params):
+    """top_p small enough keeps only the top token — sampling must
+    reduce to greedy exactly (temperature rescaling preserves argmax)."""
+    rng = np.random.RandomState(4)
+    prompt = rng.randint(1, 250, 7).astype(np.int32)
+    toks, _ = _run_stream(params, prompt, 10, temperature=1.3,
+                          top_p=1e-6, seed=9)
+    np.testing.assert_array_equal(toks, _greedy(params, prompt, 10))
+
+
+def test_logprobs_match_teacher_forced_forward(params):
+    """Engine behavior logprobs == log_softmax of the full forward at
+    the sampled tokens (temperature 1, top_p 1): the exact consistency
+    the learner's importance ratio depends on."""
+    rng = np.random.RandomState(5)
+    prompt = rng.randint(1, 250, 8).astype(np.int32)
+    toks, lps = _run_stream(params, prompt, 8, temperature=1.0,
+                            seed=1234)
+    seq = np.concatenate([prompt, toks]).astype(np.int32)
+    logits = np.asarray(
+        llama.forward(params, jnp.asarray(seq[None]), TINY), np.float32)
+    ref = np.asarray([
+        jax.nn.log_softmax(jnp.asarray(logits[0, len(prompt) - 1 + t])
+                           )[toks[t]]
+        for t in range(len(toks))], np.float32)
+    np.testing.assert_allclose(lps, ref, atol=1e-4)
+
+
+def test_disaggregated_prefill_samples_same_first_token(params):
+    """prefill_kv_sampled on a 'prefill worker' must sample the SAME
+    first token/logprob as an inline sampled admission (same (seed,
+    true_len-1) lane), and the adopted stream continues identically."""
+    rng = np.random.RandomState(6)
+    prompt = rng.randint(1, 250, 7).astype(np.int32)
+    inline_toks, inline_lps = _run_stream(
+        params, prompt, 10, temperature=1.0, seed=4321)
+    padded = np.zeros((1, 8), np.int32)
+    padded[0, :len(prompt)] = prompt
+    k, v, tok0, lp0 = prefill_kv_sampled(
+        params, jnp.asarray(padded),
+        jnp.asarray([len(prompt)], jnp.int32),
+        jnp.asarray([4321], jnp.uint32), jnp.asarray([1.0], jnp.float32),
+        jnp.asarray([1.0], jnp.float32), TINY, 64)
+    assert int(tok0[0]) == int(inline_toks[0])
+    np.testing.assert_allclose(float(lp0[0]), inline_lps[0], atol=1e-5)
+    kv = {"k": np.asarray(k[:, 0]), "v": np.asarray(v[:, 0]),
+          "first_token": int(tok0[0]), "first_logprob": float(lp0[0]),
+          "true_len": len(prompt)}
+    eng = RaggedDecoder(params, TINY, slots=2, max_len=64,
+                        chunk_tokens=4, prompt_buckets=(8,))
+    sid = eng.submit_prefilled(prompt, 10, kv, temperature=1.0,
+                               seed=4321)
+    eng.drain()
+    s = eng.pop_finished(sid)
+    np.testing.assert_array_equal(np.asarray(s.tokens[:10]), inline_toks)
+    np.testing.assert_allclose(np.asarray(s.logprobs[:10], np.float32),
+                               inline_lps, atol=1e-5)
+
+
+def test_take_tokens_streams_logprobs_in_lockstep(params):
+    rng = np.random.RandomState(7)
+    prompt = rng.randint(1, 250, 6).astype(np.int32)
+    eng = RaggedDecoder(params, TINY, slots=2, max_len=64,
+                        chunk_tokens=4, prompt_buckets=(8,))
+    sid = eng.submit(prompt, 9, temperature=0.8, seed=55)
+    got_t, got_l, done = [], [], False
+    while not done:
+        eng.pump()
+        new, lps, done = eng.take_tokens(sid, with_logprobs=True)
+        assert len(new) == len(lps)
+        got_t.extend(new)
+        got_l.extend(lps)
+    ref_t, ref_l = _run_stream(params, prompt, 9, temperature=0.8,
+                               seed=55)
+    np.testing.assert_array_equal(np.asarray(got_t[:9]), ref_t)
+    np.testing.assert_allclose(np.asarray(got_l[:9], np.float32), ref_l,
+                               atol=1e-5)
+    # drained + finished → purged, with the 3-tuple shape
+    assert eng.take_tokens(sid, with_logprobs=True) == ([], [], True)
+    # legacy 2-tuple shape unchanged
+    assert eng.take_tokens(sid) == ([], True)
+
+
+def test_submit_validates_top_p(params):
+    eng = RaggedDecoder(params, TINY, slots=2, max_len=64,
+                        chunk_tokens=4, prompt_buckets=(8,))
+    with pytest.raises(ValueError):
+        eng.submit([1, 2, 3], 4, temperature=1.0, top_p=0.0)
+    with pytest.raises(ValueError):
+        eng.submit([1, 2, 3], 4, temperature=1.0, top_p=1.5)
+
+
+def test_stats_carry_version_and_pumps(params):
+    eng = RaggedDecoder(params, TINY, slots=2, max_len=64,
+                        chunk_tokens=4, prompt_buckets=(8,),
+                        weights_version=7)
+    st = eng.stats()
+    assert st["weights_version"] == 7
+    assert st["pumps"] == 0
+    eng.submit([1, 2, 3], 2)
+    eng.pump()
+    assert eng.stats()["pumps"] == 1
+    # set_params bumps the version and drops nothing else
+    eng.set_params(eng.params, 9)
+    assert eng.stats()["weights_version"] == 9
