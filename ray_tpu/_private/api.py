@@ -858,22 +858,38 @@ def timeline(filename: str | None = None) -> list:
     chrome://tracing or Perfetto; pid = node, tid = worker."""
     events = list_tasks()
     trace = []
-    # task_id -> its complete event, for joining flow arrows
+    # task_id -> its complete event, for joining flow arrows. Flight-
+    # recorder spans and user profile marks carry synthetic ids and are
+    # never flow parents.
     by_task = {ev["task_id"].hex(): ev for ev in events
-               if ev.get("state") != "PROFILE"}
+               if ev.get("state") not in ("PROFILE", "SPAN")}
     for ev in events:
+        is_span = ev.get("state") == "SPAN"
         args = {"state": ev.get("state"), "task_id": ev["task_id"].hex()}
+        if is_span:
+            # span attributes (byte counts, wait breakdowns, ...) land
+            # verbatim in the Perfetto args pane
+            args.update(ev.get("attrs") or {})
         tr = ev.get("trace") or {}
         if tr:
-            args["trace_id"] = tr.get("trace_id")
+            tid = tr.get("trace_id")
+            # hex so the dump is valid JSON (trace ids are bytes on
+            # the wire)
+            args["trace_id"] = tid.hex() if isinstance(tid, bytes) \
+                else tid
             if tr.get("parent"):
                 args["parent_span"] = tr["parent"]
-        trace.append({
-            "name": ev.get("name", "task"),
+        if is_span:
+            cat = ev.get("kind") or "span"
+        elif ev.get("state") == "PROFILE":
             # user spans (util/profiling.py profile()) land in their own
             # category so Perfetto can filter them
-            "cat": ("user_span" if ev.get("state") == "PROFILE"
-                    else "task"),
+            cat = "user_span"
+        else:
+            cat = "task"
+        trace.append({
+            "name": ev.get("name", "task"),
+            "cat": cat,
             "ph": "X",  # complete event
             "ts": ev["start_s"] * 1e6,
             "dur": max(0.0, (ev["end_s"] - ev["start_s"]) * 1e6),

@@ -22,6 +22,14 @@ Plans are split by fault locality:
 - ``driver_specs`` trip in the driver/agent process (heartbeat, object
   chunk, lease push — in-process node agents in the test cluster), where
   one `configure` covers the whole run.
+
+Postmortems: every injected ``die``/``exit`` dumps the victim's
+flight-recorder span ring to a bundle (`flight_recorder.dump_bundle`,
+wired in `fault_injection._fire_common`), and every collective abort
+dumps a survivor-side bundle (`collective.local_abort`), so a failing
+soak seed leaves the last N spans of both sides of the failure on disk
+next to its replay spec. `tests/test_chaos_soak.py` prints the bundle
+paths alongside the `RAY_TPU_FAULT_SPEC` replay line.
 """
 
 from __future__ import annotations

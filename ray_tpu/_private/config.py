@@ -155,6 +155,18 @@ _DEFS: dict[str, Any] = {
     # declared here so set_system_config propagates it to spawned
     # workers via the RAY_TPU_FAULT_SPEC env var
     "fault_spec": "",
+    # -- flight recorder (_private/flight_recorder.py) --
+    # per-process span ring capacity (the postmortem window)
+    "flight_recorder_ring_size": 4096,
+    # postmortem bundle directory; "" = <tempdir>/ray_tpu_flight.
+    # Propagated to spawned workers via env by set_system_config.
+    "flight_recorder_dir": "",
+    # background span-flush period (spans -> head task-event ring)
+    "flight_recorder_flush_s": 0.5,
+    # instrumentation kill switch — ONLY for the runtime_perf obs
+    # family's uninstrumented baseline (propagates to spawned workers);
+    # production always runs with it on
+    "flight_recorder_enabled": True,
 }
 
 _cache: dict[str, Any] = {}

@@ -249,6 +249,17 @@ def _fire_common(site: str, ctx: dict) -> tuple[str | None, float]:
     except Exception:  # noqa: BLE001 — accounting never blocks injection
         pass
     action = fired["action"]
+    if action in ("die", "exit"):
+        # the victim's black box: dump the span ring BEFORE dying —
+        # os._exit skips destructors, so this is the only chance
+        try:
+            from ray_tpu._private import flight_recorder as _fr
+
+            _fr.dump_bundle(f"fault:{site}:{action}",
+                            extra={"ctx": fired["ctx"],
+                                   "occurrence": fired["occurrence"]})
+        except Exception:  # noqa: BLE001 — never mask the injection
+            pass
     if action == "die":
         raise InjectedFault(site, fired["ctx"])
     if action == "exit":

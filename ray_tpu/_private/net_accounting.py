@@ -1,0 +1,108 @@
+"""Per-link byte attribution: who moved how many bytes to whom, and why.
+
+Every outbound transfer at the rpc/agent layer is tagged with
+``{peer, qos_class, owner}``:
+
+* ``peer`` — the remote endpoint label (node-id prefix, ``group:rank``
+  for ring chunks, or a role like ``prefill``),
+* ``qos_class`` — traffic class: ``collective`` (ring chunks), ``bulk``
+  (object pulls/serves), ``kv`` (prefill->decode KV handoffs),
+* ``owner`` — the tenant: the object's owner worker, the collective
+  group name, or the serving engine.
+
+Exported as ``net_tx_bytes_total`` / ``net_rx_bytes_total`` counters
+(the exact signal a contention-aware scheduler consumes) plus a
+per-peer ``net_inflight_bytes`` gauge. A process-local synchronous
+tally (:func:`local_totals`) backs tests that must compare attribution
+against wire accounting without waiting on metric flush periods.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ray_tpu.util.metrics import Counter, Gauge
+
+_tx = Counter(
+    "net_tx_bytes_total",
+    "Outbound transfer bytes by peer, traffic class, and owner.",
+    tag_keys=("peer", "qos_class", "owner"),
+)
+_rx = Counter(
+    "net_rx_bytes_total",
+    "Inbound transfer bytes by peer, traffic class, and owner.",
+    tag_keys=("peer", "qos_class", "owner"),
+)
+_inflight = Gauge(
+    "net_inflight_bytes",
+    "Outbound bytes currently buffered/in flight, per peer.",
+    tag_keys=("peer",),
+)
+
+_lock = threading.Lock()
+# (direction, peer, qos_class, owner) -> bytes
+_local: dict[tuple, int] = {}
+
+
+def _on() -> bool:
+    # shares the flight recorder's benchmark-baseline kill switch so the
+    # obs overhead floor measures ALL always-on instrumentation at once
+    from ray_tpu._private import flight_recorder as _fr
+
+    return _fr._on()
+
+
+def account_tx(peer: str, qos_class: str, owner: str, nbytes: int) -> None:
+    if nbytes <= 0 or not _on():
+        return
+    tags = {"peer": peer, "qos_class": qos_class, "owner": owner}
+    _tx.inc(nbytes, tags)
+    with _lock:
+        k = ("tx", peer, qos_class, owner)
+        _local[k] = _local.get(k, 0) + int(nbytes)
+
+
+def account_rx(peer: str, qos_class: str, owner: str, nbytes: int) -> None:
+    if nbytes <= 0 or not _on():
+        return
+    tags = {"peer": peer, "qos_class": qos_class, "owner": owner}
+    _rx.inc(nbytes, tags)
+    with _lock:
+        k = ("rx", peer, qos_class, owner)
+        _local[k] = _local.get(k, 0) + int(nbytes)
+
+
+def set_inflight(peer: str, nbytes: int) -> None:
+    _inflight.set(float(max(0, nbytes)), {"peer": peer})
+
+
+def local_totals(direction: str | None = None, *, peer: str | None = None,
+                 qos_class: str | None = None,
+                 owner: str | None = None) -> dict[tuple, int]:
+    """Filtered snapshot of this process's synchronous byte tally,
+    keyed by (direction, peer, qos_class, owner)."""
+    with _lock:
+        items = list(_local.items())
+    out = {}
+    for (d, p, q, o), v in items:
+        if direction is not None and d != direction:
+            continue
+        if peer is not None and p != peer:
+            continue
+        if qos_class is not None and q != qos_class:
+            continue
+        if owner is not None and o != owner:
+            continue
+        out[(d, p, q, o)] = v
+    return out
+
+
+def total(direction: str, **filters) -> int:
+    return sum(local_totals(direction, **filters).values())
+
+
+def reset_local() -> None:
+    """Test helper: clear the process-local tally (metrics counters are
+    monotonic and untouched)."""
+    with _lock:
+        _local.clear()

@@ -511,6 +511,128 @@ def run_rl_benchmarks(*, quick: bool = False) -> list[dict]:
     return results
 
 
+def run_obs_benchmarks(*, quick: bool = False) -> list[dict]:
+    """The `obs` family: what the always-on flight recorder costs.
+
+    - span record throughput: ring-only ``record()`` rate in one
+      process — the ceiling any per-op span can ever cost;
+    - allreduce overhead: ring 16MB allreduce instrumented vs the
+      suppressed baseline (workers spawned under
+      ``flight_recorder_enabled=False`` start with recording AND byte
+      accounting off — the honest uninstrumented comparison);
+    - serve overhead: pool decode tokens/s instrumented vs suppressed.
+
+    The committed floors hold both overheads to <=3%: observability
+    that taxes the hot path more than that does not ship."""
+    import threading
+    import uuid
+
+    from ray_tpu._private import config as _cfg
+    from ray_tpu._private import flight_recorder as _fr
+
+    results = []
+
+    # ---- raw span record throughput (ring only, no flush traffic) ----
+    n = 50_000 if quick else 200_000
+    t = time.monotonic()
+    _fr.record("bench", "obs.warm", t, t, flush=False)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        _fr.record("bench", "obs.span", t, t, flush=False)
+    dt = time.perf_counter() - t0
+    r = {"name": "obs span record throughput (ring only)",
+         "per_s": round(n / dt, 1), "unit": "spans/s", "n": n}
+    results.append(r)
+    print(json.dumps(r), flush=True)
+
+    # ---- ring allreduce overhead (worker-side spans + byte tags) ----
+    def allreduce_rate(enabled: bool) -> float:
+        _cfg.set_system_config({"flight_recorder_enabled": enabled})
+        world = 4
+        ranks = [_CollRank.remote() for _ in range(world)]
+        try:
+            name = f"obs-{uuid.uuid4().hex[:8]}"
+            ray_tpu.get([a.init.remote(world, rk, name)
+                         for rk, a in enumerate(ranks)], timeout=120)
+            nbytes = 16 * 1024 * 1024
+            iters = 3 if quick else 6
+            best = None
+            for _ in range(2 if quick else 3):
+                outs = ray_tpu.get(
+                    [a.allreduce_loop.remote(nbytes, iters, "ring", None)
+                     for a in ranks], timeout=600)
+                per_op = max(d for d, _ in outs)
+                best = per_op if best is None else min(best, per_op)
+            return 1.0 / best
+        finally:
+            for a in ranks:
+                ray_tpu.kill(a)
+
+    base = allreduce_rate(False)
+    inst = allreduce_rate(True)
+    _cfg.set_system_config({"flight_recorder_enabled": True})
+    r = {"name": "obs overhead: ring allreduce 16MB (4 ranks)",
+         "per_s": round(inst, 2), "unit": "ops/s",
+         "baseline_per_s": round(base, 2),
+         "overhead_pct": round(max(0.0, (base - inst) / base) * 100, 2)}
+    results.append(r)
+    print(json.dumps(r), flush=True)
+
+    # ---- serve decode overhead (pool + replica + engine spans) ----
+    def serve_rate(enabled: bool) -> float:
+        import contextlib as _ctx
+
+        from ray_tpu.serve.llm_pool import LLMPool
+
+        _cfg.set_system_config({"flight_recorder_enabled": enabled})
+        # the pool itself runs in THIS process: suppress driver-side
+        # spans too for the baseline (workers read the config flag)
+        with _ctx.ExitStack() as stack:
+            if not enabled:
+                stack.enter_context(_fr._suppressed())
+            pool = LLMPool(
+                model_size="tiny", slots=8, max_len=128, chunk_tokens=8,
+                prompt_buckets=(16,), min_replicas=1, max_replicas=1,
+                chunk_delay_s=0.01, autoscale=False)
+            try:
+                warm = [int(x) for x in
+                        np.random.RandomState(3).randint(1, 250, 16)]
+                ray_tpu.get([rep.handle.generate.remote(warm, 8)
+                             for rep in pool._alive()], timeout=600)
+                n_req, new_tokens = (8 if quick else 16), 64
+                outs = [None] * n_req
+
+                def one(i):
+                    rng = np.random.RandomState(2000 + i)
+                    outs[i] = pool.generate(
+                        [int(x) for x in rng.randint(1, 250, 16)],
+                        new_tokens)
+
+                threads = [threading.Thread(target=one, args=(i,))
+                           for i in range(n_req)]
+                t0 = time.perf_counter()
+                for th in threads:
+                    th.start()
+                for th in threads:
+                    th.join()
+                dt = time.perf_counter() - t0
+                return sum(len(o["tokens"]) for o in outs) / dt
+            finally:
+                pool.shutdown()
+
+    sbase = serve_rate(False)
+    sinst = serve_rate(True)
+    _cfg.set_system_config({"flight_recorder_enabled": True})
+    r = {"name": "obs overhead: serve pool decode (1 replica)",
+         "per_s": round(sinst, 1), "unit": "tokens/s",
+         "baseline_per_s": round(sbase, 1),
+         "overhead_pct":
+             round(max(0.0, (sbase - sinst) / sbase) * 100, 2)}
+    results.append(r)
+    print(json.dumps(r), flush=True)
+    return results
+
+
 def run_benchmarks(*, quick: bool = False) -> list[dict]:
     results = []
     windows = 1 if quick else 3
@@ -618,6 +740,9 @@ def run_benchmarks(*, quick: bool = False) -> list[dict]:
     # ---- collective (DCN star vs ring vs ring+int8) ----
     results.extend(run_collective_benchmarks(quick=quick))
 
+    # ---- obs (flight-recorder overhead + span throughput) ----
+    results.extend(run_obs_benchmarks(quick=quick))
+
     return results
 
 
@@ -670,7 +795,7 @@ def main(argv=None):
     p.add_argument("--quick", action="store_true")
     p.add_argument("--family", default="all",
                    choices=["all", "collective", "transfer", "serve",
-                            "rl"],
+                            "rl", "obs"],
                    help="run one workload family only")
     p.add_argument("--in-process", action="store_true",
                    help="head in the driver process (debug only)")
@@ -693,6 +818,8 @@ def main(argv=None):
             results = run_serve_benchmarks(quick=args.quick)
         elif args.family == "rl":
             results = run_rl_benchmarks(quick=args.quick)
+        elif args.family == "obs":
+            results = run_obs_benchmarks(quick=args.quick)
         else:
             results = run_benchmarks(quick=args.quick)
     finally:

@@ -12,6 +12,7 @@ dependency — the head's task-event ring is the trace store and
 
 from __future__ import annotations
 
+import contextlib
 import contextvars
 import os
 
@@ -38,6 +39,42 @@ def new_trace_id() -> str:
     from ray_tpu._private.ids import random_bytes
 
     return random_bytes(8).hex()
+
+
+def new_span_id() -> str:
+    """A synthetic 32-hex span id (same width as a task id) for roots
+    that are not tasks — e.g. a serve request entering at the pool."""
+    from ray_tpu._private.ids import random_bytes
+
+    return random_bytes(16).hex()
+
+
+@contextlib.contextmanager
+def scope(trace_id: str, span: str):
+    """Enter an explicit (trace_id, span) scope for the body's duration
+    — used to re-enter a stored request trace (stream polls)."""
+    tok = set_current(trace_id, span)
+    try:
+        yield
+    finally:
+        reset(tok)
+
+
+@contextlib.contextmanager
+def root_scope():
+    """Ensure a trace context exists for the body: join the ambient one
+    if present (pool running inside an actor call), else root a fresh
+    trace (driver-direct usage). Yields the active (trace_id, span)."""
+    cur = current()
+    if cur is not None:
+        yield cur
+        return
+    tid, span = new_trace_id(), new_span_id()
+    tok = set_current(tid, span)
+    try:
+        yield (tid, span)
+    finally:
+        reset(tok)
 
 
 def for_submit() -> dict:

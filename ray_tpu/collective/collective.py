@@ -177,6 +177,17 @@ class Group:
             with box.cond:
                 box.cond.notify_all()
         _record_abort(self, reason, origin)
+        # survivor's black box: every rank that observes the abort dumps
+        # its span ring, so a postmortem has the victim AND survivors
+        try:
+            from ray_tpu._private import flight_recorder as _fr
+
+            _fr.dump_bundle(
+                f"collective-abort:{self.name}",
+                extra={"rank": self.rank, "epoch": self.epoch,
+                       "reason": reason, "origin": origin, "op": op})
+        except Exception:  # noqa: BLE001 — abort handling must proceed
+            pass
         return True
 
     def abort(self, reason: str, *, op: str | None = None) -> None:

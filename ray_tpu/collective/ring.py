@@ -53,10 +53,26 @@ def _abort_poll(g, op: str) -> None:
         poll(op=op)
 
 
+def _peer_label(g, rank: int) -> str:
+    """Byte-attribution peer label for a ring neighbor: its node id
+    prefix when the rendezvous learned it, else group:rank."""
+    try:
+        nid = g.peer_nodes.get(rank)
+        if nid:
+            return nid.hex()[:8]
+    except Exception:  # noqa: BLE001 — duck-typed test groups
+        pass
+    return f"{g.name}:r{rank}"
+
+
 def _send_chunk(g, right: int, seq: int, key: str, frame, st, *,
                 op: str, step: int, chunk: int) -> None:
     """One pipelined chunk send, wrapped with the deterministic
     fault-injection site ``ring.send`` (drop / dup / delay / die)."""
+    from ray_tpu._private import net_accounting as _net
+
+    wb = compression.wire_bytes(frame)
+    t0 = time.perf_counter()
     if fault_injection.enabled():
         act = fault_injection.fire(
             "ring.send", group=g.name, rank=g.rank, op=op, step=step,
@@ -65,19 +81,38 @@ def _send_chunk(g, right: int, seq: int, key: str, frame, st, *,
             return
         if act == "dup":
             g._send_obj(right, seq, key, frame, fire=True)
-            st.bytes_sent += compression.wire_bytes(frame)
+            st.bytes_sent += wb
+            _net.account_tx(_peer_label(g, right), "collective", g.name, wb)
     g._send_obj(right, seq, key, frame, fire=True)
-    st.bytes_sent += compression.wire_bytes(frame)
+    st.send_s += time.perf_counter() - t0
+    st.bytes_sent += wb
     st.chunks += 1
+    _net.account_tx(_peer_label(g, right), "collective", g.name, wb)
 
 
 def _recv_chunk(g, left: int, seq: int, key: str, *, timeout: float,
-                op: str, step: int, chunk: int):
+                op: str, step: int, chunk: int, st=None):
+    from ray_tpu._private import net_accounting as _net
+
     if fault_injection.enabled():
         fault_injection.fire(
             "ring.recv", group=g.name, rank=g.rank, op=op, step=step,
             chunk=chunk)
-    return g._recv_obj(left, seq, key, timeout=timeout, op=op)
+    t0 = time.perf_counter()
+    frame = g._recv_obj(left, seq, key, timeout=timeout, op=op)
+    dt = time.perf_counter() - t0
+    if st is not None:
+        # the first blocking recv of an op is dominated by waiting for
+        # the slowest peer to ENTER the op: attribute it to rendezvous,
+        # later waits to per-chunk pipeline stalls
+        if st.recvs == 0:
+            st.rendezvous_s += dt
+        else:
+            st.recv_wait_s += dt
+        st.recvs += 1
+        _net.account_rx(_peer_label(g, left), "collective", g.name,
+                        compression.wire_bytes(frame))
+    return frame
 
 _REDUCE_ELEMWISE = {
     "sum": np.add,
@@ -102,6 +137,16 @@ class OpStats:
     bytes_recv: int = 0
     chunks: int = 0
     seconds: float = 0.0
+    # flight-recorder span breakdown (all perf_counter deltas):
+    # rendezvous (first blocking recv: waiting for the slowest peer to
+    # enter the op), later chunk waits, send/outbox time, and local
+    # encode/decode/reduce compute overlapped with the wire
+    t_start: float = field(default_factory=time.monotonic)
+    rendezvous_s: float = 0.0
+    recv_wait_s: float = 0.0
+    send_s: float = 0.0
+    compute_s: float = 0.0
+    recvs: int = 0
 
     @property
     def compression_ratio(self) -> float:
@@ -278,6 +323,7 @@ def _ring_reduce_scatter_flat(g, flat: np.ndarray, bounds: list[int], *,
         # fire every chunk of the step before blocking on receives: the
         # outbox drains on the io thread while we decode/accumulate
         for ci, (lo, hi) in enumerate(send_chunks):
+            tc = time.perf_counter()
             if use_ef:
                 # rank in the key: ranks may share a process (threaded
                 # tests, multi-group actors), and residuals are strictly
@@ -288,17 +334,20 @@ def _ring_reduce_scatter_flat(g, flat: np.ndarray, bounds: list[int], *,
                 _ef_put(ef_key, residual)
             else:
                 frame = codec.encode(work[lo:hi])
+            st.compute_s += time.perf_counter() - tc
             _send_chunk(g, right, seq, f"{tag}:rs{step}:{ci}", frame, st,
                         op=f"{tag}:rs{step}", step=step, chunk=ci)
         for ci, (lo, hi) in enumerate(recv_chunks):
             frame = _recv_chunk(g, left, seq, f"{tag}:rs{step}:{ci}",
                                 timeout=timeout, op=f"{tag}:rs{step}",
-                                step=step, chunk=ci)
+                                step=step, chunk=ci, st=st)
             st.bytes_recv += compression.wire_bytes(frame)
+            tc = time.perf_counter()
             incoming = codec.decode(frame)
             if hi > lo:
                 chunk = np.asarray(incoming, dtype=work.dtype).ravel()
                 work[lo:hi] = reducer(work[lo:hi], chunk)
+            st.compute_s += time.perf_counter() - tc
         st.seconds += time.perf_counter() - t0
     return work
 
@@ -344,12 +393,14 @@ def _ring_all_gather_flat(g, work: np.ndarray, bounds: list[int], *,
         for ci, (clo, chi) in enumerate(recv_chunks):
             frame = _recv_chunk(g, left, seq, f"{tag}:ag{step}:{ci}",
                                 timeout=timeout, op=f"{tag}:ag{step}",
-                                step=step, chunk=ci)
+                                step=step, chunk=ci, st=st)
             st.bytes_recv += compression.wire_bytes(frame)
             frames.append(frame)  # forward verbatim next step
+            tc = time.perf_counter()
             if chi > clo:
                 work[clo:chi] = np.asarray(
                     codec.decode(frame), dtype=work.dtype).ravel()
+            st.compute_s += time.perf_counter() - tc
         st.seconds += time.perf_counter() - t0
     return work
 
@@ -361,6 +412,26 @@ def _ring_all_gather_flat(g, work: np.ndarray, bounds: list[int], *,
 
 def _finish(g, st: OpStats):
     record_stats(g.name, st)
+    try:
+        from ray_tpu._private import flight_recorder as _fr
+
+        _fr.record(
+            "collective", f"collective.{st.op}", st.t_start,
+            time.monotonic(),
+            attrs={
+                "group": g.name, "rank": g.rank,
+                "world_size": st.world_size, "codec": st.codec,
+                "tensor_bytes": st.tensor_bytes,
+                "bytes_sent": st.bytes_sent,
+                "bytes_recv": st.bytes_recv,
+                "chunks": st.chunks,
+                "rendezvous_s": round(st.rendezvous_s, 6),
+                "chunk_wait_s": round(st.recv_wait_s, 6),
+                "send_s": round(st.send_s, 6),
+                "compute_s": round(st.compute_s, 6),
+            })
+    except Exception:  # noqa: BLE001 — observability is best-effort
+        pass
 
 
 def _restore_dtype(work: np.ndarray, arr: np.ndarray,

@@ -204,6 +204,9 @@ class ControlPlane:
         import collections
 
         self.task_events: collections.deque = collections.deque(maxlen=50_000)
+        # events silently evicted from the ring (no silent caps: surfaced
+        # via /api/events and the /metrics builtins)
+        self.task_events_dropped = 0
         # structured cluster events + durable worker failure records
         # (reference dashboard/modules/event + GcsWorkerManager tables)
         self.cluster_events: collections.deque = collections.deque(
@@ -1254,8 +1257,23 @@ class ControlPlane:
     # ray_tpu.timeline().
 
     async def rpc_task_events(self, conn, p):
-        self.task_events.extend(p["events"])
+        events = p["events"]
+        cap = self.task_events.maxlen or 0
+        overflow = len(self.task_events) + len(events) - cap
+        if overflow > 0:
+            # extend() evicts this many from the left: count them instead
+            # of truncating silently
+            self.task_events_dropped += min(overflow,
+                                            cap + len(events))
+        self.task_events.extend(events)
         return True
+
+    async def rpc_obs_stats(self, conn, p):
+        return {
+            "task_events_dropped_total": self.task_events_dropped,
+            "task_events_len": len(self.task_events),
+            "task_events_cap": self.task_events.maxlen,
+        }
 
     async def rpc_list_task_events(self, conn, p):
         events = list(self.task_events)

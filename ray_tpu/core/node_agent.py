@@ -74,6 +74,17 @@ def _part_chunk(part: dict):
     return part.get("chunk", b"")
 
 
+def _owner_label(owner) -> str:
+    """Byte-attribution owner tag from a directory entry's
+    owner_address dict (the tenant that created the object)."""
+    if isinstance(owner, dict) and owner.get("worker_id"):
+        try:
+            return owner["worker_id"].hex()[:12]
+        except (AttributeError, TypeError):
+            pass
+    return "unknown"
+
+
 _xfer_metrics: dict | None = None
 
 
@@ -2254,6 +2265,15 @@ class NodeAgent:
                     await asyncio.wait_for(conn.drain(), timeout=20.0)
                 except asyncio.TimeoutError:
                     return {"busy": True, "retry_after_s": 0.5}
+            # per-peer inflight: this peer's transport write backlog is
+            # exactly the bytes the pacing window is holding for it
+            try:
+                from ray_tpu._private import net_accounting as _net
+
+                _net.set_inflight(p.get("requester", "?"),
+                                  self._conn_write_buffered(conn))
+            except Exception:  # noqa: BLE001 — gauge is best-effort
+                pass
         return self._read_object_chunk(p, conn)
 
     @staticmethod
@@ -2285,6 +2305,25 @@ class NodeAgent:
         end = min(offset + _chunk_size(), total)
         view = buf.data[offset:end]
         meta = buf.metadata if offset == 0 else b""
+        if conn is not None:
+            # tx attribution from the puller's self-declared identity
+            # ({requester, qos, owner} riding the chunk request) — the
+            # exact mirror of the rx accounting on its side
+            try:
+                from ray_tpu._private import flight_recorder as _fr
+                from ray_tpu._private import net_accounting as _net
+
+                _net.account_tx(p.get("requester", "?"),
+                                p.get("qos", "bulk"),
+                                p.get("owner", "unknown"), end - offset)
+                now = time.monotonic()
+                _fr.record("transfer", "transfer.serve_chunk", now, now,
+                           attrs={"oid": oid.hex()[:16], "offset": offset,
+                                  "bytes": end - offset,
+                                  "peer": p.get("requester", "?")},
+                           flush=False)
+            except Exception:  # noqa: BLE001 — serving must not fail
+                pass
         if pins is None:
             # direct/local caller (no transport to hold the view for):
             # legacy inline copy, release immediately
@@ -2399,16 +2438,20 @@ class NodeAgent:
                 continue
             pulled = False
             clis = []
+            nids = []
             for nid in info["locations"]:
                 cli = await self._peer_agent(nid)
                 if cli is not None:
                     clis.append(cli)
+                    nids.append(nid)
             if clis:
                 try:
                     # every reachable holder goes in: the pipelined pull
                     # stripes its chunk window across all of them and
                     # fails over chunk-by-chunk
-                    pulled = await self._pull_from(clis, oid)
+                    pulled = await self._pull_from(
+                        clis, oid, nids=nids,
+                        owner=_owner_label(info.get("owner")))
                 except StoreFullError:
                     # store saturated even after LRU eviction: back off
                     # and retry within the deadline — the admission
@@ -2424,7 +2467,8 @@ class NodeAgent:
         return False
 
     async def _read_chunk_backoff(self, cli: AsyncRpcClient, oid: bytes,
-                                  offset: int, budget_s: float = 60.0):
+                                  offset: int, budget_s: float = 60.0,
+                                  attrib: dict | None = None):
         """read_object_chunk with bounded backoff on the server's
         retryable {"busy": True} refusal (its pacing deadline expired:
         our own connection is flooded). Bounded by WALL CLOCK, not
@@ -2435,9 +2479,13 @@ class NodeAgent:
         other locations within its own deadline)."""
         backoff = 0.1
         deadline = time.monotonic() + budget_s
+        req = {"object_id": oid, "offset": offset}
+        if attrib:
+            # {requester, qos, owner} ride the request so the SERVER can
+            # attribute its tx bytes symmetrically with our rx
+            req.update(attrib)
         while True:
-            part = await cli.call("read_object_chunk",
-                                  {"object_id": oid, "offset": offset})
+            part = await cli.call("read_object_chunk", req)
             if not (isinstance(part, dict) and part.get("busy")):
                 return part
             if time.monotonic() > deadline:
@@ -2456,7 +2504,8 @@ class NodeAgent:
             await asyncio.sleep(0.01)
         return False
 
-    async def _pull_from(self, clis, oid: bytes) -> bool:
+    async def _pull_from(self, clis, oid: bytes, *, nids=None,
+                         owner: str = "unknown") -> bool:
         """Pipelined multi-source pull (object_manager.cc:633 redesigned
         around the pull RTT): chunk 0 establishes total size + metadata,
         then a sliding window of transfer_pull_pipeline_depth concurrent
@@ -2469,14 +2518,27 @@ class NodeAgent:
         if not isinstance(clis, (list, tuple)):
             clis = [clis]
         t0 = time.monotonic()
+        # rx attribution: peer label per source + the self-declared
+        # identity each chunk request carries for the server's tx side
+        if nids is not None and len(nids) == len(clis):
+            labels = [nid.hex()[:8] for nid in nids]
+        else:
+            labels = [f"src{i}" for i in range(len(clis))]
+        label_of = {id(c): lbl for c, lbl in zip(clis, labels)}
+        rx_by: dict[str, int] = {}
+        attrib = {"requester": self.node_id.hex()[:8], "qos": "bulk",
+                  "owner": owner}
         try:
             first = None
+            lead_lbl = labels[0] if labels else "?"
             for lead in clis:
                 try:
-                    first = await self._read_chunk_backoff(lead, oid, 0)
+                    first = await self._read_chunk_backoff(
+                        lead, oid, 0, attrib=attrib)
                 except (rpc.ConnectionLost, rpc.RpcError, OSError):
                     first = None  # dead lead: try the next holder
                 if first is not None:
+                    lead_lbl = label_of[id(lead)]
                     break
             if first is None:
                 return False
@@ -2490,6 +2552,7 @@ class NodeAgent:
                 return await self._await_sealed(oid)
             try:
                 n0 = len(chunk0)
+                rx_by[lead_lbl] = rx_by.get(lead_lbl, 0) + n0
                 wbuf.data[0:n0] = chunk0
                 if n0 == 0 and total > 0:
                     wbuf.abort()
@@ -2508,13 +2571,17 @@ class NodeAgent:
                     next source', not 'abort the pull'."""
                     try:
                         part = await self._read_chunk_backoff(
-                            cli, oid, off)
+                            cli, oid, off, attrib=attrib)
                     except (rpc.ConnectionLost, rpc.RpcError, OSError):
                         return None
                     if part is None:
                         return None
                     data = _part_chunk(part)
-                    return data if len(data) == want else None
+                    if len(data) != want:
+                        return None
+                    lbl = label_of[id(cli)]
+                    rx_by[lbl] = rx_by.get(lbl, 0) + len(data)
+                    return data
 
                 async def fetch_chunks(widx: int):
                     own = clis[widx % len(clis)]
@@ -2558,8 +2625,23 @@ class NodeAgent:
                 if meta:
                     wbuf.meta[:] = meta
                 wbuf.seal()
-                self._record_pull(oid, total, st, len(clis),
-                                  time.monotonic() - t0)
+                dt = time.monotonic() - t0
+                self._record_pull(oid, total, st, len(clis), dt)
+                try:
+                    from ray_tpu._private import flight_recorder as _fr
+                    from ray_tpu._private import net_accounting as _net
+
+                    for lbl, n in rx_by.items():
+                        _net.account_rx(lbl, "bulk", owner, n)
+                    _fr.record(
+                        "transfer", "transfer.pull", t0, t0 + dt,
+                        attrs={"oid": oid.hex()[:16], "bytes": total,
+                               "chunks": st["chunks"],
+                               "sources": len(clis),
+                               "peak_inflight": st["peak"],
+                               "owner": owner})
+                except Exception:  # noqa: BLE001 — accounting only
+                    pass
                 return True
             except Exception:
                 wbuf.abort()

@@ -12,6 +12,9 @@ modules/reporter (node stats + stack dumps). Endpoints:
   GET /api/cluster   summary (alive nodes, resource totals)
   GET /api/stacks    thread stacks of every worker (py-spy analog)
   GET /api/logs      per-node log files; ?node_id=&file= tails one
+  GET /api/timeline  Chrome-trace JSON (tasks + flight-recorder spans)
+  GET /api/slo       TTFT/TBT/step-time percentiles + straggler rank
+  GET /api/events    cluster events + task_events_dropped_total
   GET /metrics       Prometheus text format (cluster + user metrics)
 
 Runs inside the driver (or any process with cluster access) on a
@@ -90,6 +93,61 @@ def _to_prometheus(rows: list[dict], cluster: dict) -> str:
     return "\n".join(lines) + "\n"
 
 
+def _hist_percentiles(rows: list[dict], name: str, *,
+                      group_key: str | None = None) -> dict:
+    """Percentiles from aggregated histogram rows (`rpc_get_metrics`).
+
+    Bucket rows carry an ``("le", bound)`` tag with CUMULATIVE counts;
+    the ``("__stat__", "sum")`` row carries the value sum. Linear
+    interpolation inside the winning bucket; a hit landing in the +Inf
+    bucket clamps to the largest finite bound. Returns
+    ``{group: {count, mean_s, p50_s, p90_s, p99_s}}`` keyed by the
+    `group_key` tag value ("" when ungrouped — other tag dimensions are
+    summed together)."""
+    buckets: dict[str, dict[float, float]] = {}
+    sums: dict[str, float] = {}
+    for r in rows:
+        if r["name"] != name:
+            continue
+        tags = dict(tuple(t) for t in r["tags"])
+        grp = tags.get(group_key, "") if group_key else ""
+        if tags.get("__stat__") == "sum":
+            sums[grp] = sums.get(grp, 0.0) + r["value"]
+            continue
+        if "le" not in tags:
+            continue
+        le = float("inf") if tags["le"] == "+Inf" else float(tags["le"])
+        g = buckets.setdefault(grp, {})
+        g[le] = g.get(le, 0) + r["value"]
+    out: dict[str, dict] = {}
+    for grp, bs in buckets.items():
+        total = bs.get(float("inf"), 0)
+        if total <= 0:
+            continue
+        res = {"count": int(total),
+               "mean_s": round(sums.get(grp, 0.0) / total, 6)}
+        for q, label in ((0.5, "p50_s"), (0.9, "p90_s"), (0.99, "p99_s")):
+            target = q * total
+            prev_b, prev_c = 0.0, 0.0
+            val = prev_b
+            for b in sorted(bs):
+                c = bs[b]
+                if c >= target:
+                    if b == float("inf"):
+                        val = prev_b
+                    else:
+                        span = c - prev_c
+                        frac = ((target - prev_c) / span) if span > 0 else 1.0
+                        val = prev_b + frac * (b - prev_b)
+                    break
+                if b != float("inf"):
+                    prev_b = b
+                prev_c = c
+            res[label] = round(val, 6)
+        out[grp] = res
+    return out
+
+
 class DashboardHead:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self.host = host
@@ -124,6 +182,36 @@ class DashboardHead:
             "tasks_running": sum(n.get("running", 0) for n in alive),
         }
 
+    def _slo_summary(self) -> dict:
+        """TTFT / TBT / step-time percentiles from the head's metric
+        store, plus slowest-rank straggler attribution (which rank is
+        slowest and which step segment its time went to)."""
+        rows = self._head().call("get_metrics", {})
+        ttft = _hist_percentiles(rows, "serve_ttft_seconds")
+        tbt = _hist_percentiles(rows, "serve_tbt_seconds")
+        step = _hist_percentiles(rows, "train_step_seconds",
+                                 group_key="rank")
+        seg: dict[str, dict[str, float]] = {}
+        for r in rows:
+            if r["name"] != "train_step_segment_seconds_total":
+                continue
+            tags = dict(tuple(t) for t in r["tags"])
+            seg.setdefault(tags.get("rank", "?"), {})[
+                tags.get("segment", "?")] = r["value"]
+        straggler = None
+        if step:
+            slowest = max(step, key=lambda rk: step[rk]["mean_s"])
+            segs = seg.get(slowest, {})
+            straggler = {
+                "rank": slowest,
+                "mean_step_s": step[slowest]["mean_s"],
+                "dominant_segment":
+                    max(segs, key=segs.get) if segs else None,
+                "segments_s": {k: round(v, 6) for k, v in segs.items()},
+            }
+        return {"ttft": ttft.get("", {}), "tbt": tbt.get("", {}),
+                "train_step": step, "straggler": straggler}
+
     def _agent_call(self, node: dict, method: str, payload: dict,
                     timeout: float = 10.0):
         from ray_tpu._private import rpc as _rpc
@@ -153,9 +241,22 @@ class DashboardHead:
         if path == "/api/cluster":
             return self._cluster_summary()
         if path == "/api/events":
-            return head.call("list_events", {
+            events = head.call("list_events", {
                 "limit": int(query.get("limit", 1000)),
                 "kind": query.get("kind")})
+            try:
+                obs = head.call("obs_stats", {})
+            except Exception:  # noqa: BLE001 — older head
+                obs = {}
+            return {"events": events,
+                    "task_events_dropped_total":
+                        obs.get("task_events_dropped_total", 0)}
+        if path == "/api/timeline":
+            from ray_tpu._private import api as _api
+
+            return _api.timeline()
+        if path == "/api/slo":
+            return self._slo_summary()
         if path == "/api/op_stats":
             return head.call("op_stats", {})
         if path == "/api/worker_failures":
@@ -293,6 +394,18 @@ class DashboardHead:
         try:
             if parts.path == "/metrics":
                 rows = self._head().call("get_metrics", {})
+                try:
+                    obs = self._head().call("obs_stats", {})
+                    rows = rows + [{
+                        "name": "task_events_dropped_total",
+                        "kind": "counter",
+                        "description": "task/span events evicted from the "
+                                       "head's bounded event ring",
+                        "tags": [],
+                        "value": obs.get("task_events_dropped_total", 0),
+                    }]
+                except Exception:  # noqa: BLE001
+                    pass
                 text = _to_prometheus(rows, self._cluster_summary())
                 return "200 OK", "text/plain; version=0.0.4", text.encode()
             if parts.path == "/api/serve/applications":

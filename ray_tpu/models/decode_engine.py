@@ -55,6 +55,13 @@ def _get_metrics():
                 "decode_prefix_cache_hit_rate",
                 "prefix-cache hit rate since start",
                 tag_keys=("engine",)),
+            "tbt": M.Histogram(
+                "serve_tbt_seconds",
+                "per-token time-between-tokens (chunk gap / chunk "
+                "tokens, per active stream)",
+                boundaries=(0.001, 0.005, 0.02, 0.05, 0.1, 0.25,
+                            0.5, 1.0),
+                tag_keys=("engine",)),
         }
     return _metrics
 
@@ -755,6 +762,13 @@ class RaggedDecoder:
             s.tokens.extend(int(t) for t in toks[slot, :take])
             s.token_times.extend([t_now] * take)
             delivered += take
+            # per-token TBT: this stream's inter-chunk gap amortized
+            # over the chunk's tokens (tokens inside one chunk land
+            # together — the gap IS the per-token pacing a client sees)
+            if take > 0 and len(s.token_times) > take:
+                prev = s.token_times[-take - 1]
+                if t_now > prev:
+                    self._tbt_obs((t_now - prev) / take)
             if len(s.tokens) >= s.max_new \
                     or int(pos_np[slot]) >= self.max_len - 1:
                 s.done = True
@@ -779,6 +793,12 @@ class RaggedDecoder:
 
     RATE_WINDOW_S = 5.0
     METRICS_PERIOD_S = 1.0
+
+    def _tbt_obs(self, v: float) -> None:
+        try:
+            _get_metrics()["tbt"].observe(v, {"engine": self.name})
+        except Exception:  # noqa: BLE001 — telemetry never breaks decode
+            pass
 
     def _account(self, t_now: float, delivered: int) -> None:
         self._total_tokens += delivered

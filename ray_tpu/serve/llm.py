@@ -122,6 +122,7 @@ class LLMServer:
         # sids being consumed via poll_stream: the pump must NOT purge
         # their finished entries (no _done_events waiter is registered)
         self._stream_sids: dict[int, float] = {}  # sid -> last poll
+        self._stream_ft: set[int] = set()  # sids with first-token span
         self._stop = False
         self._draining = False
         self._pump_thread = threading.Thread(
@@ -211,6 +212,28 @@ class LLMServer:
             # registered waiter (abandoned by a timed-out handler)
             with self._lock:
                 self._done_events.pop(sid, None)
+        try:
+            from ray_tpu._private import flight_recorder as _fr
+
+            stamps = s.token_times
+            if stamps:
+                # engine stamps are perf_counter; rebase onto monotonic
+                # via one paired read so the span clock stays coherent
+                off = time.monotonic() - time.perf_counter()
+                _fr.record("serve", "serve.first_token",
+                           s.submitted + off, stamps[0] + off,
+                           attrs={"sid": sid,
+                                  "engine": self.engine.name})
+                if len(stamps) > 1:
+                    _fr.record(
+                        "serve", "serve.decode", stamps[0] + off,
+                        stamps[-1] + off,
+                        attrs={"sid": sid, "tokens": len(stamps),
+                               "tbt_mean_s": round(
+                                   (stamps[-1] - stamps[0])
+                                   / (len(stamps) - 1), 6)})
+        except Exception:  # noqa: BLE001 — observability best-effort
+            pass
         return {
             "tokens": s.tokens[:max_tokens],
             "submitted_s": s.submitted,
@@ -240,11 +263,31 @@ class LLMServer:
         ride the object store straight from the prefill worker's node
         to this replica (pipelined multi-source pull), never through
         the pool."""
+        t0 = time.monotonic()
         sid, ev = self._submit_locked(
             lambda: self.engine.submit_prefilled(
                 list(prompt_ids), int(max_tokens), kv,
                 temperature=temperature, top_p=top_p, seed=seed))
+        self._record_kv_handoff(kv, t0)
         return self._wait_result(sid, ev, int(max_tokens))
+
+    def _record_kv_handoff(self, kv, t0: float) -> None:
+        """Span + kv-class rx attribution for an externally-prefilled
+        payload adopted by this replica (the KV rows arrived via the
+        object store during arg staging; this covers the replica-side
+        handoff into the engine)."""
+        try:
+            from ray_tpu._private import flight_recorder as _fr
+            from ray_tpu._private import net_accounting as _net
+
+            nb = int(getattr(kv.get("k"), "nbytes", 0)
+                     + getattr(kv.get("v"), "nbytes", 0))
+            _fr.record("serve", "serve.kv_handoff", t0, time.monotonic(),
+                       attrs={"kv_bytes": nb,
+                              "engine": self.engine.name})
+            _net.account_rx("prefill", "kv", self.engine.name, nb)
+        except Exception:  # noqa: BLE001 — observability best-effort
+            pass
 
     # -- streaming API --
 
@@ -261,6 +304,7 @@ class LLMServer:
         prompt_ids = list(req["prompt_ids"])
         max_tokens = int(req.get("max_tokens", 64))
         sampling = self._sampling(req)
+        t0 = time.monotonic()
         with self._lock:
             if self._draining:
                 raise RuntimeError("replica draining: not admitting")
@@ -271,6 +315,8 @@ class LLMServer:
                 sid = self.engine.submit(prompt_ids, max_tokens,
                                          **sampling)
             self._stream_sids[sid] = time.monotonic()
+        if req.get("kv") is not None:
+            self._record_kv_handoff(req["kv"], t0)
         return {"sid": sid}
 
     def submit_stream_prefilled(self, kv: dict, prompt_ids: list,
@@ -283,6 +329,7 @@ class LLMServer:
         an ObjectRef passed here is resolved by the executor's arg
         staging — the KV rows ride the object store from the prefill
         worker's node, never through the caller."""
+        t0 = time.monotonic()
         with self._lock:
             if self._draining:
                 raise RuntimeError("replica draining: not admitting")
@@ -290,6 +337,7 @@ class LLMServer:
                 list(prompt_ids), int(max_tokens), kv,
                 temperature=temperature, top_p=top_p, seed=seed)
             self._stream_sids[sid] = time.monotonic()
+        self._record_kv_handoff(kv, t0)
         return {"sid": sid}
 
     def poll_stream(self, sid: int) -> dict:
@@ -305,12 +353,46 @@ class LLMServer:
             # read BEFORE take_tokens: the final (fully-drained) take
             # purges the stream and with it the version record
             version = self.engine.stream_version(sid)
+            s = self.engine._by_sid.get(sid)
             new, lps, done = self.engine.take_tokens(
                 sid, with_logprobs=True)
             if done:
                 self._stream_sids.pop(sid, None)
+        self._record_stream_spans(sid, s, bool(new), done)
         return {"tokens": new, "logprobs": lps, "done": done,
                 "version": version}
+
+    def _record_stream_spans(self, sid: int, s, fresh: bool,
+                             done: bool) -> None:
+        """Streaming twin of _wait_result's span pair: first_token on
+        the first poll that surfaces tokens, decode when the stream
+        finishes. Runs under the poller's trace scope (the pool
+        re-enters the stream's trace on every poll)."""
+        try:
+            from ray_tpu._private import flight_recorder as _fr
+
+            stamps = s.token_times if s is not None else []
+            if not stamps:
+                return
+            off = time.monotonic() - time.perf_counter()
+            if fresh and sid not in self._stream_ft:
+                self._stream_ft.add(sid)
+                _fr.record("serve", "serve.first_token",
+                           s.submitted + off, stamps[0] + off,
+                           attrs={"sid": sid,
+                                  "engine": self.engine.name})
+            if done:
+                self._stream_ft.discard(sid)
+                if len(stamps) > 1:
+                    _fr.record(
+                        "serve", "serve.decode", stamps[0] + off,
+                        stamps[-1] + off,
+                        attrs={"sid": sid, "tokens": len(stamps),
+                               "tbt_mean_s": round(
+                                   (stamps[-1] - stamps[0])
+                                   / (len(stamps) - 1), 6)})
+        except Exception:  # noqa: BLE001 — observability best-effort
+            pass
 
     # -- weight publishing (actor-learner loop) --
 

@@ -35,11 +35,14 @@ decode replicas behind a shared admission queue:
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import threading
 import time
 
 import ray_tpu
+from ray_tpu._private import flight_recorder as _fr
+from ray_tpu._private import trace as _trace
 from ray_tpu.serve.llm import LLMServer, build_model
 
 logger = logging.getLogger(__name__)
@@ -84,6 +87,7 @@ class PrefillWorker:
 
         # chaos site: prefill-worker death / stall mid-prefill
         _fi.fire("serve.prefill", worker=self.name)
+        t0 = time.monotonic()
         prompt = np.asarray(prompt_ids, np.int32)
         bucket = next((b for b in self.buckets if len(prompt) <= b), None)
         if bucket is None:
@@ -101,6 +105,20 @@ class PrefillWorker:
             self.max_len)
         k, v, tok0, lp0 = jax.device_get(
             (k[:, 0], v[:, 0], toks0[0], logp0[0]))
+        kv_bytes = int(k.nbytes + v.nbytes)
+        try:
+            from ray_tpu._private import flight_recorder as _flr
+            from ray_tpu._private import net_accounting as _net
+
+            _flr.record("serve", "serve.prefill", t0, time.monotonic(),
+                        attrs={"worker": self.name,
+                               "prompt_tokens": len(prompt),
+                               "bucket": bucket, "kv_bytes": kv_bytes})
+            # the KV payload leaves this node for the adopting decode
+            # replica via the object store: tag it as kv-class traffic
+            _net.account_tx("decode", "kv", self.name, kv_bytes)
+        except Exception:  # noqa: BLE001 — observability best-effort
+            pass
         return {"k": k, "v": v, "first_token": int(tok0),
                 "first_logprob": float(lp0), "true_len": len(prompt),
                 "version": self._version}
@@ -157,6 +175,12 @@ def _get_pool_metrics():
                 "llm_pool_queue_depth", "requests awaiting a replica"),
             "ttft_p99": M.Gauge(
                 "llm_pool_ttft_p99_s", "TTFT p99 over the recent window"),
+            "ttft_hist": M.Histogram(
+                "serve_ttft_seconds",
+                "client-observed time to first token "
+                "(admission wait + submit->first-token)",
+                boundaries=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                            1.0, 2.5, 5.0, 10.0)),
         }
     return _pool_metrics
 
@@ -333,14 +357,17 @@ class LLMPool:
         SLO scaler exists to catch)."""
         stamps = out.get("token_times_s") or []
         if stamps and out.get("submitted_s") is not None:
+            ttft = queue_wait_s + stamps[0] - out["submitted_s"]
             with self._lock:
                 now = time.monotonic()
-                self._ttfts.append(
-                    (now,
-                     queue_wait_s + stamps[0] - out["submitted_s"]))
+                self._ttfts.append((now, ttft))
                 cut = now - self.TTFT_WINDOW_S
                 while self._ttfts and self._ttfts[0][0] < cut:
                     self._ttfts.pop(0)
+            try:
+                _get_pool_metrics()["ttft_hist"].observe(ttft)
+            except Exception:  # noqa: BLE001 — metrics best-effort
+                pass
 
     def ttft_p99(self) -> float | None:
         with self._lock:
@@ -405,7 +432,19 @@ class LLMPool:
     def generate(self, prompt_ids: list, max_tokens: int = 64, *,
                  temperature: float = 0.0, top_p: float = 1.0,
                  seed: int | None = None) -> dict:
-        """Blocking generate with transparent replica failover."""
+        """Blocking generate with transparent replica failover. The
+        whole request runs under ONE trace id (joined from the ambient
+        context when deployed as an actor, rooted fresh for direct
+        use), so the prefill worker's and decode replica's spans
+        decompose this request's TTFT in the timeline."""
+        with _trace.root_scope():
+            return self._generate_traced(
+                prompt_ids, max_tokens, temperature=temperature,
+                top_p=top_p, seed=seed)
+
+    def _generate_traced(self, prompt_ids: list, max_tokens: int = 64, *,
+                         temperature: float = 0.0, top_p: float = 1.0,
+                         seed: int | None = None) -> dict:
         prompt_ids = list(prompt_ids)
         max_tokens = int(max_tokens)
         sampling = {"temperature": float(temperature),
@@ -416,7 +455,11 @@ class LLMPool:
         t_enqueue = time.monotonic()
         for _ in range(self.max_replicas + 2):
             rep = self._acquire()
-            queue_wait = time.monotonic() - t_enqueue
+            t_admitted = time.monotonic()
+            queue_wait = t_admitted - t_enqueue
+            _fr.record("serve", "serve.admission_wait", t_enqueue,
+                       t_admitted, attrs={"replica": rep.name,
+                                          "queued": self._waiting})
             try:
                 if kv_ref is not None:
                     ref = rep.handle.adopt_prefilled.remote(
@@ -484,22 +527,39 @@ class LLMPool:
         with self._lock:
             self._next_rid += 1
             rid = f"s{self._next_rid}"
+        # one trace id for the stream's WHOLE lifetime: submit, the
+        # prefill worker, the decode replica, and every later poll
+        # re-enter this scope (polls are separate calls, so the pair is
+        # pinned on the record rather than read from the contextvar)
+        tr = _trace.current() or (_trace.new_trace_id(),
+                                  _trace.new_span_id())
         rec = {"prompt_ids": prompt_ids, "max_tokens": max_tokens,
                "emitted": 0, "rep": None, "sid": None, "done": False,
                "last_poll": time.monotonic(), "sampling": sampling,
-               "version": self._weights_version,
-               "kv_ref": self._maybe_prefill(prompt_ids, sampling)}
-        self._streams[rid] = rec
-        try:
-            self._assign_stream(rec)
-        except BaseException:
-            self._streams.pop(rid, None)
-            raise
+               "version": self._weights_version, "trace": tr}
+        with _trace.scope(*tr):
+            rec["kv_ref"] = self._maybe_prefill(prompt_ids, sampling)
+            self._streams[rid] = rec
+            try:
+                self._assign_stream(rec)
+            except BaseException:
+                self._streams.pop(rid, None)
+                raise
         return {"rid": rid, "seed": sampling["seed"],
                 "weights_version": rec["version"]}
 
     def _assign_stream(self, rec: dict):
+        with contextlib.ExitStack() as stack:
+            if rec.get("trace"):
+                stack.enter_context(_trace.scope(*rec["trace"]))
+            self._assign_stream_traced(rec)
+
+    def _assign_stream_traced(self, rec: dict):
+        t_enqueue = time.monotonic()
         rep = self._acquire()
+        _fr.record("serve", "serve.admission_wait", t_enqueue,
+                   time.monotonic(), attrs={"replica": rep.name,
+                                            "queued": self._waiting})
         try:
             body = {"prompt_ids": rec["prompt_ids"],
                     "max_tokens": rec["max_tokens"], **rec["sampling"]}
@@ -557,9 +617,14 @@ class LLMPool:
                 return {"tokens": [], "logprobs": [], "done": False,
                         "weights_version": rec["version"]}
         rep = rec["rep"]
+        t_poll = time.monotonic()
         try:
-            out = ray_tpu.get(rep.handle.poll_stream.remote(rec["sid"]),
-                              timeout=120)
+            with contextlib.ExitStack() as stack:
+                if rec.get("trace"):
+                    stack.enter_context(_trace.scope(*rec["trace"]))
+                out = ray_tpu.get(
+                    rep.handle.poll_stream.remote(rec["sid"]),
+                    timeout=120)
         except ray_tpu.RayActorError:
             # mid-stream death: re-queue onto a survivor and skip the
             # tokens the client already has — exact because the
@@ -613,6 +678,14 @@ class LLMPool:
         fresh_lps = lps[skip:] if lps else []
         rec["emitted"] += len(fresh)
         rec["replayed"] = rec.get("replayed", 0) + len(fresh)
+        if fresh or out["done"]:
+            tr = rec.get("trace")
+            _fr.record("serve", "serve.stream_poll", t_poll,
+                       time.monotonic(),
+                       attrs={"rid": rid, "tokens": len(fresh),
+                              "done": bool(out["done"])},
+                       trace=({"trace_id": tr[0], "parent": tr[1]}
+                              if tr else None))
         if out["done"]:
             rec["done"] = True
             self._release(rep)

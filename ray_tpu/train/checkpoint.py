@@ -31,6 +31,7 @@ import os
 import pickle
 import shutil
 import tempfile
+import time
 import zlib
 from typing import Any
 
@@ -259,6 +260,7 @@ def save_state(state: Any, path: str, *, process_index: int | None = None,
     import msgpack
     from jax.tree_util import tree_flatten
 
+    t_ckpt = time.monotonic()
     pid = jax.process_index() if process_index is None else process_index
     os.makedirs(path, exist_ok=True)
     leaves, treedef = tree_flatten(state)
@@ -301,6 +303,17 @@ def save_state(state: Any, path: str, *, process_index: int | None = None,
         if extra is not None:
             _write_with_checksum(path, "user.pkl", pickle.dumps(extra), sums)
     _flush_checksums(path, f"p{pid}", sums)
+    t_done = time.monotonic()
+    try:
+        from ray_tpu._private import flight_recorder as _fr
+        from ray_tpu.train import session as _sess
+
+        _sess._add_step_time("checkpoint", t_done - t_ckpt)
+        _fr.record("train", "train.checkpoint_save", t_ckpt, t_done,
+                   attrs={"path": path, "process": pid,
+                          "shards": len(shards)})
+    except Exception:  # noqa: BLE001 — observability best-effort
+        pass
     return Checkpoint(path)
 
 

@@ -23,12 +23,14 @@ quantization error step over step.
 
 from __future__ import annotations
 
+import time
 from typing import Any
 
 import numpy as np
 
 from ray_tpu._private import config
 from ray_tpu.collective import collective as _col
+from ray_tpu.train import session as _sess
 
 __all__ = ["dcn_allreduce_grads", "init_cross_slice_group",
            "reform_cross_slice_group"]
@@ -124,6 +126,7 @@ def dcn_allreduce_grads(grads: Any, group_name: str = "dcn", *,
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     if not leaves:
         return grads
+    t_coll = time.monotonic()
     np_leaves = [np.asarray(x) for x in leaves]
     bucket_bytes = int(bucket_bytes
                        or config.get("collective_bucket_bytes"))
@@ -144,4 +147,8 @@ def dcn_allreduce_grads(grads: Any, group_name: str = "dcn", *,
         for i, shape, n in members:
             out[i] = synced[pos:pos + n].reshape(shape)
             pos += n
+    # attribute the whole bucketed sync to the step's collective-wait
+    # segment (per-op rendezvous/chunk-wait detail lives in the ring's
+    # own "collective" spans)
+    _sess._add_step_time("collective", time.monotonic() - t_coll)
     return jax.tree_util.tree_unflatten(treedef, out)

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -90,7 +91,10 @@ class DataShard:
             if i in self._consumed:
                 continue
             self._consumed.add(i)
-            yield self._blocks[i]
+            t0 = time.monotonic()
+            block = self._blocks[i]
+            _add_step_time("data", time.monotonic() - t0)
+            yield block
         # fully drained (not broken out of): epoch boundary. The
         # `self.indices and` guard keeps an EMPTY assignment (fewer
         # blocks than ranks after a rebalance) from bumping the epoch
@@ -121,6 +125,13 @@ class _Session:
     )
     finished: threading.Event = field(default_factory=threading.Event)
     error: BaseException | None = None
+    # flight-recorder step instrumentation: report() closes a
+    # "train.step" span decomposed into the named wait segments
+    # accumulated via _add_step_time (collective / data / checkpoint);
+    # the remainder is compute
+    step_t0: float = field(default_factory=time.monotonic)
+    step_index: int = 0
+    step_segments: dict = field(default_factory=dict)
 
 
 _session: _Session | None = None
@@ -148,13 +159,84 @@ def _shutdown_session():
         _session = None
 
 
+_step_metrics_reg = None
+
+
+def _step_metrics():
+    global _step_metrics_reg
+    if _step_metrics_reg is None:
+        from ray_tpu.util import metrics as M
+
+        _step_metrics_reg = {
+            "step_s": M.Histogram(
+                "train_step_seconds",
+                "per-rank training step wall time (report to report)",
+                boundaries=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                            5.0, 15.0, 60.0),
+                tag_keys=("rank",)),
+            "seg_s": M.Counter(
+                "train_step_segment_seconds_total",
+                "cumulative step time by segment (compute / "
+                "collective / data / checkpoint), per rank — the "
+                "straggler-attribution signal",
+                tag_keys=("rank", "segment")),
+        }
+    return _step_metrics_reg
+
+
+def _add_step_time(segment: str, dt: float) -> None:
+    """Accumulate a named wait segment into the current step's
+    breakdown; no-op outside a training worker (serving/driver code
+    sharing the instrumented call sites)."""
+    s = _session
+    if s is None or dt <= 0:
+        return
+    s.step_segments[segment] = s.step_segments.get(segment, 0.0) + dt
+
+
+def _close_step(s: _Session, metrics: dict) -> None:
+    now = time.monotonic()
+    t0, segs = s.step_t0, s.step_segments
+    s.step_index += 1
+    s.step_segments = {}
+    dur = max(0.0, now - t0)
+    coll = segs.get("collective", 0.0)
+    data = segs.get("data", 0.0)
+    ckpt = segs.get("checkpoint", 0.0)
+    compute = max(0.0, dur - coll - data - ckpt)
+    try:
+        from ray_tpu._private import flight_recorder as _fr
+
+        _fr.record(
+            "train", "train.step", t0, now,
+            attrs={"rank": s.world_rank,
+                   "step": int(metrics.get("step", s.step_index)),
+                   "collective_wait_s": round(coll, 6),
+                   "data_wait_s": round(data, 6),
+                   "checkpoint_s": round(ckpt, 6),
+                   "compute_s": round(compute, 6)})
+        m = _step_metrics()
+        rank = str(s.world_rank)
+        m["step_s"].observe(dur, {"rank": rank})
+        for seg, v in (("compute", compute), ("collective", coll),
+                       ("data", data), ("checkpoint", ckpt)):
+            if v > 0:
+                m["seg_s"].inc(v, {"rank": rank, "segment": seg})
+    except Exception:  # noqa: BLE001 — observability best-effort
+        pass
+
+
 def report(metrics: dict, checkpoint=None) -> None:
     """Report metrics (and optionally a checkpoint) to the driver.
 
     Blocks until the driver has consumed the previous report (reference
     session.py:423 + result_queue(1))."""
     s = _get_session()
+    _close_step(s, metrics)
     s.results.put({"metrics": dict(metrics), "checkpoint": checkpoint})
+    # the next step starts once the driver unblocks us — the queue wait
+    # is driver backpressure, not this rank's step time
+    s.step_t0 = time.monotonic()
 
 
 def get_checkpoint():

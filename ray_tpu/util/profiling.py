@@ -24,13 +24,23 @@ import time
 
 @contextlib.contextmanager
 def profile(name: str, extra: dict | None = None):
+    from ray_tpu._private import flight_recorder as _fr
     from ray_tpu._private.api import _worker
 
-    start = time.time()
+    # monotonic for the duration (wall-clock deltas jump under clock
+    # adjustment); the flight recorder's single wall anchor converts to
+    # epoch seconds for the timeline
+    start_mono = time.monotonic()
     try:
         yield
     finally:
-        end = time.time()
+        end_mono = time.monotonic()
+        start = _fr.wall(start_mono)
+        end = start + (end_mono - start_mono)
+        # mirror into the local span ring (postmortem visibility); the
+        # head copy still rides the PROFILE event below
+        _fr.record("user", name, start_mono, end_mono,
+                   attrs=extra or {}, flush=False)
         w = _worker
         if w is not None:
             try:

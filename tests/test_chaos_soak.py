@@ -188,9 +188,15 @@ def _run_seed(cluster, tmp_path, seed: int, deadline_s: float):
         _assert_no_leaks(cluster)
         return result, elapsed
     except BaseException:
-        # replay instructions for the exact failure
+        # replay instructions for the exact failure, plus the flight-
+        # recorder postmortems (victim + survivor span rings)
+        from ray_tpu._private import flight_recorder
+
+        bundles = flight_recorder.latest_bundles()
         print(f"\nCHAOS SOAK FAILURE {plan.describe()}\n"
-              f"replay: RAY_TPU_FAULT_SPEC='{plan.env_value()}'\n",
+              f"replay: RAY_TPU_FAULT_SPEC='{plan.env_value()}'\n"
+              f"flight-recorder bundles ({flight_recorder.bundle_dir()}):\n"
+              + "".join(f"  {b}\n" for b in bundles),
               file=sys.stderr, flush=True)
         raise
     finally:

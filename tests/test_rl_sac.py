@@ -92,6 +92,7 @@ def cluster():
     c.shutdown()
 
 
+@pytest.mark.slow  # ~50s of SAC updates; tier-1 has an 870s budget
 def test_sac_learns_pendulum(cluster):
     """SAC must climb far above the random-policy baseline (~-1200 avg
     return) on Pendulum — the swing-up is effectively solved around

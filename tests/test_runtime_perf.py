@@ -184,6 +184,42 @@ def test_pipelined_pull_2x_sequential_under_latency():
         c.shutdown()
 
 
+def test_recorded_obs_family_floors():
+    """ISSUE-14 acceptance: the committed `obs` runtime_perf family must
+    show the always-on flight recorder costing <= 3% on ring allreduce
+    and serve decode throughput, with a healthy span-record rate."""
+    rec = _recorded_bench()
+    spans = rec["obs span record throughput (ring only)"]
+    # measured ~820k spans/s on the dev box; even a 5x-slower CI box
+    # clears this with room — per-op spans cost microseconds
+    assert spans["per_s"] >= 100_000, spans
+    for name in ("obs overhead: ring allreduce 16MB (4 ranks)",
+                 "obs overhead: serve pool decode (1 replica)"):
+        r = rec[name]
+        assert r["overhead_pct"] <= 3.0, r
+        assert r["baseline_per_s"] > 0, r
+
+
+def test_live_span_record_throughput_floor():
+    """Ring-only record() (the per-chunk hot-path form) must stay
+    cheap: >= 50k spans/s live, ~16x under the recorded dev-box rate."""
+    import time as _time
+
+    from ray_tpu._private import flight_recorder as fr
+
+    n = 20_000
+    t = _time.monotonic()
+    fr.record("bench", "warm", t, t, flush=False)
+    t0 = _time.perf_counter()
+    for _ in range(n):
+        fr.record("bench", "floor", t, t, flush=False)
+    dt = _time.perf_counter() - t0
+    assert n / dt >= 50_000, f"{n / dt:.0f} spans/s"
+    # ring stays bounded regardless of volume
+    st = fr.stats()
+    assert st["ring_len"] <= st["ring_cap"]
+
+
 def test_task_throughput_floors(cluster):
     @ray_tpu.remote(num_cpus=0)
     def noop():

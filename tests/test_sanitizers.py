@@ -14,6 +14,7 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCRIPT = os.path.join(ROOT, "ray_tpu", "_native", "sanitize", "run.sh")
 
 
+@pytest.mark.slow  # ~90s of sanitizer builds; tier-1 has an 870s budget
 @pytest.mark.skipif(shutil.which("g++") is None, reason="no toolchain")
 def test_sanitizers_clean(tmp_path):
     out = str(tmp_path / "SANITIZE.json")

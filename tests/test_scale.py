@@ -26,6 +26,7 @@ def cluster():
     c.shutdown()
 
 
+@pytest.mark.slow  # ~60s drain; tier-1 has an 870s budget
 def test_100k_queued_task_drain(cluster):
     """100k num_cpus=0 tasks queued and drained with no failures and no
     degradation: the second half must drain at a comparable rate to the

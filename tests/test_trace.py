@@ -84,6 +84,124 @@ def test_timeline_renders_flow_arrows(cluster):
     assert any(t["args"].get("parent_span") for t in spans)
 
 
+def _span_events(kind, name_prefix, n=1, timeout=30, match=None):
+    """Wait for >= n flight-recorder SPAN events of `kind` whose name
+    starts with `name_prefix` (driver-side pending spans are flushed on
+    every poll; worker-side ones ride their 0.5s flushers)."""
+    from ray_tpu._private import flight_recorder
+
+    evs = []
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        flight_recorder.flush_now()
+        evs = [e for e in ray_tpu.list_tasks(limit=5000)
+               if e.get("state") == "SPAN" and e.get("kind") == kind
+               and e.get("name", "").startswith(name_prefix)
+               and (match is None or match(e))]
+        if len(evs) >= n:
+            return evs
+        time.sleep(0.25)
+    raise AssertionError(
+        f"only {len(evs)}/{n} {kind}:{name_prefix} spans arrived")
+
+
+def test_serve_stream_spans_share_one_trace(cluster):
+    """Satellite (d): one trace id covers submit -> prefill worker ->
+    decode replica -> stream poll. The prompt length crosses
+    prefill_threshold so the request traverses the DISAGGREGATED path:
+    admission wait + prefill + KV handoff + first token + poll spans
+    all carry the stream's trace."""
+    import numpy as np
+
+    from ray_tpu.serve.llm_pool import LLMPool
+
+    pool = LLMPool(model_size="tiny", slots=2, max_len=96,
+                   chunk_tokens=4, prompt_buckets=(8, 16),
+                   min_replicas=1, max_replicas=1, prefill_workers=1,
+                   prefill_threshold=12, autoscale=False)
+    try:
+        prompt = np.random.RandomState(11).randint(
+            1, 256, size=14).tolist()
+        sub = pool.submit_stream({"prompt_ids": prompt, "max_tokens": 8})
+        rid = sub["rid"]
+        tid = pool._streams[rid]["trace"][0]
+        deadline = time.time() + 120
+        toks = []
+        while time.time() < deadline:
+            out = pool.poll_stream(rid)
+            toks.extend(out["tokens"])
+            if out["done"]:
+                break
+            time.sleep(0.02)
+        assert len(toks) == 8
+
+        segments = ["serve.admission_wait", "serve.prefill",
+                    "serve.kv_handoff", "serve.first_token",
+                    "serve.stream_poll"]
+        for name in segments:
+            evs = _span_events("serve", name, n=1, match=lambda e: (
+                (e.get("trace") or {}).get("trace_id") == tid))
+            assert evs, name
+        # the prefill span reports the KV payload it shipped
+        pf = _span_events("serve", "serve.prefill")[0]
+        assert pf["attrs"]["kv_bytes"] > 0
+        assert pf["attrs"]["prompt_tokens"] == len(prompt)
+    finally:
+        pool.shutdown()
+
+
+def test_ring_collective_op_records_breakdown_span(cluster):
+    """Satellite (d): a ring allreduce submitted under one driver trace
+    leaves per-rank `collective` spans carrying that trace id and the
+    rendezvous / chunk-wait / send / compute breakdown."""
+    import numpy as np
+
+    from ray_tpu._private import trace as _trace
+
+    @ray_tpu.remote(num_cpus=0)
+    class Rank:
+        def init(self, world, rank, name):
+            from ray_tpu.collective import init_collective_group
+
+            init_collective_group(world, rank, group_name=name)
+            self.group = name
+
+        def ar(self):
+            from ray_tpu._private import flight_recorder
+            from ray_tpu._private import trace as tr
+            from ray_tpu.collective import collective as col
+
+            col.allreduce(np.ones(4096, np.float32), self.group,
+                          timeout=60.0)
+            flight_recorder.flush_now()
+            return tr.current()[0]
+
+    ranks = [Rank.remote() for _ in range(2)]
+    group = "trace-ring"
+    ray_tpu.get([a.init.remote(2, r, group)
+                 for r, a in enumerate(ranks)], timeout=120)
+    with _trace.root_scope() as (tid, _span):
+        tids = ray_tpu.get([a.ar.remote() for a in ranks], timeout=120)
+    assert set(tids) == {tid}  # both ranks executed inside OUR trace
+
+    evs = _span_events("collective", "collective.", n=2, match=lambda e: (
+        e["attrs"].get("group") == group))
+    assert {e["attrs"]["rank"] for e in evs} == {0, 1}
+    for e in evs:
+        assert (e.get("trace") or {}).get("trace_id") == tid
+        a = e["attrs"]
+        assert a["world_size"] == 2 and a["chunks"] >= 2
+        assert a["bytes_sent"] > 0 and a["bytes_recv"] > 0
+        for k in ("rendezvous_s", "chunk_wait_s", "send_s", "compute_s"):
+            assert a[k] >= 0.0, (k, a)
+        # the breakdown never exceeds the span it decomposes
+        dur = e["end_s"] - e["start_s"]
+        assert a["chunk_wait_s"] + a["send_s"] + a["compute_s"] <= \
+            dur + 0.05
+    for a in ranks:
+        ray_tpu.kill(a)
+
+
 def test_actor_calls_carry_trace(cluster):
     from ray_tpu._private import trace as _trace
 
