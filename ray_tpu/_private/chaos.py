@@ -71,7 +71,7 @@ SITE_ACTIONS: dict[str, list[tuple[str, float]]] = {
 # sites that fire in the driver/agent process rather than a train worker
 DRIVER_SITES = frozenset(
     {"agent.heartbeat", "object.read_chunk", "worker.lease_push",
-     "rl.rollout"})
+     "rl.rollout", "net.pace"})
 
 # ---- the serving-pool / RL-loop fault surface (profile="rl") ----
 #
@@ -103,6 +103,28 @@ RL_SITE_ACTIONS: dict[str, list[tuple[str, float]]] = {
 # pool's actor processes load it on first fire), not via train-loop
 # config or driver configure()
 SERVE_SITES = frozenset({"serve.replica_pump", "serve.prefill"})
+
+# ---- the multi-tenant QoS fault surface (profile="qos") ----
+#
+# Sweeps the outbound pacer and the paths it gates: ``net.pace`` trips
+# inside net_qos.try_acquire/acquire (drop raises the typed retryable
+# NetPaceError; delay/stall lengthen a grant without holding the pacer
+# lock — the classic "pacing stall" a saturated link produces), plus
+# the serve-side chunk refusal path and the serve/prefill actors whose
+# death must purge pacer state rather than leave peers throttled
+# forever. Every action here is recoverable by design: the qos soak
+# asserts liveness (no deadlock, no permanent throttle), not restarts.
+QOS_SITE_WEIGHTS: dict[str, float] = {
+    "net.pace": 3.0,             # pacer grant drop/delay/stall
+    "object.read_chunk": 1.5,    # paced bulk serve refusal
+    "serve.replica_pump": 1.0,   # replica death with queued tenants
+    "serve.prefill": 0.75,       # prefill death mid KV handoff
+    "ring.send": 1.0,            # gang traffic sharing the paced link
+}
+
+QOS_SITE_ACTIONS: dict[str, list[tuple[str, float]]] = {
+    "net.pace": [("drop", 2.0), ("delay", 2.0), ("stall", 1.0)],
+}
 
 
 @dataclass
@@ -161,6 +183,12 @@ def gen_fault_plan(seed: int, *, world_size: int = 2,
     exhausted kill — plans stay finite. The default "train" profile is
     byte-identical to the pre-RL expansion for every seed, keeping the
     existing soak's fixed seeds replayable.
+
+    ``profile="qos"`` sweeps the multi-tenant pacing surface
+    (QOS_SITE_WEIGHTS): pacer grant drops/delays/stalls (``net.pace``),
+    paced chunk-serve refusals, and serve-actor deaths that must purge
+    pacer state — every action recoverable, so qos soaks assert
+    liveness under pacing faults rather than process recovery.
     """
     rng = random.Random(seed)
     if profile == "rl":
@@ -168,6 +196,11 @@ def gen_fault_plan(seed: int, *, world_size: int = 2,
         if n_prefill <= 0:
             default_weights.pop("serve.prefill", None)
         actions = {**SITE_ACTIONS, **RL_SITE_ACTIONS}
+    elif profile == "qos":
+        default_weights = dict(QOS_SITE_WEIGHTS)
+        if n_prefill <= 0:
+            default_weights.pop("serve.prefill", None)
+        actions = {**SITE_ACTIONS, **RL_SITE_ACTIONS, **QOS_SITE_ACTIONS}
     elif profile == "train":
         default_weights = SITE_WEIGHTS
         actions = SITE_ACTIONS
@@ -202,7 +235,7 @@ def gen_fault_plan(seed: int, *, world_size: int = 2,
             spec["after"] = rng.randrange(0, 4)
         else:
             spec["after"] = rng.randrange(0, 6)
-        if action == "delay":
+        if action in ("delay", "stall"):
             spec["delay_s"] = round(rng.uniform(0.05, 0.3), 3)
         if site in SERVE_SITES:
             plan.serve_specs.append(spec)

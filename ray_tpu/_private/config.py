@@ -150,6 +150,22 @@ _DEFS: dict[str, Any] = {
     # thread to unwind (after abort_all_local wakes it) before declaring
     # the survivor wedged and falling back to a gang restart
     "train_quiesce_timeout_s": 30.0,
+    # -- outbound QoS pacer (_private/net_qos.py) --
+    # master switch: every tagged send path consults the pacer (with an
+    # unlimited rate this is just a per-peer tally)
+    "net_qos_enabled": True,
+    # per-peer pacing rate in megabits/s; 0 = unlimited (no parking,
+    # no preemption — enforcement engages only under a finite rate)
+    "net_qos_rate_mbps": 0.0,
+    # token-bucket capacity per peer in bytes; 0 = auto (one refill
+    # interval at the configured rate, floored at 4MB)
+    "net_qos_window_bytes": 0,
+    # guaranteed bulk fraction of each window interval: bulk may take
+    # this share even while higher classes wait (anti-starvation)
+    "net_qos_bulk_share": 0.2,
+    # blocking-acquire deadline — a wedged window fails typed
+    # (NetPaceError, retryable) instead of hanging the sender
+    "net_qos_grant_timeout_s": 30.0,
     # -- fault injection (chaos tests) --
     # JSON list of injection specs (see _private/fault_injection.py);
     # declared here so set_system_config propagates it to spawned

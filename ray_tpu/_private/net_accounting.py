@@ -1,20 +1,26 @@
 """Per-link byte attribution: who moved how many bytes to whom, and why.
 
 Every outbound transfer at the rpc/agent layer is tagged with
-``{peer, qos_class, owner}``:
+``{peer, qos_class, owner, tenant}``:
 
 * ``peer`` — the remote endpoint label (node-id prefix, ``group:rank``
   for ring chunks, or a role like ``prefill``),
 * ``qos_class`` — traffic class: ``collective`` (ring chunks), ``bulk``
   (object pulls/serves), ``kv`` (prefill->decode KV handoffs),
-* ``owner`` — the tenant: the object's owner worker, the collective
-  group name, or the serving engine.
+* ``owner`` — the resource principal: the object's owner worker, the
+  collective group name, or the serving engine,
+* ``tenant`` — the serving tenant the bytes were moved FOR (``-`` for
+  non-serve traffic); the dimension per-tenant SLO verdicts group by.
 
 Exported as ``net_tx_bytes_total`` / ``net_rx_bytes_total`` counters
 (the exact signal a contention-aware scheduler consumes) plus a
 per-peer ``net_inflight_bytes`` gauge. A process-local synchronous
 tally (:func:`local_totals`) backs tests that must compare attribution
 against wire accounting without waiting on metric flush periods.
+
+The enforcement half of these tags lives in ``net_qos.py``: the same
+{peer, qos_class} identity keyed here is what the outbound pacer
+prioritizes and preempts on.
 """
 
 from __future__ import annotations
@@ -25,13 +31,13 @@ from ray_tpu.util.metrics import Counter, Gauge
 
 _tx = Counter(
     "net_tx_bytes_total",
-    "Outbound transfer bytes by peer, traffic class, and owner.",
-    tag_keys=("peer", "qos_class", "owner"),
+    "Outbound transfer bytes by peer, traffic class, owner, and tenant.",
+    tag_keys=("peer", "qos_class", "owner", "tenant"),
 )
 _rx = Counter(
     "net_rx_bytes_total",
-    "Inbound transfer bytes by peer, traffic class, and owner.",
-    tag_keys=("peer", "qos_class", "owner"),
+    "Inbound transfer bytes by peer, traffic class, owner, and tenant.",
+    tag_keys=("peer", "qos_class", "owner", "tenant"),
 )
 _inflight = Gauge(
     "net_inflight_bytes",
@@ -40,7 +46,7 @@ _inflight = Gauge(
 )
 
 _lock = threading.Lock()
-# (direction, peer, qos_class, owner) -> bytes
+# (direction, peer, qos_class, owner, tenant) -> bytes
 _local: dict[tuple, int] = {}
 
 
@@ -52,23 +58,27 @@ def _on() -> bool:
     return _fr._on()
 
 
-def account_tx(peer: str, qos_class: str, owner: str, nbytes: int) -> None:
+def account_tx(peer: str, qos_class: str, owner: str, nbytes: int,
+               tenant: str = "-") -> None:
     if nbytes <= 0 or not _on():
         return
-    tags = {"peer": peer, "qos_class": qos_class, "owner": owner}
+    tags = {"peer": peer, "qos_class": qos_class, "owner": owner,
+            "tenant": tenant}
     _tx.inc(nbytes, tags)
     with _lock:
-        k = ("tx", peer, qos_class, owner)
+        k = ("tx", peer, qos_class, owner, tenant)
         _local[k] = _local.get(k, 0) + int(nbytes)
 
 
-def account_rx(peer: str, qos_class: str, owner: str, nbytes: int) -> None:
+def account_rx(peer: str, qos_class: str, owner: str, nbytes: int,
+               tenant: str = "-") -> None:
     if nbytes <= 0 or not _on():
         return
-    tags = {"peer": peer, "qos_class": qos_class, "owner": owner}
+    tags = {"peer": peer, "qos_class": qos_class, "owner": owner,
+            "tenant": tenant}
     _rx.inc(nbytes, tags)
     with _lock:
-        k = ("rx", peer, qos_class, owner)
+        k = ("rx", peer, qos_class, owner, tenant)
         _local[k] = _local.get(k, 0) + int(nbytes)
 
 
@@ -78,13 +88,14 @@ def set_inflight(peer: str, nbytes: int) -> None:
 
 def local_totals(direction: str | None = None, *, peer: str | None = None,
                  qos_class: str | None = None,
-                 owner: str | None = None) -> dict[tuple, int]:
+                 owner: str | None = None,
+                 tenant: str | None = None) -> dict[tuple, int]:
     """Filtered snapshot of this process's synchronous byte tally,
-    keyed by (direction, peer, qos_class, owner)."""
+    keyed by (direction, peer, qos_class, owner, tenant)."""
     with _lock:
         items = list(_local.items())
     out = {}
-    for (d, p, q, o), v in items:
+    for (d, p, q, o, t), v in items:
         if direction is not None and d != direction:
             continue
         if peer is not None and p != peer:
@@ -93,7 +104,9 @@ def local_totals(direction: str | None = None, *, peer: str | None = None,
             continue
         if owner is not None and o != owner:
             continue
-        out[(d, p, q, o)] = v
+        if tenant is not None and t != tenant:
+            continue
+        out[(d, p, q, o, t)] = v
     return out
 
 

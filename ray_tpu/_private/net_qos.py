@@ -1,0 +1,399 @@
+"""Per-peer outbound QoS pacing: the ENFORCEMENT half of byte
+attribution.
+
+`net_accounting.py` (PR 14) tags every outbound transfer with
+``{peer, qos_class, owner}``; this module acts on those tags. Every
+tagged send path (ring chunk emission, object-chunk serving, pull
+issue, KV handoffs, weight publishes) asks the scheduler for a grant
+before putting bytes on the wire:
+
+* **Token-bucket window per peer.** Each peer label gets an
+  independent bucket refilled at ``net_qos_rate_bytes_per_s`` up to
+  ``net_qos_window_bytes`` capacity. One stalled or flooded peer paces
+  only its own traffic — buckets never interact.
+* **Strict priority** ``kv`` (latency-critical KV handoffs / streaming
+  tokens) > ``collective`` (ring chunks) > ``bulk`` (spill,
+  checkpoint, generic object pulls). A grant parks while any strictly
+  higher class is waiting on the same peer.
+* **Chunk-granularity bulk preemption.** A multi-chunk bulk transfer
+  acquires per chunk; when a higher class arrives mid-transfer its
+  next chunk PARKS (the agent surfaces the park as the retryable
+  ``{"busy": True}`` refusal the pull path already resumes from), so
+  bulk yields at chunk boundaries and resumes byte-identically — the
+  puller re-requests the same offset, never restarts the object.
+* **Bounded bulk share** (anti-starvation): within each refill
+  interval bulk may take up to ``net_qos_bulk_share`` of the window
+  EVEN when higher classes are waiting, so background traffic always
+  progresses.
+* **Chaos safety.** Grants are leases on tokens, nothing is held
+  open: a dead peer's exhausted bucket is purged on the node-death /
+  ``destroy_collective_group`` paths (and by an idle TTL sweep), every
+  blocking acquire has a deadline, and a wedged grant path fails with
+  the typed, retryable :class:`NetPaceError` instead of deadlocking.
+
+The ``net.pace`` fault-injection site fires on every grant decision
+(``drop`` -> typed refusal, ``delay``/``stall`` -> slow grant), so
+chaos plans can wedge the pacer itself and prove transfers abort
+typed-and-retryable.
+
+With the default unlimited rate (``net_qos_rate_mbps = 0``) the
+scheduler is a cheap per-peer tally — priority and preemption engage
+only when a finite rate makes the link a contended resource, which is
+exactly when they are meaningful.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ray_tpu._private import config as _cfg
+from ray_tpu._private import fault_injection as _fi
+
+CLASSES = ("kv", "collective", "bulk")
+_PRIO = {"kv": 0, "collective": 1, "bulk": 2}
+
+# idle per-peer state older than this is dropped by the lazy sweep: a
+# peer that died without an explicit purge cannot pin an exhausted
+# window (or its stats) forever
+PEER_IDLE_TTL_S = 300.0
+
+
+class NetPaceError(RuntimeError):
+    """Typed, RETRYABLE pacing failure: the grant deadline expired (or
+    a ``net.pace`` drop injection refused the window). The transfer
+    should back off and retry — never treat this as data loss."""
+
+    retryable = True
+
+    def __init__(self, peer: str, qos_class: str, msg: str):
+        self.peer = peer
+        self.qos_class = qos_class
+        super().__init__(
+            f"net_qos: {qos_class} grant for peer {peer!r} {msg}")
+
+
+class _Peer:
+    """One peer label's bucket + waiter bookkeeping (guarded by the
+    module lock; the condition shares it so grants wake parked
+    waiters)."""
+
+    __slots__ = ("tokens", "stamp", "interval_start", "interval_grants",
+                 "waiting", "granted", "grants", "parks", "preempts",
+                 "last_used", "cond")
+
+    def __init__(self, capacity: float, lock: threading.Lock):
+        self.tokens = capacity
+        self.stamp = time.monotonic()
+        self.interval_start = self.stamp
+        self.interval_grants = [0, 0, 0]   # bytes granted per class
+        self.waiting = [0, 0, 0]           # blocked acquires per class
+        self.granted = [0, 0, 0]           # lifetime bytes per class
+        self.grants = [0, 0, 0]            # lifetime grant count
+        self.parks = [0, 0, 0]             # denials: window exhausted
+        self.preempts = 0                  # bulk parked BY a higher class
+        self.last_used = self.stamp
+        self.cond = threading.Condition(lock)
+
+
+_lock = threading.Lock()
+_peers: dict[str, _Peer] = {}
+_metrics = None
+
+
+def _get_metrics():
+    global _metrics
+    if _metrics is None:
+        from ray_tpu.util import metrics as M
+
+        _metrics = {
+            "granted": M.Counter(
+                "net_qos_granted_bytes_total",
+                "bytes granted by the outbound pacer",
+                tag_keys=("peer", "qos_class")),
+            "parks": M.Counter(
+                "net_qos_parks_total",
+                "grant denials (window exhausted or preempted)",
+                tag_keys=("peer", "qos_class")),
+            "preempts": M.Counter(
+                "net_qos_bulk_preemptions_total",
+                "bulk chunks parked because a higher class was waiting",
+                tag_keys=("peer",)),
+        }
+    return _metrics
+
+
+def _rate_bytes_per_s() -> float:
+    return float(_cfg.get("net_qos_rate_mbps")) * 1e6 / 8.0
+
+
+def _capacity(rate: float) -> float:
+    cap = int(_cfg.get("net_qos_window_bytes"))
+    if cap > 0:
+        return float(cap)
+    # auto: one refill interval's worth of tokens, floored at 4MB so a
+    # slow link still admits a whole default object chunk
+    return max(4 * 2**20, rate * 0.25)
+
+
+def enforced() -> bool:
+    """True when a finite rate makes pacing (priority, preemption,
+    floors) active; False = unlimited fast path (tally only)."""
+    return bool(_cfg.get("net_qos_enabled")) and _rate_bytes_per_s() > 0
+
+
+def enabled() -> bool:
+    return bool(_cfg.get("net_qos_enabled"))
+
+
+def _peer_state(peer: str, rate: float) -> _Peer:
+    s = _peers.get(peer)
+    if s is None:
+        s = _peers[peer] = _Peer(_capacity(rate), _lock)
+        if len(_peers) > 64:
+            _sweep_locked()
+    return s
+
+
+def _sweep_locked() -> None:
+    now = time.monotonic()
+    for k, s in list(_peers.items()):
+        if now - s.last_used > PEER_IDLE_TTL_S and not any(s.waiting):
+            del _peers[k]
+
+
+def _refill(s: _Peer, rate: float, now: float) -> None:
+    cap = _capacity(rate)
+    s.tokens = min(cap, s.tokens + rate * max(0.0, now - s.stamp))
+    s.stamp = now
+    # interval = one bucket drain time: the bulk floor resets with it
+    interval = max(0.05, cap / rate) if rate > 0 else 1.0
+    if now - s.interval_start >= interval:
+        s.interval_start = now
+        s.interval_grants = [0, 0, 0]
+
+
+def _admissible(s: _Peer, prio: int, nbytes: int, rate: float) -> bool:
+    """Grant check under the lock (tokens already refilled).
+
+    Strict priority: park while any strictly-higher class has waiters
+    on this peer — EXCEPT bulk inside its guaranteed per-interval floor
+    (the anti-starvation share)."""
+    higher_waiting = any(s.waiting[q] for q in range(prio))
+    if higher_waiting:
+        if prio == _PRIO["bulk"]:
+            floor = float(_cfg.get("net_qos_bulk_share")) * _capacity(rate)
+            if s.interval_grants[prio] + nbytes > floor:
+                return False
+        else:
+            return False
+    return s.tokens >= nbytes
+
+
+def _grant_locked(s: _Peer, prio: int, nbytes: int, now: float) -> None:
+    s.tokens -= nbytes
+    s.interval_grants[prio] += nbytes
+    s.granted[prio] += nbytes
+    s.grants[prio] += 1
+    s.last_used = now
+
+
+def _retry_hint(s: _Peer, prio: int, nbytes: int, rate: float) -> float:
+    """Seconds until this grant plausibly succeeds — the agent returns
+    it as ``retry_after_s`` on the busy-refusal park path."""
+    if rate <= 0:
+        return 0.05
+    short = max(0.0, nbytes - s.tokens) / rate
+    return min(2.0, max(0.02, short if short > 0 else 0.05))
+
+
+def _fire_site(peer: str, qos_class: str, nbytes: int):
+    """The ``net.pace`` chaos site (sync callers). Returns the action;
+    ``delay``/``stall`` already slept inside fire()."""
+    if not _fi.enabled():
+        return None
+    return _fi.fire("net.pace", peer=peer, qos=qos_class, nbytes=nbytes)
+
+
+def try_acquire(peer: str, qos_class: str, nbytes: int, *,
+                owner: str = "unknown") -> float:
+    """Non-blocking grant. Returns 0.0 when granted, else a positive
+    ``retry_after_s`` hint — the caller parks (the agent's serve path
+    turns the hint into the retryable ``{"busy": True}`` refusal, which
+    is how an in-flight bulk transfer is preempted at chunk granularity
+    and later resumes byte-identically). Raises :class:`NetPaceError`
+    on an injected ``net.pace`` drop."""
+    if not enabled() or nbytes <= 0:
+        return 0.0
+    prio = _PRIO.get(qos_class, _PRIO["bulk"])
+    if _fi.enabled():
+        act, delay_s = _fi.fire_async(
+            "net.pace", peer=peer, qos=qos_class, nbytes=nbytes)
+        if act == "drop":
+            raise NetPaceError(peer, qos_class, "refused by injection")
+        if act in ("delay", "stall"):
+            # async-safe park: surface the injected latency as the
+            # retry hint instead of sleeping on the caller's loop
+            return max(0.01, delay_s)
+    rate = _rate_bytes_per_s()
+    now = time.monotonic()
+    with _lock:
+        s = _peer_state(peer, rate)
+        s.last_used = now
+        if rate <= 0:
+            _grant_locked(s, prio, nbytes, now)
+            return 0.0
+        _refill(s, rate, now)
+        if _admissible(s, prio, nbytes, rate):
+            _grant_locked(s, prio, nbytes, now)
+            s.cond.notify_all()
+            return 0.0
+        s.parks[prio] += 1
+        preempted = (prio == _PRIO["bulk"]
+                     and any(s.waiting[q] for q in range(prio)))
+        if preempted:
+            s.preempts += 1
+        hint = _retry_hint(s, prio, nbytes, rate)
+    try:
+        m = _get_metrics()
+        m["parks"].inc(1, {"peer": peer, "qos_class": qos_class})
+        if preempted:
+            m["preempts"].inc(1, {"peer": peer})
+    except Exception:  # noqa: BLE001 — accounting never blocks pacing
+        pass
+    return hint
+
+
+def acquire(peer: str, qos_class: str, nbytes: int, *,
+            owner: str = "unknown", timeout: float | None = None,
+            poll=None) -> None:
+    """Blocking grant for sync send paths (ring chunk emission, serve
+    KV handoffs). Waits with a deadline — NEVER unbounded, so a wedged
+    window fails typed instead of hanging the sender. ``poll`` (if
+    given) runs between waits; ring sends pass their abort poll so a
+    collective abort wakes a parked sender immediately."""
+    if not enabled() or nbytes <= 0:
+        return
+    prio = _PRIO.get(qos_class, _PRIO["bulk"])
+    act = _fire_site(peer, qos_class, nbytes)
+    if act == "drop":
+        raise NetPaceError(peer, qos_class, "refused by injection")
+    rate = _rate_bytes_per_s()
+    now = time.monotonic()
+    if timeout is None:
+        timeout = float(_cfg.get("net_qos_grant_timeout_s"))
+    deadline = now + max(0.0, timeout)
+    with _lock:
+        s = _peer_state(peer, rate)
+        s.last_used = now
+        if rate <= 0:
+            _grant_locked(s, prio, nbytes, now)
+            return
+        _refill(s, rate, now)
+        if _admissible(s, prio, nbytes, rate):
+            _grant_locked(s, prio, nbytes, now)
+            s.cond.notify_all()
+            return
+        s.parks[prio] += 1
+        if prio == _PRIO["bulk"] and any(s.waiting[q] for q in range(prio)):
+            s.preempts += 1
+        s.waiting[prio] += 1
+        try:
+            while True:
+                now = time.monotonic()
+                if now >= deadline:
+                    raise NetPaceError(
+                        peer, qos_class,
+                        f"not granted within {timeout:.1f}s "
+                        f"({nbytes} bytes, tokens={s.tokens:.0f})")
+                # short slices so abort polls and deadline checks stay
+                # responsive even when no grant ever notifies
+                s.cond.wait(timeout=min(0.05, deadline - now))
+                if poll is not None:
+                    poll()
+                _refill(s, rate, time.monotonic())
+                if _admissible(s, prio, nbytes, rate):
+                    _grant_locked(s, prio, nbytes, time.monotonic())
+                    s.cond.notify_all()
+                    return
+        finally:
+            s.waiting[prio] -= 1
+            s.cond.notify_all()
+
+
+async def acquire_async(peer: str, qos_class: str, nbytes: int, *,
+                        owner: str = "unknown",
+                        timeout: float | None = None) -> None:
+    """Event-loop-friendly acquire for the agent's pull-issue path:
+    parks with ``await asyncio.sleep`` (never blocks the loop), bounded
+    by the grant deadline, failing typed."""
+    import asyncio
+
+    if not enabled() or nbytes <= 0:
+        return
+    if timeout is None:
+        timeout = float(_cfg.get("net_qos_grant_timeout_s"))
+    deadline = time.monotonic() + max(0.0, timeout)
+    while True:
+        hint = try_acquire(peer, qos_class, nbytes, owner=owner)
+        if hint <= 0:
+            return
+        if time.monotonic() + hint > deadline:
+            raise NetPaceError(
+                peer, qos_class, f"not granted within {timeout:.1f}s")
+        await asyncio.sleep(hint)
+
+
+def purge_peer(peer: str) -> bool:
+    """Drop a peer's pacer/window state (node death, group teardown —
+    the PR 1 mailbox/KV purge discipline). An exhausted window must
+    never throttle a reused address: the next acquire starts from a
+    full fresh bucket. Parked waiters are woken so they re-evaluate
+    against the fresh state (their sends then fail or succeed on their
+    own transport, not on stale pacing)."""
+    with _lock:
+        s = _peers.pop(peer, None)
+        if s is None:
+            return False
+        s.cond.notify_all()
+    return True
+
+
+def purge_group_peers(group_name: str) -> int:
+    """Purge every ``group:rN`` peer label of a destroyed collective
+    group. Node-id-labelled ring peers are covered by the node-death
+    purge path."""
+    with _lock:
+        victims = [k for k in _peers if k.startswith(f"{group_name}:r")]
+        for k in victims:
+            s = _peers.pop(k)
+            s.cond.notify_all()
+    return len(victims)
+
+
+def stats(peer: str | None = None) -> dict:
+    """Per-peer snapshot: bytes/grants/parks per class, preemptions —
+    the falsifiability surface the QoS tests assert on."""
+    with _lock:
+        items = ([(peer, _peers[peer])] if peer is not None
+                 and peer in _peers else
+                 list(_peers.items()) if peer is None else [])
+        out = {}
+        for k, s in items:
+            out[k] = {
+                "tokens": round(s.tokens, 1),
+                "granted_bytes": {c: s.granted[_PRIO[c]] for c in CLASSES},
+                "grants": {c: s.grants[_PRIO[c]] for c in CLASSES},
+                "parks": {c: s.parks[_PRIO[c]] for c in CLASSES},
+                "waiting": {c: s.waiting[_PRIO[c]] for c in CLASSES},
+                "preemptions": s.preempts,
+            }
+    return out.get(peer, {}) if peer is not None else out
+
+
+def reset() -> None:
+    """Test helper: drop ALL pacer state (wakes any waiters)."""
+    with _lock:
+        for s in _peers.values():
+            s.cond.notify_all()
+        _peers.clear()

@@ -511,6 +511,280 @@ def run_rl_benchmarks(*, quick: bool = False) -> list[dict]:
     return results
 
 
+def run_qos_benchmarks(*, quick: bool = False) -> list[dict]:
+    """The `qos` family: multi-tenant pacing under contention.
+
+    - pacer grant fast path: ops/s of the unlimited-rate tally path —
+      what EVERY tagged send pays when enforcement is off (rate=0);
+    - serve contention floors: a tenant's pool decode tokens/s and TTFT
+      p99 while a learner gang (paced collective sends) and a bulk
+      object spill (paced chunk pulls) saturate the same host, vs the
+      same workload uncontended. The committed floors: per-tenant
+      tokens/s >= 0.7x uncontended, TTFT p99 <= 2x uncontended, the
+      bulk transfer still completes byte-identical, and byte
+      attribution stays within 1% with the pacer ON;
+    - batched stream fanout: aggregate sampled-stream tokens/s across
+      concurrent rollouts with the per-REPLICA batched poll surface,
+      plus the replica-side poll-RPC count it amortizes."""
+    import os as _os
+    import threading
+    import uuid
+
+    from ray_tpu._private import config as _cfg
+    from ray_tpu._private import net_accounting as _net
+    from ray_tpu._private import net_qos as _qos
+    from ray_tpu._private.rpc import EventLoopThread
+    from ray_tpu.core.control_plane import ControlPlane
+    from ray_tpu.core.node_agent import NodeAgent
+    from ray_tpu.serve.llm_pool import LLMPool
+
+    results = []
+
+    # ---- pacer grant fast path (enforcement off: pure tally) ----
+    _qos.reset()
+    results.append(timeit(
+        "qos pacer grant (unlimited fast path)",
+        lambda: _qos.try_acquire("bench-peer", "bulk", 65536,
+                                 owner="bench"),
+        windows=1 if quick else 3))
+    print(json.dumps(results[-1]), flush=True)
+    _qos.reset()
+
+    # ---- serve contention floors (tenant vs gang + bulk spill) ----
+    prompt_len, new_tokens, chunk_delay = 16, 96, 0.05
+    n_requests = 8 if quick else 16
+    concurrency = 8
+    pool = LLMPool(
+        model_size="tiny", slots=8, max_len=128, chunk_tokens=8,
+        prompt_buckets=(prompt_len,), min_replicas=2, max_replicas=2,
+        chunk_delay_s=chunk_delay, autoscale=False)
+
+    def serve_round():
+        outs = [None] * n_requests
+        errs: list[str] = []
+        sem = threading.Semaphore(concurrency)
+
+        def one(i):
+            rng = np.random.RandomState(4000 + i)
+            prompt = [int(x) for x in rng.randint(1, 250, prompt_len)]
+            with sem:
+                try:
+                    outs[i] = pool.generate(prompt, new_tokens,
+                                            tenant="tenant-a")
+                except Exception as e:  # noqa: BLE001
+                    errs.append(f"req {i}: {type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(n_requests)]
+        t0 = time.perf_counter()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        dt = time.perf_counter() - t0
+        if errs:
+            raise RuntimeError(f"{len(errs)} serve requests failed; "
+                               f"first: {errs[0][:300]}")
+        total = sum(len(o["tokens"]) for o in outs)
+        ttfts = sorted(o["token_times_s"][0] - o["submitted_s"]
+                       for o in outs)
+        p99 = ttfts[min(len(ttfts) - 1, int(0.99 * len(ttfts)))]
+        return total / dt, p99
+
+    io = EventLoopThread("ray_tpu-qos-bench")
+    cp = ControlPlane()
+    head_port = io.run(cp.start())
+    sid = uuid.uuid4().hex[:8]
+    agents = [
+        NodeAgent("127.0.0.1", head_port,
+                  resources={"CPU": 1.0, "memory": 2.0 * 2**30},
+                  store_capacity=128 * 1024 * 1024,
+                  session_id=f"qos{sid}{i}")
+        for i in range(2)
+    ]
+    for a in agents:
+        io.run(a.start())
+    nbytes = 8 * 1024 * 1024
+    blob = _os.urandom(nbytes)
+
+    def seed_blob():
+        o = _os.urandom(16)
+        agents[0].store.put_bytes(o, blob, metadata=b"")
+        io.run(agents[0].rpc_object_sealed(
+            None, {"object_id": o, "size": nbytes}))
+        return o
+
+    def drop_blob(o):
+        agents[1].store.delete(o)
+        agents[0].store.pin(o, False)
+        agents[0].store.delete(o)
+
+    ranks = []
+    try:
+        # warm both replicas, then the uncontended baseline
+        warm = [int(x) for x in np.random.RandomState(9)
+                .randint(1, 250, prompt_len)]
+        ray_tpu.get([r.handle.generate.remote(warm, 8)
+                     for r in pool._alive()], timeout=600)
+        base_rate, base_p99 = serve_round()
+
+        # contended: finite per-peer pacing ON, gang + bulk in the
+        # background (ranks spawned AFTER the config flip so their
+        # processes inherit the paced rate through the env)
+        _qos.reset()
+        _net.reset_local()
+        _cfg.set_system_config({"net_qos_rate_mbps": 200.0})
+        world = 2
+        ranks = [_CollRank.remote() for _ in range(world)]
+        gname = f"qos-{uuid.uuid4().hex[:8]}"
+        ray_tpu.get([a.init.remote(world, r, gname)
+                     for r, a in enumerate(ranks)], timeout=120)
+        stop = threading.Event()
+        pulls = [0]
+        bulk_err: list[str] = []
+
+        def bulk_loop():
+            try:
+                while not stop.is_set():
+                    o = seed_blob()
+                    ok = io.run(agents[1].rpc_fetch_object(
+                        None, {"object_id": o, "timeout": 120}))
+                    assert ok, "bulk pull failed under pacing"
+                    pulls[0] += 1
+                    drop_blob(o)
+            except Exception as e:  # noqa: BLE001
+                bulk_err.append(f"{type(e).__name__}: {e}")
+
+        def gang_loop():
+            mb2 = 2 * 1024 * 1024
+            while not stop.is_set():
+                try:
+                    ray_tpu.get(
+                        [a.allreduce_loop.remote(mb2, 2, "ring", None)
+                         for a in ranks], timeout=120)
+                except Exception:
+                    return
+
+        bt = threading.Thread(target=bulk_loop)
+        gt = threading.Thread(target=gang_loop)
+        bt.start()
+        gt.start()
+        try:
+            cont_rate, cont_p99 = serve_round()
+        finally:
+            stop.set()
+            bt.join(timeout=120)
+            gt.join(timeout=120)
+        if bulk_err:
+            raise RuntimeError(bulk_err[0])
+        # byte-identical completion under pacing/preemption
+        o = seed_blob()
+        ok = io.run(agents[1].rpc_fetch_object(
+            None, {"object_id": o, "timeout": 120}))
+        buf = agents[1].store.get(o)
+        identical = bool(ok) and buf is not None and (
+            bytes(buf.data) == blob)
+        if buf is not None:
+            buf.release()
+        drop_blob(o)
+        pulls[0] += 1
+        # attribution: the driver-process rx tally (pull side) must
+        # match the wire bytes the bulk loop actually moved
+        rx = _net.total("rx", qos_class="bulk")
+        expect = pulls[0] * nbytes
+        attrib_err = abs(rx - expect) / expect
+        qst = _qos.stats()
+        parks = sum(s["parks"]["bulk"] + s["parks"]["collective"]
+                    for s in qst.values())
+        r = {
+            "name": "qos serve contention (gang + bulk spill, paced)",
+            "per_s": round(cont_rate, 1),
+            "unit": "tokens/s",
+            "uncontended_per_s": round(base_rate, 1),
+            "ratio_tokens": round(cont_rate / base_rate, 3),
+            "ttft_p99_s": round(cont_p99, 3),
+            "uncontended_ttft_p99_s": round(base_p99, 3),
+            "ratio_ttft": round(cont_p99 / max(base_p99, 1e-9), 3),
+            "bulk_pulls": pulls[0],
+            "bulk_completed": bool(identical),
+            "attribution_err": round(attrib_err, 5),
+            "pacer_parks": parks,
+            "rate_mbps": 200.0,
+        }
+        results.append(r)
+        print(json.dumps(r), flush=True)
+
+        # ---- batched stream fanout (per-replica poll batching) ----
+        _cfg.set_system_config({"net_qos_rate_mbps": 0.0})
+        _qos.reset()
+        n_streams = 8
+        counts = [0] * n_streams
+
+        def stream_one(i):
+            rng = np.random.RandomState(5000 + i)
+            prompt = [int(x) for x in rng.randint(1, 250, prompt_len)]
+            sub = pool.submit_stream({
+                "prompt_ids": prompt, "max_tokens": new_tokens,
+                "temperature": 1.0, "top_p": 0.95, "seed": 100 + i,
+                "tenant": "tenant-a"})
+            toks = []
+            while True:
+                out = pool.poll_stream(sub["rid"])
+                toks += out["tokens"]
+                if out["done"]:
+                    break
+                time.sleep(0.004)
+            counts[i] = len(toks)
+
+        # warm the sampled kernel on both replicas
+        wts = [threading.Thread(target=stream_one, args=(i,))
+               for i in range(2)]
+        for t in wts:
+            t.start()
+        for t in wts:
+            t.join()
+        polls0 = sum(ray_tpu.get(rep.handle.stats.remote(), timeout=60)
+                     .get("stream_polls", 0) for rep in pool._alive())
+        threads = [threading.Thread(target=stream_one, args=(i,))
+                   for i in range(n_streams)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        polls1 = sum(ray_tpu.get(rep.handle.stats.remote(), timeout=60)
+                     .get("stream_polls", 0) for rep in pool._alive())
+        r = {"name": "qos batched stream fanout (8 streams)",
+             "per_s": round(sum(counts) / dt, 1), "unit": "tokens/s",
+             "streams": n_streams, "tokens": sum(counts),
+             "replica_poll_rpcs": polls1 - polls0,
+             "polls_per_token":
+                 round((polls1 - polls0) / max(1, sum(counts)), 3)}
+        results.append(r)
+        print(json.dumps(r), flush=True)
+    finally:
+        _cfg.set_system_config({"net_qos_rate_mbps": 0.0})
+        _qos.reset()
+        for a in ranks:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+        pool.shutdown()
+        for a in agents:
+            try:
+                io.run(a.stop(), timeout=10)
+            except Exception:
+                pass
+        try:
+            io.run(cp.stop(), timeout=10)
+        except Exception:
+            pass
+        io.stop()
+    return results
+
+
 def run_obs_benchmarks(*, quick: bool = False) -> list[dict]:
     """The `obs` family: what the always-on flight recorder costs.
 
@@ -734,6 +1008,9 @@ def run_benchmarks(*, quick: bool = False) -> list[dict]:
     # ---- rl (actor-learner rollout / experience / publish paths) ----
     results.extend(run_rl_benchmarks(quick=quick))
 
+    # ---- qos (pacing under contention + batched stream fanout) ----
+    results.extend(run_qos_benchmarks(quick=quick))
+
     # ---- transfer (zero-copy put + pipelined cross-node pull) ----
     results.extend(run_transfer_benchmarks(quick=quick))
 
@@ -795,7 +1072,7 @@ def main(argv=None):
     p.add_argument("--quick", action="store_true")
     p.add_argument("--family", default="all",
                    choices=["all", "collective", "transfer", "serve",
-                            "rl", "obs"],
+                            "rl", "obs", "qos"],
                    help="run one workload family only")
     p.add_argument("--in-process", action="store_true",
                    help="head in the driver process (debug only)")
@@ -820,6 +1097,8 @@ def main(argv=None):
             results = run_rl_benchmarks(quick=args.quick)
         elif args.family == "obs":
             results = run_obs_benchmarks(quick=args.quick)
+        elif args.family == "qos":
+            results = run_qos_benchmarks(quick=args.quick)
         else:
             results = run_benchmarks(quick=args.quick)
     finally:

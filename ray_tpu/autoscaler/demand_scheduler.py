@@ -84,6 +84,29 @@ def replica_resource_demands(n_new: int,
     return [dict(shape) for _ in range(max(0, n_new))]
 
 
+def link_tx_by_peer(rows: list[dict]) -> dict[str, float]:
+    """Aggregate ``net_tx_bytes_total`` metric rows (the flight
+    recorder's per-link byte attribution, as returned by
+    ``rpc_get_metrics``) into per-peer outbound byte totals.
+
+    Peer labels are node-id prefixes, ``group:rank`` ring endpoints, or
+    serve-role labels; callers mapping onto node placement typically
+    pass the result through their own label->node translation. Sampled
+    twice over a window this yields the per-link bytes/s that
+    `get_nodes_to_launch` consumes to steer new replicas away from
+    links saturated by collective steps or bulk spills."""
+    out: dict[str, float] = {}
+    for r in rows or []:
+        if r.get("name") != "net_tx_bytes_total":
+            continue
+        tags = dict(tuple(t) for t in r.get("tags", []))
+        peer = tags.get("peer")
+        if peer is None:
+            continue
+        out[peer] = out.get(peer, 0.0) + float(r.get("value", 0.0))
+    return out
+
+
 def _fits(need: dict, cap: dict) -> bool:
     return all(cap.get(r, 0.0) >= v for r, v in need.items() if v > 0)
 
@@ -130,15 +153,40 @@ def get_nodes_to_launch(
     *,
     pg_demands: list[dict] | None = None,
     launched_by_type: dict[str, int] | None = None,
+    free_node_ids: list[str] | None = None,
+    link_tx_bytes_per_s: dict[str, float] | None = None,
+    link_saturation_bytes_per_s: float = 0.0,
 ) -> dict[str, int]:
     """-> {node_type: count} to launch now.
 
     `node_types`: {name: {"resources": {...}, "max_workers": N}}.
     `free_capacities`: available resources of live nodes PLUS the full
     resources of instances already launching (never double-launch).
+
+    Link-aware placement: when `free_node_ids` labels each entry of
+    `free_capacities` and `link_tx_bytes_per_s` carries per-node
+    outbound load (see `link_tx_by_peer`), free capacity is tried
+    lightest-link-first, and nodes at or past
+    `link_saturation_bytes_per_s` (when > 0) are AVOIDED: a demand that
+    only fits there opens a fresh node instead (falling back to the
+    saturated node only when no launchable type can hold it) — a new
+    decode replica lands away from links a collective gang or bulk
+    spill is saturating rather than queueing behind their chunks.
     """
     launched_by_type = dict(launched_by_type or {})
     free = [dict(c) for c in free_capacities]
+    saturated: list[dict] = []
+    if free_node_ids and link_tx_bytes_per_s:
+        load = [link_tx_bytes_per_s.get(nid, 0.0)
+                for nid in list(free_node_ids)[:len(free)]]
+        load += [0.0] * (len(free) - len(load))
+        sat = link_saturation_bytes_per_s
+        order = sorted(range(len(free)), key=lambda i: load[i])
+        if sat > 0:
+            saturated = [free[i] for i in order if load[i] >= sat]
+            free = [free[i] for i in order if load[i] < sat]
+        else:
+            free = [free[i] for i in order]
     to_launch: dict[str, int] = {}
     open_nodes: list[tuple[str, dict]] = []  # (type, remaining capacity)
 
@@ -193,9 +241,14 @@ def get_nodes_to_launch(
                 _take(need, cap)
                 placed = True
                 break
-        if not placed:
-            open_for(need)  # unfittable demands are silently skipped:
-            # nothing the provider offers can hold them
+        if not placed and not open_for(need):
+            # last resort: a saturated node beats not placing at all
+            for cap in saturated:
+                if _fits(need, cap):
+                    _take(need, cap)
+                    break
+            # otherwise silently skipped: nothing the provider offers
+            # can hold the demand
 
     # STRICT_SPREAD: each bundle on a DISTINCT node — consume distinct
     # free nodes first, then open one node per remaining bundle

@@ -416,6 +416,15 @@ def _on_peer_lost(key: tuple) -> None:
         for r, owner in g.peers.items():
             if r != g.rank and (owner.get("addr"), owner.get("port")) == key:
                 g.abort(f"lost connection to rank {r}")
+                try:
+                    from ray_tpu._private import net_qos as _qos
+
+                    _qos.purge_peer(f"{g.name}:r{r}")
+                    nid = g.peer_nodes.get(r)
+                    if nid:
+                        _qos.purge_peer(nid.hex()[:8])
+                except Exception:  # noqa: BLE001 — purge is best-effort
+                    pass
                 break
 
 
@@ -428,6 +437,12 @@ def _on_node_dead(payload) -> None:
         else payload
     if not node_id:
         return
+    try:
+        from ray_tpu._private import net_qos as _qos
+
+        _qos.purge_peer(node_id.hex()[:8])
+    except Exception:  # noqa: BLE001 — purge is best-effort
+        pass
     for g in list(_groups.values()):
         if g._abort is not None:
             continue
@@ -782,6 +797,18 @@ def destroy_collective_group(group_name: str = "default"):
             for k in [k for k in box.msgs if k[0] == group_name]:
                 del box.msgs[k]
     _ring.purge_group(group_name)
+    # pacer windows keyed by this group's peer labels go with it: a dead
+    # incarnation's exhausted window must not pace its successor
+    try:
+        from ray_tpu._private import net_qos as _qos
+
+        _qos.purge_group_peers(group_name)
+        if g is not None:
+            for nid in getattr(g, "peer_nodes", {}).values():
+                if nid:
+                    _qos.purge_peer(nid.hex()[:8])
+    except Exception:  # noqa: BLE001 — teardown is best-effort
+        pass
     if g is not None:
         # straggler frames from this incarnation arriving after the purge
         # above are dropped at ingress

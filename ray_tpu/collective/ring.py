@@ -70,8 +70,15 @@ def _send_chunk(g, right: int, seq: int, key: str, frame, st, *,
     """One pipelined chunk send, wrapped with the deterministic
     fault-injection site ``ring.send`` (drop / dup / delay / die)."""
     from ray_tpu._private import net_accounting as _net
+    from ray_tpu._private import net_qos as _qos
 
     wb = compression.wire_bytes(frame)
+    # collective-class pacer grant per chunk: parks behind kv traffic
+    # under a finite rate, bounded by the grant deadline, and keeps
+    # polling the group abort so a dead peer aborts the op instead of
+    # wedging a parked sender (NetPaceError propagates = typed abort)
+    _qos.acquire(_peer_label(g, right), "collective", wb, owner=g.name,
+                 poll=lambda: _abort_poll(g, op))
     t0 = time.perf_counter()
     if fault_injection.enabled():
         act = fault_injection.fire(

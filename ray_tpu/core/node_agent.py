@@ -326,6 +326,14 @@ class NodeAgent:
         cli = self._peer_clients.pop(nid, None)
         if cli is not None:
             asyncio.ensure_future(cli.close())
+        # purge the dead peer's pacer window (PR 1 purge discipline): an
+        # exhausted bucket must not throttle a reused address forever
+        try:
+            from ray_tpu._private import net_qos as _qos
+
+            _qos.purge_peer(nid.hex()[:8])
+        except Exception:  # noqa: BLE001 — purge is best-effort
+            pass
 
     def _on_node_added_push(self, payload):
         self.cluster_view[payload["node_id"]] = payload
@@ -2241,6 +2249,23 @@ class NodeAgent:
                 # the chunk is "lost": surface it as the retryable busy
                 # refusal so the puller's backoff path re-requests it
                 return {"busy": True, "retry_after_s": 0.05}
+        # QoS grant for the serve side, classed by the request's
+        # self-declared {requester, qos, owner} tags. A denied window
+        # rides the SAME retryable refusal as pacing/flooding — this is
+        # exactly how an in-flight bulk transfer is preempted at chunk
+        # granularity by a higher class: its next chunk parks client-side
+        # and the resumed pull re-requests the same offset, byte-identical.
+        try:
+            from ray_tpu._private import net_qos as _qos
+
+            hint = _qos.try_acquire(
+                p.get("requester", "?"), p.get("qos", "bulk"),
+                _chunk_size(), owner=p.get("owner", "unknown"))
+        except Exception as e:  # NetPaceError (injected drop) included
+            return {"busy": True, "retry_after_s": 0.1,
+                    "paced": str(e)[:120]}
+        if hint > 0:
+            return {"busy": True, "retry_after_s": hint, "paced": True}
         if conn is not None:
             # Serve gate: ~2 chunks buffered per connection, not the full
             # window. Pipelining depth lives in the puller's OUTSTANDING
@@ -2468,10 +2493,12 @@ class NodeAgent:
 
     async def _read_chunk_backoff(self, cli: AsyncRpcClient, oid: bytes,
                                   offset: int, budget_s: float = 60.0,
-                                  attrib: dict | None = None):
+                                  attrib: dict | None = None,
+                                  peer: str | None = None):
         """read_object_chunk with bounded backoff on the server's
         retryable {"busy": True} refusal (its pacing deadline expired:
-        our own connection is flooded). Bounded by WALL CLOCK, not
+        our own connection is flooded, or the QoS window parked us
+        behind a higher class). Bounded by WALL CLOCK, not
         attempt count — each refused attempt can itself block in the
         server's drain wait, so counting attempts alone could pin a pull
         on one flooded location for minutes. Returns the chunk dict, or
@@ -2484,6 +2511,21 @@ class NodeAgent:
             # {requester, qos, owner} ride the request so the SERVER can
             # attribute its tx bytes symmetrically with our rx
             req.update(attrib)
+        if peer is not None:
+            # pull-issue grant against the SOURCE peer's window: a chunk
+            # request parks here (asleep on the loop, never blocking it)
+            # while higher-class traffic owns the link; a pace deadline
+            # or injected net.pace drop fails typed and the outer pull
+            # loop retries other sources — never a wedged transfer
+            from ray_tpu._private import net_qos as _qos
+
+            try:
+                await _qos.acquire_async(
+                    peer, (attrib or {}).get("qos", "bulk"), _chunk_size(),
+                    owner=(attrib or {}).get("owner", "unknown"),
+                    timeout=max(1.0, deadline - time.monotonic()))
+            except _qos.NetPaceError:
+                return None
         while True:
             part = await cli.call("read_object_chunk", req)
             if not (isinstance(part, dict) and part.get("busy")):
@@ -2534,7 +2576,8 @@ class NodeAgent:
             for lead in clis:
                 try:
                     first = await self._read_chunk_backoff(
-                        lead, oid, 0, attrib=attrib)
+                        lead, oid, 0, attrib=attrib,
+                        peer=label_of[id(lead)])
                 except (rpc.ConnectionLost, rpc.RpcError, OSError):
                     first = None  # dead lead: try the next holder
                 if first is not None:
@@ -2571,7 +2614,8 @@ class NodeAgent:
                     next source', not 'abort the pull'."""
                     try:
                         part = await self._read_chunk_backoff(
-                            cli, oid, off, attrib=attrib)
+                            cli, oid, off, attrib=attrib,
+                            peer=label_of[id(cli)])
                     except (rpc.ConnectionLost, rpc.RpcError, OSError):
                         return None
                     if part is None:

@@ -209,7 +209,18 @@ class DashboardHead:
                     max(segs, key=segs.get) if segs else None,
                 "segments_s": {k: round(v, 6) for k, v in segs.items()},
             }
+        # per-tenant SLO verdicts: the serve histograms carry a tenant
+        # tag, so fair-queueing outcomes are observable here, not just
+        # asserted in tests ("-" = untagged traffic)
+        per_tenant: dict[str, dict] = {}
+        for tn, pct in _hist_percentiles(
+                rows, "serve_ttft_seconds", group_key="tenant").items():
+            per_tenant.setdefault(tn or "-", {})["ttft"] = pct
+        for tn, pct in _hist_percentiles(
+                rows, "serve_tbt_seconds", group_key="tenant").items():
+            per_tenant.setdefault(tn or "-", {})["tbt"] = pct
         return {"ttft": ttft.get("", {}), "tbt": tbt.get("", {}),
+                "per_tenant": per_tenant,
                 "train_step": step, "straggler": straggler}
 
     def _agent_call(self, node: dict, method: str, payload: dict,

@@ -61,7 +61,7 @@ def _get_metrics():
                 "tokens, per active stream)",
                 boundaries=(0.001, 0.005, 0.02, 0.05, 0.1, 0.25,
                             0.5, 1.0),
-                tag_keys=("engine",)),
+                tag_keys=("engine", "tenant")),
         }
     return _metrics
 
@@ -396,6 +396,8 @@ class _Stream:
     # the staleness window; the pool's splice guard needs the version
     # the tokens were actually generated under, not the publish stamp)
     version: int | None = None
+    # tenant for per-tenant SLO attribution (TBT histograms)
+    tenant: str = "-"
 
 
 class RaggedDecoder:
@@ -462,7 +464,7 @@ class RaggedDecoder:
 
     def submit(self, prompt_tokens, max_new: int, *,
                temperature: float = 0.0, top_p: float = 1.0,
-               seed: int = 0) -> int:
+               seed: int = 0, tenant: str = "-") -> int:
         """Validates HERE (caller's thread) so a bad request raises at
         the submitter, never inside the pump loop. ``temperature`` 0 is
         greedy decode; > 0 samples on the stream's (seed, position)
@@ -484,7 +486,7 @@ class RaggedDecoder:
         s = _Stream(self._next_sid, prompt, min(max_new, room),
                     submitted=time.perf_counter(),
                     temperature=float(temperature), top_p=float(top_p),
-                    seed=int(seed) & 0xFFFFFFFF)
+                    seed=int(seed) & 0xFFFFFFFF, tenant=str(tenant))
         self._next_sid += 1
         self.queue.append(s)
         self._by_sid[s.sid] = s
@@ -492,7 +494,8 @@ class RaggedDecoder:
 
     def submit_prefilled(self, prompt_tokens, max_new: int,
                          kv: dict, *, temperature: float = 0.0,
-                         top_p: float = 1.0, seed: int = 0) -> int:
+                         top_p: float = 1.0, seed: int = 0,
+                         tenant: str = "-") -> int:
         """Enqueue a stream whose prefill already happened elsewhere
         (a dedicated prefill worker, serve/llm_pool.py). `kv`:
         {"k"/"v": [n_layers, S, n_kv_heads, head_dim] with S == this
@@ -522,7 +525,7 @@ class RaggedDecoder:
         s = _Stream(self._next_sid, prompt, min(max_new, room),
                     submitted=time.perf_counter(),
                     temperature=float(temperature), top_p=float(top_p),
-                    seed=int(seed) & 0xFFFFFFFF,
+                    seed=int(seed) & 0xFFFFFFFF, tenant=str(tenant),
                     prefilled={"k": k, "v": np.asarray(kv["v"]),
                                "first_token": int(kv["first_token"]),
                                "first_logprob":
@@ -768,7 +771,7 @@ class RaggedDecoder:
             if take > 0 and len(s.token_times) > take:
                 prev = s.token_times[-take - 1]
                 if t_now > prev:
-                    self._tbt_obs((t_now - prev) / take)
+                    self._tbt_obs((t_now - prev) / take, s.tenant)
             if len(s.tokens) >= s.max_new \
                     or int(pos_np[slot]) >= self.max_len - 1:
                 s.done = True
@@ -794,9 +797,10 @@ class RaggedDecoder:
     RATE_WINDOW_S = 5.0
     METRICS_PERIOD_S = 1.0
 
-    def _tbt_obs(self, v: float) -> None:
+    def _tbt_obs(self, v: float, tenant: str = "-") -> None:
         try:
-            _get_metrics()["tbt"].observe(v, {"engine": self.name})
+            _get_metrics()["tbt"].observe(
+                v, {"engine": self.name, "tenant": tenant})
         except Exception:  # noqa: BLE001 — telemetry never breaks decode
             pass
 

@@ -123,6 +123,9 @@ class LLMServer:
         # their finished entries (no _done_events waiter is registered)
         self._stream_sids: dict[int, float] = {}  # sid -> last poll
         self._stream_ft: set[int] = set()  # sids with first-token span
+        # poll RPCs served (single + batched): the batching test's
+        # falsifiability counter — N streams should NOT mean N RPCs/tick
+        self._poll_rpcs = 0
         self._stop = False
         self._draining = False
         self._pump_thread = threading.Thread(
@@ -244,19 +247,20 @@ class LLMServer:
 
     def generate(self, prompt_ids: list, max_tokens: int = 64, *,
                  temperature: float = 0.0, top_p: float = 1.0,
-                 seed: int = 0) -> dict:
+                 seed: int = 0, tenant: str = "-") -> dict:
         """Blocking single-request API (one handler thread per call;
         all calls share the slot batch)."""
         sid, ev = self._submit_locked(
             lambda: self.engine.submit(
                 list(prompt_ids), int(max_tokens),
-                temperature=temperature, top_p=top_p, seed=seed))
+                temperature=temperature, top_p=top_p, seed=seed,
+                tenant=tenant))
         return self._wait_result(sid, ev, int(max_tokens))
 
     def adopt_prefilled(self, kv: dict, prompt_ids: list,
                         max_tokens: int = 64, *,
                         temperature: float = 0.0, top_p: float = 1.0,
-                        seed: int = 0) -> dict:
+                        seed: int = 0, tenant: str = "-") -> dict:
         """Blocking generate for a stream prefilled ELSEWHERE: `kv` is
         the prefill worker's payload (decode_engine.prefill_kv rows +
         first token), typically passed as an ObjectRef so the KV rows
@@ -267,25 +271,38 @@ class LLMServer:
         sid, ev = self._submit_locked(
             lambda: self.engine.submit_prefilled(
                 list(prompt_ids), int(max_tokens), kv,
-                temperature=temperature, top_p=top_p, seed=seed))
-        self._record_kv_handoff(kv, t0)
+                temperature=temperature, top_p=top_p, seed=seed,
+                tenant=tenant))
+        self._record_kv_handoff(kv, t0, tenant=tenant)
         return self._wait_result(sid, ev, int(max_tokens))
 
-    def _record_kv_handoff(self, kv, t0: float) -> None:
+    def _record_kv_handoff(self, kv, t0: float, tenant: str = "-") -> None:
         """Span + kv-class rx attribution for an externally-prefilled
         payload adopted by this replica (the KV rows arrived via the
         object store during arg staging; this covers the replica-side
-        handoff into the engine)."""
+        handoff into the engine). The handoff claims a kv-class grant on
+        the pacer first — under a finite rate, THIS is what preempts
+        in-flight bulk chunks on the link (strict priority): the claim
+        is latency-critical, so a refused window is logged as a park and
+        the handoff proceeds (the bytes already arrived; the claim paces
+        the link, it does not gate correctness)."""
         try:
             from ray_tpu._private import flight_recorder as _fr
             from ray_tpu._private import net_accounting as _net
+            from ray_tpu._private import net_qos as _qos
 
             nb = int(getattr(kv.get("k"), "nbytes", 0)
                      + getattr(kv.get("v"), "nbytes", 0))
+            try:
+                _qos.acquire("prefill", "kv", nb,
+                             owner=self.engine.name, timeout=5.0)
+            except _qos.NetPaceError:
+                pass  # typed park under injection/saturation: proceed
             _fr.record("serve", "serve.kv_handoff", t0, time.monotonic(),
-                       attrs={"kv_bytes": nb,
+                       attrs={"kv_bytes": nb, "tenant": tenant,
                               "engine": self.engine.name})
-            _net.account_rx("prefill", "kv", self.engine.name, nb)
+            _net.account_rx("prefill", "kv", self.engine.name, nb,
+                            tenant=tenant)
         except Exception:  # noqa: BLE001 — observability best-effort
             pass
 
@@ -304,26 +321,29 @@ class LLMServer:
         prompt_ids = list(req["prompt_ids"])
         max_tokens = int(req.get("max_tokens", 64))
         sampling = self._sampling(req)
+        tenant = str(req.get("tenant", "-"))
         t0 = time.monotonic()
         with self._lock:
             if self._draining:
                 raise RuntimeError("replica draining: not admitting")
             if req.get("kv") is not None:
                 sid = self.engine.submit_prefilled(
-                    prompt_ids, max_tokens, req["kv"], **sampling)
+                    prompt_ids, max_tokens, req["kv"], tenant=tenant,
+                    **sampling)
             else:
                 sid = self.engine.submit(prompt_ids, max_tokens,
-                                         **sampling)
+                                         tenant=tenant, **sampling)
             self._stream_sids[sid] = time.monotonic()
         if req.get("kv") is not None:
-            self._record_kv_handoff(req["kv"], t0)
+            self._record_kv_handoff(req["kv"], t0, tenant=tenant)
         return {"sid": sid}
 
     def submit_stream_prefilled(self, kv: dict, prompt_ids: list,
                                 max_tokens: int = 64, *,
                                 temperature: float = 0.0,
                                 top_p: float = 1.0,
-                                seed: int = 0) -> dict:
+                                seed: int = 0,
+                                tenant: str = "-") -> dict:
         """submit_stream for an externally-prefilled stream. `kv` is a
         dedicated TOP-LEVEL argument (not nested in a request dict) so
         an ObjectRef passed here is resolved by the executor's arg
@@ -335,16 +355,30 @@ class LLMServer:
                 raise RuntimeError("replica draining: not admitting")
             sid = self.engine.submit_prefilled(
                 list(prompt_ids), int(max_tokens), kv,
-                temperature=temperature, top_p=top_p, seed=seed)
+                temperature=temperature, top_p=top_p, seed=seed,
+                tenant=tenant)
             self._stream_sids[sid] = time.monotonic()
-        self._record_kv_handoff(kv, t0)
+        self._record_kv_handoff(kv, t0, tenant=tenant)
         return {"sid": sid}
 
     def poll_stream(self, sid: int) -> dict:
         """New tokens (+ parallel behavior logprobs) since the last
         poll, plus a done flag. The final poll (done=True) releases the
         stream."""
-        sid = int(sid)
+        self._poll_rpcs += 1
+        return self._poll_one(int(sid))
+
+    def poll_streams(self, sids: list) -> dict:
+        """Batched poll: ONE RPC drains every listed stream. The pool's
+        fan-out consumers each poll per request, which caps aggregate
+        streaming throughput at the RPC rate (~106 tok/s measured)
+        rather than the engine's decode rate — the pool batches all
+        sids co-located on this replica into one of these calls per
+        tick. Returns {sid: poll result}."""
+        self._poll_rpcs += 1
+        return {int(sid): self._poll_one(int(sid)) for sid in sids}
+
+    def _poll_one(self, sid: int) -> dict:
         with self._lock:
             if sid not in self._stream_sids:
                 return {"tokens": [], "logprobs": [], "done": True,
@@ -425,6 +459,7 @@ class LLMServer:
             st = self.engine.stats()
             st["draining"] = self._draining
             st["waiters"] = len(self._done_events)
+            st["stream_polls"] = self._poll_rpcs
             return st
 
     def health(self) -> bool:

@@ -35,6 +35,7 @@ decode replicas behind a shared admission queue:
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import logging
 import threading
@@ -73,7 +74,8 @@ class PrefillWorker:
         self._version = 0
 
     def prefill(self, prompt_ids: list, *, temperature: float = 0.0,
-                top_p: float = 1.0, seed: int = 0) -> dict:
+                top_p: float = 1.0, seed: int = 0,
+                tenant: str = "-") -> dict:
         """-> {"k", "v", "first_token", "first_logprob", "true_len",
         "version"} — the payload `RaggedDecoder.submit_prefilled`
         adopts. The first token rides the stream's (seed, position)
@@ -109,14 +111,25 @@ class PrefillWorker:
         try:
             from ray_tpu._private import flight_recorder as _flr
             from ray_tpu._private import net_accounting as _net
+            from ray_tpu._private import net_qos as _qos
 
+            # kv-class pacer grant for the outbound handoff: under a
+            # finite rate this is the strict-priority claim that parks
+            # in-flight bulk chunks; a typed refusal (injection) is
+            # logged as a park and the handoff proceeds
+            try:
+                _qos.acquire("decode", "kv", kv_bytes, owner=self.name,
+                             timeout=5.0)
+            except _qos.NetPaceError:
+                pass
             _flr.record("serve", "serve.prefill", t0, time.monotonic(),
-                        attrs={"worker": self.name,
+                        attrs={"worker": self.name, "tenant": tenant,
                                "prompt_tokens": len(prompt),
                                "bucket": bucket, "kv_bytes": kv_bytes})
             # the KV payload leaves this node for the adopting decode
             # replica via the object store: tag it as kv-class traffic
-            _net.account_tx("decode", "kv", self.name, kv_bytes)
+            _net.account_tx("decode", "kv", self.name, kv_bytes,
+                            tenant=tenant)
         except Exception:  # noqa: BLE001 — observability best-effort
             pass
         return {"k": k, "v": v, "first_token": int(tok0),
@@ -150,7 +163,8 @@ _PrefillActor = ray_tpu.remote(num_cpus=0)(PrefillWorker)
 class _Replica:
     """Pool-side record of one decode replica."""
 
-    __slots__ = ("handle", "inflight", "draining", "dead", "name")
+    __slots__ = ("handle", "inflight", "draining", "dead", "name",
+                 "poll_lock")
 
     def __init__(self, handle, name: str):
         self.handle = handle
@@ -158,6 +172,10 @@ class _Replica:
         self.draining = False
         self.dead = False
         self.name = name
+        # serializes batched stream polls against this replica: one
+        # poll_streams RPC in flight per replica, results for the other
+        # co-located streams buffered pool-side
+        self.poll_lock = threading.Lock()
 
 
 _pool_metrics = None
@@ -180,7 +198,8 @@ def _get_pool_metrics():
                 "client-observed time to first token "
                 "(admission wait + submit->first-token)",
                 boundaries=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
-                            1.0, 2.5, 5.0, 10.0)),
+                            1.0, 2.5, 5.0, 10.0),
+                tag_keys=("tenant",)),
         }
     return _pool_metrics
 
@@ -214,7 +233,8 @@ class LLMPool:
                  prefix_cache_block: int = 0,
                  prefix_cache_mb: int = 256,
                  max_inflight_per_replica: int | None = None,
-                 autoscale: bool = True, chunk_delay_s: float = 0.0):
+                 autoscale: bool = True, chunk_delay_s: float = 0.0,
+                 tenant_weights: dict | None = None):
         import jax
         import numpy as np
 
@@ -250,7 +270,16 @@ class LLMPool:
         self._replicas: list[_Replica] = []
         self._waiting = 0
         self._n_spawned = 0
-        self._ttfts: list = []  # (wall stamp, ttft_s)
+        self._ttfts: list = []  # (wall stamp, ttft_s, tenant)
+        # weighted fair queueing across tenants at the admission queue:
+        # each tenant accrues virtual time 1/weight per admission, and
+        # the waiting tenant with the LOWEST virtual time goes first
+        # (FIFO within a tenant) — a tenant flooding the queue advances
+        # its own clock, it cannot advance its turn. Unknown tenants get
+        # weight 1.0.
+        self._tenant_weights = dict(tenant_weights or {})
+        self._tenants: dict[str, dict] = {}
+        self._vclock = 0.0
         self._streams: dict[str, dict] = {}
         self._next_rid = 0
         self._last_scale_up = 0.0
@@ -319,21 +348,59 @@ class LLMPool:
 
     # ---------- admission ----------
 
-    def _acquire(self) -> _Replica:
+    def _tenant_state(self, tenant: str) -> dict:
+        ts = self._tenants.get(tenant)
+        if ts is None:
+            ts = self._tenants[tenant] = {
+                "weight": float(self._tenant_weights.get(tenant, 1.0)),
+                "vtime": self._vclock,
+                "queue": collections.deque(),
+            }
+        return ts
+
+    def _tenant_turn(self, tenant: str, ticket) -> bool:
+        """Under the lock: is this ticket the head of the waiting tenant
+        with the lowest virtual time? (FIFO within a tenant, min-vtime
+        across tenants, name tie-break for determinism)."""
+        active = [(ts["vtime"], name) for name, ts in self._tenants.items()
+                  if ts["queue"]]
+        if not active:
+            return False
+        _, pick = min(active)
+        ts = self._tenants[pick]
+        return pick == tenant and ts["queue"][0] is ticket
+
+    def _acquire(self, tenant: str = "-") -> _Replica:
         """Block until some live, non-draining replica has an in-flight
-        slot. The count of blocked handler threads IS the shared
-        admission queue — its depth feeds the autoscaler."""
+        slot AND it is this tenant's weighted-fair turn. The count of
+        blocked handler threads IS the shared admission queue — its
+        depth feeds the autoscaler. A hot tenant flooding submissions
+        only queues behind ITSELF: each admission advances its virtual
+        clock by 1/weight, so other tenants' requests keep interleaving
+        at their weighted share regardless of queue depth."""
         deadline = time.monotonic() + self.ACQUIRE_TIMEOUT_S
+        ticket = object()
         with self._cond:
             self._waiting += 1
+            ts = self._tenant_state(tenant)
+            # re-align an idle tenant to the current virtual clock: a
+            # long-idle tenant must not bank unused past share and then
+            # monopolize admissions to "catch up"
+            if not ts["queue"]:
+                ts["vtime"] = max(ts["vtime"], self._vclock)
+            ts["queue"].append(ticket)
             try:
                 while True:
                     cands = [r for r in self._replicas
                              if not r.draining and not r.dead
                              and r.inflight < self._max_inflight]
-                    if cands:
+                    if cands and self._tenant_turn(tenant, ticket):
                         rep = min(cands, key=lambda r: r.inflight)
                         rep.inflight += 1
+                        ts["queue"].popleft()  # == ticket
+                        ts["vtime"] += 1.0 / max(1e-6, ts["weight"])
+                        self._vclock = max(self._vclock, ts["vtime"])
+                        self._cond.notify_all()  # next tenant's turn
                         return rep
                     if not self._cond.wait(
                             timeout=max(0.0,
@@ -344,13 +411,17 @@ class LLMPool:
                             f"({len(self._replicas)} replicas)")
             finally:
                 self._waiting -= 1
+                if ticket in ts["queue"]:
+                    ts["queue"].remove(ticket)  # timeout/interrupt path
+                    self._cond.notify_all()
 
     def _release(self, rep: _Replica):
         with self._cond:
             rep.inflight = max(0, rep.inflight - 1)
             self._cond.notify_all()
 
-    def _record_ttft(self, out: dict, queue_wait_s: float = 0.0):
+    def _record_ttft(self, out: dict, queue_wait_s: float = 0.0,
+                     tenant: str = "-"):
         """TTFT as the CLIENT experiences it: pool admission-queue wait
         PLUS the replica-side submit->first-token gap (replica stamps
         alone are blind to admission collapse — the very signal the
@@ -360,18 +431,20 @@ class LLMPool:
             ttft = queue_wait_s + stamps[0] - out["submitted_s"]
             with self._lock:
                 now = time.monotonic()
-                self._ttfts.append((now, ttft))
+                self._ttfts.append((now, ttft, tenant))
                 cut = now - self.TTFT_WINDOW_S
                 while self._ttfts and self._ttfts[0][0] < cut:
                     self._ttfts.pop(0)
             try:
-                _get_pool_metrics()["ttft_hist"].observe(ttft)
+                _get_pool_metrics()["ttft_hist"].observe(
+                    ttft, {"tenant": tenant})
             except Exception:  # noqa: BLE001 — metrics best-effort
                 pass
 
-    def ttft_p99(self) -> float | None:
+    def ttft_p99(self, tenant: str | None = None) -> float | None:
         with self._lock:
-            vals = sorted(t for _, t in self._ttfts)
+            vals = sorted(t for _, t, tn in self._ttfts
+                          if tenant is None or tn == tenant)
         if not vals:
             return None
         return vals[min(len(vals) - 1, int(0.99 * len(vals)))]
@@ -395,7 +468,7 @@ class LLMPool:
         return (n * 0x9E3779B9) & 0x7FFFFFFF
 
     def _maybe_prefill(self, prompt_ids: list, sampling: dict | None
-                       = None):
+                       = None, tenant: str = "-"):
         """Route long prompts to the prefill pool; returns an
         ObjectRef of the KV payload, or None for inline prefill."""
         if (not self._prefill or self.prefill_threshold is None
@@ -408,7 +481,7 @@ class LLMPool:
             # NOT resolved here: the ref flows straight into the decode
             # replica's adopt call, so the KV rows move prefill-node ->
             # decode-node through the object store, never via the pool
-            return pw.prefill.remote(list(prompt_ids),
+            return pw.prefill.remote(list(prompt_ids), tenant=tenant,
                                      **(sampling or {}))
         except Exception:  # noqa: BLE001 — prefill pool degraded:
             return None  # decode replicas prefill inline instead
@@ -431,7 +504,7 @@ class LLMPool:
 
     def generate(self, prompt_ids: list, max_tokens: int = 64, *,
                  temperature: float = 0.0, top_p: float = 1.0,
-                 seed: int | None = None) -> dict:
+                 seed: int | None = None, tenant: str = "-") -> dict:
         """Blocking generate with transparent replica failover. The
         whole request runs under ONE trace id (joined from the ambient
         context when deployed as an actor, rooted fresh for direct
@@ -440,35 +513,40 @@ class LLMPool:
         with _trace.root_scope():
             return self._generate_traced(
                 prompt_ids, max_tokens, temperature=temperature,
-                top_p=top_p, seed=seed)
+                top_p=top_p, seed=seed, tenant=tenant)
 
     def _generate_traced(self, prompt_ids: list, max_tokens: int = 64, *,
                          temperature: float = 0.0, top_p: float = 1.0,
-                         seed: int | None = None) -> dict:
+                         seed: int | None = None,
+                         tenant: str = "-") -> dict:
         prompt_ids = list(prompt_ids)
         max_tokens = int(max_tokens)
+        tenant = str(tenant)
         sampling = {"temperature": float(temperature),
                     "top_p": float(top_p),
                     "seed": self._assign_seed(float(temperature), seed)}
-        kv_ref = self._maybe_prefill(prompt_ids, sampling)
+        kv_ref = self._maybe_prefill(prompt_ids, sampling, tenant)
         last_err: Exception | None = None
         t_enqueue = time.monotonic()
         for _ in range(self.max_replicas + 2):
-            rep = self._acquire()
+            rep = self._acquire(tenant)
             t_admitted = time.monotonic()
             queue_wait = t_admitted - t_enqueue
             _fr.record("serve", "serve.admission_wait", t_enqueue,
                        t_admitted, attrs={"replica": rep.name,
+                                          "tenant": tenant,
                                           "queued": self._waiting})
             try:
                 if kv_ref is not None:
                     ref = rep.handle.adopt_prefilled.remote(
-                        kv_ref, prompt_ids, max_tokens, **sampling)
+                        kv_ref, prompt_ids, max_tokens, tenant=tenant,
+                        **sampling)
                 else:
                     ref = rep.handle.generate.remote(
-                        prompt_ids, max_tokens, **sampling)
+                        prompt_ids, max_tokens, tenant=tenant,
+                        **sampling)
                 out = ray_tpu.get(ref, timeout=600)
-                self._record_ttft(out, queue_wait)
+                self._record_ttft(out, queue_wait, tenant)
                 return out
             except ray_tpu.RayActorError as e:
                 last_err = e
@@ -486,7 +564,8 @@ class LLMPool:
                 if kv_ref is not None:
                     # the KV payload may have died with the replica's
                     # node — recompute rather than depend on lineage
-                    kv_ref = self._maybe_prefill(prompt_ids, sampling)
+                    kv_ref = self._maybe_prefill(prompt_ids, sampling,
+                                                 tenant)
                 continue
             finally:
                 self._release(rep)
@@ -498,7 +577,8 @@ class LLMPool:
             list(req["prompt_ids"]), int(req.get("max_tokens", 64)),
             temperature=float(req.get("temperature", 0.0)),
             top_p=float(req.get("top_p", 1.0)),
-            seed=req.get("seed"))
+            seed=req.get("seed"),
+            tenant=str(req.get("tenant", "-")))
 
     # ---------- streaming ----------
 
@@ -524,6 +604,7 @@ class LLMPool:
                     "top_p": float(req.get("top_p", 1.0)),
                     "seed": self._assign_seed(temperature,
                                               req.get("seed"))}
+        tenant = str(req.get("tenant", "-"))
         with self._lock:
             self._next_rid += 1
             rid = f"s{self._next_rid}"
@@ -536,9 +617,11 @@ class LLMPool:
         rec = {"prompt_ids": prompt_ids, "max_tokens": max_tokens,
                "emitted": 0, "rep": None, "sid": None, "done": False,
                "last_poll": time.monotonic(), "sampling": sampling,
-               "version": self._weights_version, "trace": tr}
+               "version": self._weights_version, "trace": tr,
+               "tenant": tenant}
         with _trace.scope(*tr):
-            rec["kv_ref"] = self._maybe_prefill(prompt_ids, sampling)
+            rec["kv_ref"] = self._maybe_prefill(prompt_ids, sampling,
+                                                tenant)
             self._streams[rid] = rec
             try:
                 self._assign_stream(rec)
@@ -556,13 +639,16 @@ class LLMPool:
 
     def _assign_stream_traced(self, rec: dict):
         t_enqueue = time.monotonic()
-        rep = self._acquire()
+        tenant = rec.get("tenant", "-")
+        rep = self._acquire(tenant)
         _fr.record("serve", "serve.admission_wait", t_enqueue,
                    time.monotonic(), attrs={"replica": rep.name,
+                                            "tenant": tenant,
                                             "queued": self._waiting})
         try:
             body = {"prompt_ids": rec["prompt_ids"],
-                    "max_tokens": rec["max_tokens"], **rec["sampling"]}
+                    "max_tokens": rec["max_tokens"], "tenant": tenant,
+                    **rec["sampling"]}
             sid = None
             if rec["kv_ref"] is not None and rec["emitted"] == 0:
                 # adopt path only for a fresh stream (KV as a TOP-LEVEL
@@ -572,7 +658,8 @@ class LLMPool:
                     sid = ray_tpu.get(
                         rep.handle.submit_stream_prefilled.remote(
                             rec["kv_ref"], rec["prompt_ids"],
-                            rec["max_tokens"], **rec["sampling"]),
+                            rec["max_tokens"], tenant=tenant,
+                            **rec["sampling"]),
                         timeout=600)["sid"]
                 except ray_tpu.RayActorError:
                     if self._replica_alive(rep):
@@ -602,11 +689,23 @@ class LLMPool:
             raise
 
     def poll_stream(self, rid: str) -> dict:
+        """One client poll. The replica-side fetch is BATCHED: polling
+        any stream drains EVERY stream co-located on its replica in one
+        poll_streams RPC (serialized per replica), and the co-located
+        streams' results are buffered on their records for their own
+        next poll to return instantly. Per-request RPCs capped fan-out
+        consumers at the RPC rate (~106 tok/s measured vs 2k+ engine-
+        side); with batching, N consumers on one replica cost one RPC
+        per tick, not N."""
         rec = self._streams.get(rid)
         if rec is None or rec["done"]:
             self._streams.pop(rid, None)
             return {"tokens": [], "logprobs": [], "done": True}
         rec["last_poll"] = time.monotonic()
+        ready = rec.get("ready")
+        if ready:
+            return self._ingest_poll(rid, rec, ready.pop(0),
+                                     time.monotonic())
         if rec["rep"] is None:
             # an earlier failover found no survivor yet: keep retrying
             # on every poll instead of surfacing an error (the TTL
@@ -618,46 +717,82 @@ class LLMPool:
                         "weights_version": rec["version"]}
         rep = rec["rep"]
         t_poll = time.monotonic()
-        try:
-            with contextlib.ExitStack() as stack:
-                if rec.get("trace"):
-                    stack.enter_context(_trace.scope(*rec["trace"]))
-                out = ray_tpu.get(
-                    rep.handle.poll_stream.remote(rec["sid"]),
-                    timeout=120)
-        except ray_tpu.RayActorError:
-            # mid-stream death: re-queue onto a survivor and skip the
-            # tokens the client already has — exact because the
-            # replacement replays the same (seed, position) RNG lanes
-            # against the same weight version. If weights were
-            # republished since this stream started AND tokens are
-            # already out, a replay would re-sample a DIFFERENT
-            # continuation under the new version; splicing that onto
-            # the emitted prefix would hand the client (and the RL
-            # experience path) a sequence no single policy produced —
-            # so the stream closes cleanly at the emitted prefix
-            # instead (a shorter but internally consistent trajectory).
-            self._mark_dead(rep)
-            self._release(rep)
-            rec["rep"] = rec["sid"] = None
-            if rec["emitted"] > 0 \
-                    and rec["version"] != self._weights_version:
-                rec["done"] = True
-                self._streams.pop(rid, None)
-                return {"tokens": [], "logprobs": [], "done": True,
-                        "truncated": True,
-                        "weights_version": rec["version"]}
-            rec["replayed"] = 0  # replacement stream replays from 0
-            if rec["emitted"] == 0:
-                # nothing delivered: free to restart under the current
-                # version (the trajectory is whatever the retry yields)
-                rec["version"] = self._weights_version
+        with rep.poll_lock:
+            # a batch fired by another stream's poll may have buffered
+            # our result while we waited on the replica lock
+            ready = rec.get("ready")
+            if ready:
+                return self._ingest_poll(rid, rec, ready.pop(0), t_poll)
+            with self._lock:
+                batch = [(orid, orec)
+                         for orid, orec in self._streams.items()
+                         if orec.get("rep") is rep and not orec["done"]
+                         and orec.get("sid") is not None]
+            sids = [orec["sid"] for _, orec in batch]
+            if rec["sid"] not in sids:
+                sids.append(rec["sid"])
             try:
-                self._assign_stream(rec)
-            except Exception:  # noqa: BLE001 — retried next poll
-                pass
-            return {"tokens": [], "logprobs": [], "done": False,
+                with contextlib.ExitStack() as stack:
+                    if rec.get("trace"):
+                        stack.enter_context(_trace.scope(*rec["trace"]))
+                    outs = ray_tpu.get(
+                        rep.handle.poll_streams.remote(sids),
+                        timeout=120)
+            except ray_tpu.RayActorError:
+                return self._failover_poll(rid, rec, rep)
+            # fan the batch out: co-located streams consume their
+            # buffered result (FIFO per stream — fetches are serialized
+            # by the replica lock, so order is preserved) on their next
+            # poll without an RPC
+            for orid, orec in batch:
+                if orid == rid or orec["done"]:
+                    continue
+                out = outs.get(orec["sid"])
+                if out is not None:
+                    orec.setdefault("ready", []).append(out)
+        out = outs.get(rec["sid"]) or {"tokens": [], "logprobs": [],
+                                       "done": False, "version": None}
+        return self._ingest_poll(rid, rec, out, t_poll)
+
+    def _failover_poll(self, rid: str, rec: dict, rep: _Replica) -> dict:
+        """Mid-stream replica death discovered by a poll: re-queue onto
+        a survivor and skip the tokens the client already has — exact
+        because the replacement replays the same (seed, position) RNG
+        lanes against the same weight version. If weights were
+        republished since this stream started AND tokens are already
+        out, a replay would re-sample a DIFFERENT continuation under
+        the new version; splicing that onto the emitted prefix would
+        hand the client (and the RL experience path) a sequence no
+        single policy produced — so the stream closes cleanly at the
+        emitted prefix instead (a shorter but internally consistent
+        trajectory)."""
+        self._mark_dead(rep)
+        self._release(rep)
+        rec["rep"] = rec["sid"] = None
+        if rec["emitted"] > 0 \
+                and rec["version"] != self._weights_version:
+            rec["done"] = True
+            self._streams.pop(rid, None)
+            return {"tokens": [], "logprobs": [], "done": True,
+                    "truncated": True,
                     "weights_version": rec["version"]}
+        rec["replayed"] = 0  # replacement stream replays from 0
+        if rec["emitted"] == 0:
+            # nothing delivered: free to restart under the current
+            # version (the trajectory is whatever the retry yields)
+            rec["version"] = self._weights_version
+        try:
+            self._assign_stream(rec)
+        except Exception:  # noqa: BLE001 — retried next poll
+            pass
+        return {"tokens": [], "logprobs": [], "done": False,
+                "weights_version": rec["version"]}
+
+    def _ingest_poll(self, rid: str, rec: dict, out: dict,
+                     t_poll: float) -> dict:
+        """Fold one replica-side poll result (live or buffered) into
+        the stream record: version pinning, failover offset dedup, the
+        stream-poll span, and release-on-done."""
         # pin the stream's version to the ENGINE version its tokens are
         # actually generated under: a stream submitted inside the
         # publish-to-adoption window carries the pool's NEW publish
@@ -683,12 +818,15 @@ class LLMPool:
             _fr.record("serve", "serve.stream_poll", t_poll,
                        time.monotonic(),
                        attrs={"rid": rid, "tokens": len(fresh),
+                              "tenant": rec.get("tenant", "-"),
                               "done": bool(out["done"])},
                        trace=({"trace_id": tr[0], "parent": tr[1]}
                               if tr else None))
         if out["done"]:
             rec["done"] = True
-            self._release(rep)
+            rep = rec.get("rep")
+            if rep is not None:
+                self._release(rep)
             self._streams.pop(rid, None)
         return {"tokens": fresh, "logprobs": fresh_lps,
                 "done": out["done"],
@@ -706,6 +844,31 @@ class LLMPool:
         replicas spawned later adopt this ref at construction. Returns
         the published version."""
         if not isinstance(params, ray_tpu.ObjectRef):
+            # weight blobs are BULK traffic: claim a bulk-class grant
+            # sized to the host tree before the put fans out, so under
+            # contention a publish yields to kv/collective instead of
+            # stomping them. A typed refusal (pace deadline/injection)
+            # degrades to an unpaced publish — weight freshness beats
+            # strict pacing here, and the claim is logged as a park.
+            try:
+                import jax as _jax
+
+                from ray_tpu._private import net_accounting as _net
+                from ray_tpu._private import net_qos as _qos
+
+                nbytes = sum(
+                    int(getattr(leaf, "nbytes", 0))
+                    for leaf in _jax.tree_util.tree_leaves(params))
+                if nbytes > 0:
+                    try:
+                        _qos.acquire("serve-pool", "bulk", nbytes,
+                                     owner="weights", timeout=10.0)
+                    except _qos.NetPaceError:
+                        pass
+                    _net.account_tx("serve-pool", "bulk", "weights",
+                                    nbytes)
+            except Exception:  # noqa: BLE001 — accounting best-effort
+                pass
             params = ray_tpu.put(params)
         with self._lock:
             version = int(version) if version is not None \
@@ -911,12 +1074,16 @@ class LLMPool:
               if isinstance(s, dict) and s.get("prefix_cache")]
         hits = sum(p["hits"] for p in pc)
         total = hits + sum(p["misses"] for p in pc)
+        with self._lock:
+            tenants = sorted({tn for _, _, tn in self._ttfts})
         return {
             "replicas": len(reps),
             "queue_depth": waiting,
             "inflight": sum(r.inflight for r in reps),
             "tokens_per_sec": round(agg_tps, 1),
             "ttft_p99_s": self.ttft_p99(),
+            "ttft_p99_by_tenant": {tn: self.ttft_p99(tn)
+                                   for tn in tenants},
             "prefill_workers": len(self._prefill),
             "prefix_cache_hit_rate": (hits / total) if total else None,
             "weights_version": self._weights_version,

@@ -1,0 +1,483 @@
+"""Multi-tenant QoS enforcement (_private/net_qos.py + the paths it
+gates).
+
+Covers the ISSUE-16 acceptance surface: strict-priority token-bucket
+pacing per peer (kv > collective > bulk), chunk-granularity bulk
+preemption with byte-identical resume through the agents' pull path,
+the bounded bulk share (anti-starvation floor), typed-retryable
+NetPaceError on deadline/injection (never a deadlock), pacer-state
+purge on peer death and group teardown, per-tenant weighted fair
+admission at the pool head, the per-replica batched stream-poll
+surface, and link-aware replica placement off `net_tx_bytes_total`.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import config as cfg
+from ray_tpu._private import fault_injection
+from ray_tpu._private import net_accounting as net
+from ray_tpu._private import net_qos as qos
+from ray_tpu.cluster_utils import Cluster
+
+
+# ---------------- pure units (no cluster) ----------------
+
+@pytest.fixture
+def paced():
+    """Finite-rate pacer: 0.8 mbps = 100 KB/s against a 100 KB window
+    (1 s full refill — slow enough that priority/park assertions are
+    race-free), bulk floor 20 KB per interval."""
+    qos.reset()
+    net.reset_local()
+    cfg.set_system_config({"net_qos_rate_mbps": 0.8,
+                           "net_qos_window_bytes": 100_000})
+    yield qos
+    cfg.set_system_config({"net_qos_rate_mbps": 0.0,
+                           "net_qos_window_bytes": 0})
+    fault_injection.clear()
+    qos.reset()
+
+
+def _wait_for(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_unlimited_rate_is_grant_and_tally_only():
+    qos.reset()
+    assert not qos.enforced()
+    for _ in range(3):
+        assert qos.try_acquire("pz", "bulk", 10 * 2**20) == 0.0
+    qos.acquire("pz", "kv", 2**20, timeout=0.1)  # returns immediately
+    st = qos.stats("pz")
+    assert st["granted_bytes"]["bulk"] == 30 * 2**20
+    assert st["granted_bytes"]["kv"] == 2**20
+    assert st["parks"] == {"kv": 0, "collective": 0, "bulk": 0}
+    qos.reset()
+
+
+def test_strict_priority_parks_lower_classes(paced):
+    assert qos.try_acquire("p1", "bulk", 100_000) == 0.0  # drain window
+    done = []
+    t = threading.Thread(
+        target=lambda: (qos.acquire("p1", "kv", 50_000, timeout=10),
+                        done.append(True)))
+    t.start()
+    assert _wait_for(lambda: qos.stats("p1")["waiting"]["kv"] == 1)
+    # collective parks while kv waits; bulk past its floor parks AND
+    # counts as a preemption of the in-flight bulk transfer
+    assert qos.try_acquire("p1", "collective", 1_000) > 0
+    assert qos.try_acquire("p1", "bulk", 30_000) > 0
+    st = qos.stats("p1")
+    assert st["parks"]["collective"] >= 1
+    assert st["parks"]["bulk"] >= 1
+    assert st["preemptions"] >= 1
+    t.join(timeout=10)
+    assert done, "parked kv acquire never granted"
+    # the refilled tokens went to kv first, not the parked lower classes
+    st = qos.stats("p1")
+    assert st["granted_bytes"]["kv"] == 50_000
+    assert st["granted_bytes"]["collective"] == 0
+
+
+def test_bulk_floor_progresses_under_kv_pressure(paced):
+    cfg.set_system_config({"net_qos_window_bytes": 200_000})
+    # drain via collective so the bulk floor accounting starts at zero
+    assert qos.try_acquire("p2", "collective", 200_000) == 0.0
+    t = threading.Thread(
+        target=lambda: qos.acquire("p2", "kv", 150_000, timeout=15))
+    t.start()
+    assert _wait_for(lambda: qos.stats("p2")["waiting"]["kv"] == 1)
+    time.sleep(0.4)  # ~40 KB refilled; kv (150 KB) still far short
+    # bulk inside its 40 KB per-interval share progresses even while
+    # kv waits (anti-starvation)...
+    assert qos.try_acquire("p2", "bulk", 20_000) == 0.0
+    # ...but the next grant would exceed the share: parked
+    assert qos.try_acquire("p2", "bulk", 25_000) > 0
+    t.join(timeout=15)
+    assert qos.stats("p2")["granted_bytes"]["kv"] == 150_000
+
+
+def test_acquire_deadline_raises_typed_retryable(paced):
+    assert qos.try_acquire("p3", "bulk", 100_000) == 0.0
+    t0 = time.monotonic()
+    with pytest.raises(qos.NetPaceError) as ei:
+        # larger than the window capacity: never grantable
+        qos.acquire("p3", "kv", 1_000_000, timeout=0.3)
+    assert 0.25 <= time.monotonic() - t0 < 3.0
+    assert ei.value.retryable is True
+    assert ei.value.peer == "p3" and ei.value.qos_class == "kv"
+    # the dead waiter deregistered: no phantom priority block remains
+    st = qos.stats("p3")
+    assert st["waiting"] == {"kv": 0, "collective": 0, "bulk": 0}
+    assert 0.0 < qos.try_acquire("p3", "bulk", 50_000) <= 2.0  # hint capped
+
+
+def test_purge_resets_window_and_group_peers(paced):
+    assert qos.try_acquire("p4", "bulk", 100_000) == 0.0
+    assert qos.try_acquire("p4", "bulk", 100_000) > 0  # exhausted
+    assert qos.purge_peer("p4") is True
+    assert qos.stats("p4") == {}
+    # a reused address starts from a fresh full bucket, not the
+    # exhausted window of the dead peer
+    assert qos.try_acquire("p4", "bulk", 100_000) == 0.0
+    for peer in ("g9:r0", "g9:r1", "other"):
+        qos.try_acquire(peer, "bulk", 1)
+    assert qos.purge_group_peers("g9") == 2
+    assert qos.stats("g9:r0") == {} and qos.stats("other")
+
+
+def test_net_pace_drop_is_typed_retryable(paced):
+    fault_injection.configure([
+        {"site": "net.pace", "action": "drop", "count": 0}])
+    with pytest.raises(qos.NetPaceError) as ei:
+        qos.try_acquire("pf", "bulk", 10)
+    assert ei.value.retryable is True
+    with pytest.raises(qos.NetPaceError):
+        qos.acquire("pf", "kv", 10, timeout=1.0)
+    fault_injection.clear()
+    assert qos.try_acquire("pf", "bulk", 10) == 0.0  # recovered
+
+
+def test_net_pace_stall_never_deadlocks(paced):
+    import asyncio
+
+    fault_injection.configure([
+        {"site": "net.pace", "action": "stall", "delay_s": 0.15,
+         "count": 0}])
+    try:
+        # async-path callers are NOT slept on their loop: the injected
+        # stall surfaces as a retry hint immediately
+        t0 = time.perf_counter()
+        hint = qos.try_acquire("ps", "bulk", 10)
+        assert hint >= 0.01
+        assert time.perf_counter() - t0 < 0.1
+        # sync acquire absorbs the stall and still completes bounded
+        t0 = time.perf_counter()
+        qos.acquire("ps", "kv", 10_000, timeout=5.0)
+        assert 0.1 <= time.perf_counter() - t0 < 5.0
+        # a persistent stall converges to the typed error, not a hang
+        async def go():
+            await qos.acquire_async("ps", "bulk", 10, timeout=0.5)
+
+        t0 = time.perf_counter()
+        with pytest.raises(qos.NetPaceError):
+            asyncio.run(go())
+        assert time.perf_counter() - t0 < 3.0
+    finally:
+        fault_injection.clear()
+
+
+def test_chaos_qos_profile():
+    from ray_tpu._private import chaos
+
+    p1 = chaos.gen_fault_plan(7, profile="qos", n_prefill=1)
+    assert p1.env_value() == chaos.gen_fault_plan(
+        7, profile="qos", n_prefill=1).env_value()
+    sites = set()
+    for seed in range(80):
+        plan = chaos.gen_fault_plan(seed, profile="qos", n_prefill=1)
+        for s in plan.specs:
+            sites.add(s["site"])
+            if s["action"] in ("delay", "stall"):
+                assert s["delay_s"] > 0
+            if s["site"] == "net.pace":
+                assert s in plan.driver_specs
+    assert "net.pace" in sites
+    assert "net.pace" in chaos.DRIVER_SITES
+    # the train profile stays byte-identical for replayable soak seeds:
+    # qos sites must never leak into it
+    for seed in range(40):
+        for s in chaos.gen_fault_plan(seed, profile="train").specs:
+            assert s["site"] != "net.pace"
+
+
+def test_link_aware_placement_avoids_saturated_links():
+    from ray_tpu.autoscaler.demand_scheduler import (get_nodes_to_launch,
+                                                     link_tx_by_peer)
+
+    rows = [
+        {"name": "net_tx_bytes_total",
+         "tags": [("peer", "aaaa1111"), ("qos_class", "collective"),
+                  ("owner", "gang"), ("tenant", "-")], "value": 8e9},
+        {"name": "net_tx_bytes_total",
+         "tags": [("peer", "aaaa1111"), ("qos_class", "bulk"),
+                  ("owner", "spill"), ("tenant", "-")], "value": 4e9},
+        {"name": "net_tx_bytes_total",
+         "tags": [("peer", "bbbb2222"), ("qos_class", "kv"),
+                  ("owner", "serve"), ("tenant", "a")], "value": 1e6},
+        {"name": "other_metric", "tags": [("peer", "aaaa1111")],
+         "value": 1e18},
+    ]
+    load = link_tx_by_peer(rows)
+    assert load == {"aaaa1111": 12e9, "bbbb2222": 1e6}
+
+    free = [{"TPU": 1.0}, {"TPU": 1.0}]
+    ids = ["aaaa1111", "bbbb2222"]
+    nt = {"tpu": {"resources": {"TPU": 1.0}, "max_workers": 8}}
+    kw = dict(free_node_ids=ids, link_tx_bytes_per_s=load,
+              link_saturation_bytes_per_s=1e9)
+    # one replica lands on the cold link, no launch
+    assert get_nodes_to_launch([{"TPU": 1.0}], nt,
+                               [dict(c) for c in free], **kw) == {}
+    # a second replica avoids the gang-saturated node: fresh launch
+    assert get_nodes_to_launch([{"TPU": 1.0}] * 2, nt,
+                               [dict(c) for c in free],
+                               **kw) == {"tpu": 1}
+    # ...unless nothing can launch — then the saturated node still
+    # beats not placing at all
+    nt0 = {"tpu": {"resources": {"TPU": 1.0}, "max_workers": 0}}
+    assert get_nodes_to_launch([{"TPU": 1.0}] * 2, nt0,
+                               [dict(c) for c in free], **kw) == {}
+    # without link signals behaviour is unchanged
+    assert get_nodes_to_launch([{"TPU": 1.0}] * 2, nt,
+                               [dict(c) for c in free]) == {}
+
+
+# ---------------- agents-only integration (no driver) ----------------
+
+@pytest.fixture
+def agents_cluster():
+    # agents only, NO driver connect: drives the agent-to-agent chunk
+    # path directly (same idiom as test_flight_recorder)
+    c = Cluster(head_resources={"CPU": 2, "memory": 2 * 2**30},
+                store_capacity=256 * 2**20)
+    c.add_node(resources={"CPU": 2, "memory": 2 * 2**30})
+    yield c
+    c.shutdown()
+
+
+def _seed_owned(cluster, agent, data: bytes, owner_wid: bytes):
+    oid = os.urandom(16)
+    agent.store.put_bytes(oid, data, metadata=b"")
+    cluster.io.run(agent.rpc_object_sealed(
+        None, {"object_id": oid, "size": len(data),
+               "owner": {"worker_id": owner_wid}}))
+    return oid
+
+
+def test_bulk_pull_preempted_by_kv_resumes_byte_identical(agents_cluster):
+    """The tentpole end-to-end: a multi-chunk bulk pull on a paced link
+    is preempted at chunk granularity by kv-class acquires on the same
+    peer, parks (never cancels), and resumes byte-identically — with
+    attribution still exact with the pacer ON."""
+    c = agents_cluster
+    src, dst = c.agents[0], c.agents[1]
+    src_label = src.node_id.hex()[:8]
+    old_chunk = cfg.get("object_transfer_chunk_bytes")
+    qos.reset()
+    net.reset_local()
+    try:
+        # 256 KB chunks over a 1 MB/s paced link with a one-chunk
+        # window: each chunk needs a full refill, so kv pressure
+        # deterministically parks the in-flight bulk transfer
+        cfg.set_system_config({
+            "object_transfer_chunk_bytes": 256 * 1024,
+            "net_qos_rate_mbps": 8.0,
+            "net_qos_window_bytes": 256 * 1024,
+        })
+        wid = bytes([0xAB]) * 16
+        data = os.urandom(2 * 2**20)  # 8 chunks
+        oid = _seed_owned(c, src, data, wid)
+
+        pulled = []
+
+        def pull():
+            pulled.append(c.io.run(dst.rpc_fetch_object(
+                None, {"object_id": oid, "timeout": 120})))
+
+        pt = threading.Thread(target=pull)
+        pt.start()
+        time.sleep(0.2)  # the pull is mid-flight
+        # hammer the pull-side peer window with latency-critical kv
+        # grants for ~1.5s: while a kv acquire waits, every bulk chunk
+        # grant on this peer must park (floor 20% < one chunk)
+        t_end = time.monotonic() + 1.5
+        while time.monotonic() < t_end and pt.is_alive():
+            qos.acquire(src_label, "kv", 128 * 1024, owner="tenant-kv",
+                        timeout=5.0)
+        pt.join(timeout=120)
+        assert pulled == [True], "preempted pull never completed"
+
+        st = qos.stats(src_label)
+        assert st["parks"]["bulk"] >= 1, st     # chunk grants parked
+        assert st["preemptions"] >= 1, st       # ...while kv waited
+        assert st["granted_bytes"]["kv"] > 0, st
+        # byte-identical resume: parked chunks re-request the same
+        # offset, never restart or corrupt the object
+        buf = dst.store.get(oid)
+        assert buf is not None and bytes(buf.data) == data
+        buf.release()
+        # attribution exact with the pacer on (<= 1% by acceptance;
+        # the tally is byte-exact here)
+        owner = wid.hex()[:12]
+        assert net.total("rx", qos_class="bulk", owner=owner) == len(data)
+        assert net.total("tx", qos_class="bulk", owner=owner) == len(data)
+    finally:
+        cfg.set_system_config({
+            "object_transfer_chunk_bytes": old_chunk,
+            "net_qos_rate_mbps": 0.0,
+            "net_qos_window_bytes": 0,
+        })
+        qos.reset()
+
+
+def test_peer_death_purges_pacer_state(agents_cluster):
+    """Chaos safety: a dead peer's exhausted window must not throttle a
+    reused address forever — the node-death push purges it."""
+    c = agents_cluster
+    a, b = c.agents[0], c.agents[1]
+    label = b.node_id.hex()[:8]
+    qos.reset()
+    cfg.set_system_config({"net_qos_rate_mbps": 0.8,
+                           "net_qos_window_bytes": 100_000})
+    try:
+        assert qos.try_acquire(label, "bulk", 100_000) == 0.0
+        assert qos.try_acquire(label, "bulk", 100_000) > 0  # exhausted
+        assert qos.stats(label)
+
+        async def fire():
+            a._on_node_dead_push({"node_id": b.node_id})
+
+        c.io.run(fire())
+        assert qos.stats(label) == {}, "pacer state survived peer death"
+        # no permanent throttle: the next acquire gets a fresh window
+        assert qos.try_acquire(label, "bulk", 100_000) == 0.0
+    finally:
+        cfg.set_system_config({"net_qos_rate_mbps": 0.0,
+                               "net_qos_window_bytes": 0})
+        qos.reset()
+
+
+# ---------------- serving pool (driver-connected cluster) -------------
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(head_resources={"CPU": 8, "memory": 8 * 2**30})
+    c.connect()
+    yield c
+    c.shutdown()
+
+
+def test_two_tenant_wfq_floors_cold_tenant_ttft(cluster):
+    """A hot tenant flooding the admission queue cannot push another
+    tenant's TTFT p99 past its floor: weighted fair queueing admits the
+    cold tenant's sparse requests ahead of the hot backlog instead of
+    FIFO-appending them behind it."""
+    from ray_tpu.serve.llm_pool import LLMPool
+
+    pool = LLMPool(model_size="tiny", slots=2, max_len=96,
+                   chunk_tokens=8, prompt_buckets=(16,),
+                   min_replicas=1, max_replicas=1, chunk_delay_s=0.05,
+                   autoscale=False,
+                   tenant_weights={"hot": 1.0, "cold": 1.0})
+    try:
+        warm = [int(x) for x in
+                np.random.RandomState(5).randint(1, 250, 16)]
+        ray_tpu.get([r.handle.generate.remote(warm, 8)
+                     for r in pool._alive()], timeout=600)
+        n_hot, n_cold, new_tokens = 12, 2, 48
+        errs: list[str] = []
+
+        def one(i, tenant):
+            rng = np.random.RandomState(7000 + i)
+            prompt = [int(x) for x in rng.randint(1, 250, 16)]
+            try:
+                out = pool.generate(prompt, new_tokens, tenant=tenant)
+                assert len(out["tokens"]) == new_tokens
+            except Exception as e:  # noqa: BLE001
+                errs.append(f"{tenant} {i}: {type(e).__name__}: {e}")
+
+        hot = [threading.Thread(target=one, args=(i, "hot"))
+               for i in range(n_hot)]
+        cold = [threading.Thread(target=one, args=(100 + i, "cold"))
+                for i in range(n_cold)]
+        for t in hot:
+            t.start()
+        time.sleep(0.4)  # the hot backlog is queued and deep
+        for t in cold:
+            t.start()
+        for t in hot + cold:
+            t.join(timeout=300)
+        assert not errs, errs[0]
+
+        hot_p99 = pool.ttft_p99("hot")
+        cold_p99 = pool.ttft_p99("cold")
+        assert hot_p99 is not None and cold_p99 is not None
+        # FIFO would serialize cold behind the ~12-deep hot backlog
+        # (TTFT ~= the full drain ~= hot's worst case); WFQ admits it
+        # within a round or two
+        assert cold_p99 < 0.75 * hot_p99, (
+            f"cold tenant TTFT p99 {cold_p99:.3f}s not floored vs "
+            f"hot {hot_p99:.3f}s")
+        by_tenant = pool.stats()["ttft_p99_by_tenant"]
+        assert set(by_tenant) >= {"hot", "cold"}
+    finally:
+        pool.shutdown()
+
+
+def test_batched_stream_polls_amortize_rpcs(cluster):
+    """Satellite 1: co-located streams share one poll_streams RPC per
+    poller round instead of one RPC per stream — and batching changes
+    no tokens (greedy streams match the non-streaming output)."""
+    from ray_tpu.serve.llm_pool import LLMPool
+
+    pool = LLMPool(model_size="tiny", slots=4, max_len=96,
+                   chunk_tokens=8, prompt_buckets=(16,),
+                   min_replicas=1, max_replicas=1, chunk_delay_s=0.03,
+                   autoscale=False)
+    try:
+        rng = np.random.RandomState(11)
+        prompt = [int(x) for x in rng.randint(1, 250, 16)]
+        new_tokens = 32
+        ref = pool.generate(list(prompt), new_tokens)["tokens"]
+
+        n_streams = 3
+        client_polls = [0] * n_streams
+        toks: list[list] = [[] for _ in range(n_streams)]
+
+        rep = pool._alive()[0]
+        polls0 = ray_tpu.get(rep.handle.stats.remote(),
+                             timeout=60)["stream_polls"]
+
+        def stream_one(i):
+            sub = pool.submit_stream({"prompt_ids": list(prompt),
+                                      "max_tokens": new_tokens})
+            while True:
+                out = pool.poll_stream(sub["rid"])
+                client_polls[i] += 1
+                toks[i] += out["tokens"]
+                if out["done"]:
+                    break
+                time.sleep(0.01)
+
+        threads = [threading.Thread(target=stream_one, args=(i,))
+                   for i in range(n_streams)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        polls1 = ray_tpu.get(rep.handle.stats.remote(),
+                             timeout=60)["stream_polls"]
+
+        # greedy determinism: batched polling changed no tokens
+        for i in range(n_streams):
+            assert toks[i] == list(ref), f"stream {i} diverged"
+        # the replica served fewer poll RPCs than the clients issued
+        # polls: co-located streams rode shared batches
+        assert sum(client_polls) > n_streams
+        assert polls1 - polls0 < sum(client_polls), (
+            f"replica RPCs {polls1 - polls0} not amortized vs "
+            f"{sum(client_polls)} client polls")
+    finally:
+        pool.shutdown()

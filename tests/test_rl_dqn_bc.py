@@ -85,6 +85,7 @@ def test_dqn_learns_corridor(cluster):
         algo.stop()
 
 
+@pytest.mark.slow  # ~19s clone soak; DQN tests above cover the stack
 def test_bc_clones_expert(cluster):
     # expert: always action 1 when pos < N (i.e. always, in this env)
     rng = np.random.default_rng(0)

@@ -93,7 +93,8 @@ def test_replicas_stay_identical(cluster):
         group.shutdown()
 
 
-def test_scaling_2_and_4_learners(cluster):
+@pytest.mark.slow  # ~24s scaling sweep; gradient-parity tests above
+def test_scaling_2_and_4_learners(cluster):  # cover the update path
     """Sharded update wall-clock with 2 and 4 learners on a large batch:
     both complete and produce finite metrics; 4-learner shards are half
     the per-actor work of 2-learner shards (asserted via timing being in

@@ -128,6 +128,34 @@ def test_recorded_rl_family_floors():
     assert pub["latency_s"] <= 2.0, pub
 
 
+def test_recorded_qos_family_floors():
+    """ISSUE-16 acceptance: the committed `qos` runtime_perf family must
+    hold the multi-tenant contention floors — with the pacer ON and a
+    learner gang + bulk spill saturating the host, the serving tenant
+    keeps >= 0.7x its uncontended decode tokens/s and TTFT p99 within
+    2x uncontended, the bulk transfer still completes byte-identical,
+    and byte attribution stays within 1%. The batched stream fanout
+    must beat the old per-request poll ceiling (~106 tok/s) with well
+    under one replica poll RPC per emitted token."""
+    rec = _recorded_bench()
+    grant = rec["qos pacer grant (unlimited fast path)"]
+    # measured ~500k grants/s on the dev box: the tally fast path every
+    # tagged send pays when enforcement is off costs ~2us
+    assert grant["per_s"] >= 50_000, grant
+    cont = rec["qos serve contention (gang + bulk spill, paced)"]
+    assert cont["ratio_tokens"] >= 0.7, cont
+    assert cont["ratio_ttft"] <= 2.0, cont
+    assert cont["bulk_completed"] is True, cont
+    assert cont["attribution_err"] <= 0.01, cont
+    assert cont["pacer_parks"] > 0, cont  # pacing actually engaged
+    assert cont["rate_mbps"] > 0, cont
+    fan = rec["qos batched stream fanout (8 streams)"]
+    # measured ~640 tok/s aggregate (dev box); the pre-batching surface
+    # capped each stream near ~106 tok/s and cost ~3 RPCs/token
+    assert fan["per_s"] >= 150, fan
+    assert fan["polls_per_token"] <= 1.0, fan
+
+
 def test_pipelined_pull_2x_sequential_under_latency():
     """Cross-node pull with the chunk window vs one-request-at-a-time,
     under a deterministic injected per-chunk serve latency (the

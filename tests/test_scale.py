@@ -70,6 +70,7 @@ def test_100k_queued_task_drain(cluster):
     print(f"submit {n / t_submit:.0f}/s drain {n / t_all:.0f}/s")
 
 
+@pytest.mark.slow  # ~27s lifecycle soak; tier-1 has an 870s budget
 def test_many_actor_lifecycle(cluster):
     """Concurrent actor creation at scale: every creation must succeed
     (startup-concurrency gating — unbounded concurrent interpreter

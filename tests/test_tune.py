@@ -89,6 +89,7 @@ def test_asha_stops_bad_trials_early(cluster):
     assert best.config["level"] in (0.1, 0.2)
 
 
+@pytest.mark.slow  # ~22s; tune surface covered by the grid tests above
 def test_tune_tiny_llama_lr_with_checkpoints(cluster, tmp_path):
     """VERDICT item 10 'done' bar: tune tiny-llama LR over trials; best
     trial's checkpoint is restorable."""
