@@ -408,6 +408,7 @@ class RemoteFunction:
             "max_retries": max_retries,
             "scheduling_strategy": scheduling_strategy,
             "runtime_env": runtime_env,
+            "fetch_tags": None,
         }
         self.__name__ = getattr(func, "__name__", "remote_function")
 
@@ -466,7 +467,8 @@ class RemoteFunction:
             retries=o["max_retries"],
             scheduling_strategy=o["scheduling_strategy"],
             runtime_env=o.get("runtime_env"),
-            name=o.get("name", self.__name__), func_id=cache[1], **pg_kw,
+            name=o.get("name", self.__name__), func_id=cache[1],
+            fetch_tags=o.get("fetch_tags"), **pg_kw,
         )
         refs = [ObjectRef(i) for i in ids]
         return refs[0] if o["num_returns"] in (1, "dynamic") else refs
@@ -492,11 +494,17 @@ class ActorMethod:
         self._name = name
         self._num_returns = 1
         self._concurrency_group = None
+        self._fetch_tags = None
 
-    def options(self, num_returns=1, concurrency_group=None, **_):
+    def options(self, num_returns=1, concurrency_group=None,
+                fetch_tags=None, **_):
+        """fetch_tags={"qos": ..., "owner": ...} tags the executor-side
+        ObjectRef arg fetches (and the cross-node pulls behind them)
+        with the consuming subsystem for pacing + byte attribution."""
         m = ActorMethod(self._handle, self._name)
         m._num_returns = num_returns
         m._concurrency_group = concurrency_group
+        m._fetch_tags = dict(fetch_tags) if fetch_tags else None
         return m
 
     def remote(self, *args, **kwargs):
@@ -505,6 +513,7 @@ class ActorMethod:
             self._handle._actor_id, self._name, args, kwargs,
             num_returns=self._num_returns,
             concurrency_group=self._concurrency_group,
+            fetch_tags=self._fetch_tags,
         )
         refs = [ObjectRef(i) for i in ids]
         return refs[0] if self._num_returns == 1 else refs

@@ -42,6 +42,41 @@ _DEFS: dict[str, Any] = {
     # full instead of paying one RTT per 4MB chunk). When the directory
     # reports >1 holder, the in-flight window is striped across sources.
     "transfer_pull_pipeline_depth": 8,
+    # receive-side scatter-read: pull chunks land DIRECTLY in the shm
+    # write buffer (rpc client reads into a pre-registered destination
+    # view) instead of materializing reader-side bytes first. Read
+    # per-chunk like object_transfer_chunk_bytes, so it can be flipped
+    # live (the bench records on/off back to back).
+    "transfer_scatter_read": True,
+    # StreamReader limit for rpc client connections: with asyncio's
+    # 64KB default the transport pauses every ~128KB, costing ~32
+    # pause/resume cycles per 4MB pull chunk. This is a growth cap,
+    # not a preallocation — small-message connections stay tiny.
+    # Read at connect time (reconnect to apply).
+    "rpc_reader_buffer_bytes": 8 * 1024 * 1024,
+    # busy-refusal retry backoff (_read_chunk_backoff): initial sleep,
+    # multiplier, per-sleep cap, and the wall-clock budget for one
+    # chunk. All read per-use so a live cluster can be retuned (e.g.
+    # shrink the cap when a QoS pacer park hint dominates the sleep).
+    "transfer_busy_backoff_initial_s": 0.1,
+    "transfer_busy_backoff_mult": 1.6,
+    "transfer_busy_backoff_max_s": 2.0,
+    "transfer_busy_budget_s": 60.0,
+    # pre-fault object-store segments at creation: touch pages (and ask
+    # for transparent hugepages where the kernel offers MADV_HUGEPAGE)
+    # so pull-destination writes hit warm pages (~10 GB/s) instead of
+    # paying first-touch faults (~0.4 GB/s) on the critical path.
+    # prewarm_bytes caps how much of the heap head is touched up front
+    # (the allocator is first-fit from the head, so the warm region IS
+    # the pull-sized allocation pool); 0 disables, -1 warms the whole
+    # segment.
+    "object_store_prefault": True,
+    "object_store_hugepages": True,
+    "object_store_prewarm_bytes": 512 * 1024 * 1024,
+    # auto-prewarm only stores at least this large: the sync page-touch
+    # (~0.6s/512MB) is amortized by long-lived production stores, not
+    # by the small throwaway stores test clusters create by the hundred
+    "object_store_prefault_min_capacity": 1024 * 1024 * 1024,
     # queued-path pipelining: tasks the dispatcher may stack into one
     # pool worker's exec queue when no idle worker matches and the pool
     # is at cap (the queued analog of lease-push pipelining)

@@ -74,6 +74,46 @@ class ConnectionLost(Exception):
     pass
 
 
+async def _readinto_exactly(reader: asyncio.StreamReader,
+                            view: memoryview) -> None:
+    """readexactly(view.nbytes) scattered straight into `view`.
+
+    asyncio.StreamReader has no public readinto, so this drains the
+    reader's internal buffer into the destination — ONE copy, socket
+    buffer -> destination (typically a shared-memory write buffer),
+    with no intermediate bytes object. Falls back to readexactly +
+    copy if the private buffer attributes ever move (still correct,
+    one extra copy)."""
+    n = view.nbytes
+    buf = getattr(reader, "_buffer", None)
+    if buf is None or not hasattr(reader, "_wait_for_data") \
+            or not hasattr(reader, "_maybe_resume_transport"):
+        view[:] = await reader.readexactly(n)
+        return
+    off = 0
+    while off < n:
+        if not buf:
+            if reader.at_eof():
+                raise asyncio.IncompleteReadError(bytes(view[:off]), n)
+            await reader._wait_for_data("_readinto_exactly")
+            continue
+        avail = len(buf)
+        if avail <= n - off:
+            # consume the whole buffer: no temp bytes, no front-delete
+            # memmove — this is the hot case when draining multi-MB
+            # chunks through a large reader buffer
+            view[off:off + avail] = buf
+            buf.clear()
+            take = avail
+        else:
+            take = n - off
+            with memoryview(buf) as bm:
+                view[off:off + take] = bm[:take]
+            del buf[:take]
+        reader._maybe_resume_transport()
+        off += take
+
+
 async def _read_frame(reader: asyncio.StreamReader) -> Any:
     header = await reader.readexactly(_LEN.size)
     (n,) = _LEN.unpack(header)
@@ -107,8 +147,10 @@ class OobReply:
     that is the safe point to drop a shm pin backing the views) — or on
     a write failure / one-way misuse, so pins can never leak.
 
-    Client side: the buffers arrive as `result["oob"]` (list of bytes,
-    in order) when `payload` is a dict."""
+    Client side: the buffers arrive as `result["oob"]` (in order) when
+    `payload` is a dict — bytes normally, or views aliasing the caller's
+    pre-registered destination when the call scatter-read them
+    (`call(oob_into=...)`, flagged by `result["oob_scattered"]`)."""
 
     __slots__ = ("payload", "bufs", "release")
 
@@ -309,6 +351,9 @@ class AsyncRpcClient:
         self._writer = None
         self._reqid = 0
         self._pending: dict[int, asyncio.Future] = {}
+        # reqid -> writable memoryview pre-registered by call(oob_into=):
+        # an OOB reply's raw buffers are scatter-read straight into it
+        self._oob_dest: dict[int, memoryview] = {}
         self._push_handlers: dict[str, Callable[[Any], None]] = {}
         self._read_task: asyncio.Task | None = None
         self.closed = False
@@ -326,8 +371,16 @@ class AsyncRpcClient:
         last = None
         for _ in range(retries):
             try:
+                from ray_tpu._private import config as _cfg
+
+                # a large reader buffer lets the transport deliver whole
+                # multi-MB OOB chunks between flow-control pauses — the
+                # default 64KB limit costs ~32 pause/resume cycles per
+                # 4MB chunk on the pull path (memory is only used when
+                # the sender outruns the reader)
                 self._reader, self._writer = await asyncio.open_connection(
-                    self.host, self.port
+                    self.host, self.port,
+                    limit=int(_cfg.get("rpc_reader_buffer_bytes")),
                 )
                 break
             except OSError as e:
@@ -350,6 +403,7 @@ class AsyncRpcClient:
                 kind = msg[0]
                 if kind == RESPONSE:
                     _, reqid, ok, payload = msg
+                    self._oob_dest.pop(reqid, None)  # e.g. busy refusal
                     fut = self._pending.pop(reqid, None)
                     if fut is not None and not fut.done():
                         if ok:
@@ -361,11 +415,29 @@ class AsyncRpcClient:
                     # the raw buffers follow the header on the stream and
                     # MUST be consumed even if the caller gave up (timed
                     # out / disconnected) — they are part of the framing
-                    bufs = [await self._reader.readexactly(n)
-                            for n in sizes]
+                    dest = self._oob_dest.pop(reqid, None)
+                    scattered = (ok and dest is not None
+                                 and sum(sizes) <= dest.nbytes)
+                    if scattered:
+                        # scatter-read: each raw buffer lands at its
+                        # offset in the caller's destination (the shm
+                        # write buffer) — no intermediate bytes. The
+                        # attached views alias the destination.
+                        bufs = []
+                        off = 0
+                        for n in sizes:
+                            v = dest[off:off + n]
+                            await _readinto_exactly(self._reader, v)
+                            bufs.append(v)
+                            off += n
+                    else:
+                        bufs = [await self._reader.readexactly(n)
+                                for n in sizes]
                     fut = self._pending.pop(reqid, None)
                     if ok and isinstance(payload, dict):
                         payload["oob"] = bufs
+                        if scattered:
+                            payload["oob_scattered"] = True
                     if fut is not None and not fut.done():
                         if ok:
                             fut.set_result(payload)
@@ -388,19 +460,36 @@ class AsyncRpcClient:
                 if not fut.done():
                     fut.set_exception(err)
             self._pending.clear()
+            self._oob_dest.clear()
             if self.on_close is not None:
                 try:
                     self.on_close()
                 except Exception:
                     logger.exception("on_close callback failed")
 
-    async def call(self, method: str, payload: Any = None, timeout=None) -> Any:
+    async def call(self, method: str, payload: Any = None, timeout=None,
+                   oob_into: memoryview | None = None) -> Any:
+        """One request/response. `oob_into` pre-registers a writable
+        destination: an OOB reply's raw buffers are scatter-read
+        straight into it (the attached "oob" views alias it and the
+        result carries "oob_scattered"). Because the read loop writes
+        into the buffer whenever the reply arrives, a scatter call may
+        NOT also set a timeout — an abandoned-but-registered buffer
+        written after the caller moved on (freed/reused shm) would be
+        silent corruption. Scatter callers bound their wait with a
+        wall-clock budget between attempts instead; only connection
+        death interrupts an in-flight scatter, and a dead read loop
+        can no longer write."""
         if self.closed:
             raise ConnectionLost(f"connection to {self.host}:{self.port} closed")
+        if oob_into is not None and timeout is not None:
+            raise ValueError("oob_into and timeout are mutually exclusive")
         self._reqid += 1
         reqid = self._reqid
         fut = asyncio.get_running_loop().create_future()
         self._pending[reqid] = fut
+        if oob_into is not None:
+            self._oob_dest[reqid] = memoryview(oob_into)
         _write_frame(self._writer, [REQUEST, reqid, method, payload])
         await self._writer.drain()
         if timeout is not None:
@@ -586,16 +675,19 @@ class SyncRpcClient:
                 logger.exception("on_reconnect callback failed")
         return True
 
-    def call(self, method: str, payload: Any = None, timeout=None) -> Any:
+    def call(self, method: str, payload: Any = None, timeout=None,
+             oob_into: memoryview | None = None) -> Any:
         try:
             return self.io.run(
-                self.client.call(method, payload, timeout=timeout)
+                self.client.call(method, payload, timeout=timeout,
+                                 oob_into=oob_into)
             )
         except ConnectionLost:
             if not self._try_reconnect():
                 raise
             return self.io.run(
-                self.client.call(method, payload, timeout=timeout)
+                self.client.call(method, payload, timeout=timeout,
+                                 oob_into=oob_into)
             )
 
     def oneway(self, method: str, payload: Any = None):

@@ -193,7 +193,8 @@ def run_transfer_benchmarks(*, quick: bool = False) -> list[dict]:
     agents = [
         NodeAgent("127.0.0.1", head_port,
                   resources={"CPU": 1.0, "memory": 2.0 * 2**30},
-                  store_capacity=512 * 1024 * 1024,
+                  # full mode adds a 1GB pull tier; quick keeps it lean
+                  store_capacity=(512 if quick else 1536) * 1024 * 1024,
                   session_id=f"xfer{sid}{i}")
         for i in range(3)
     ]
@@ -258,6 +259,47 @@ def run_transfer_benchmarks(*, quick: bool = False) -> list[dict]:
                gb_per_s=round(nbytes / min(two) / 1e9, 3),
                sources=(agents[2].transfer_stats["last_pull"] or
                         {}).get("sources"))
+        # scatter A/B at 64MB: the pipelined tiers above run with
+        # transfer_scatter_read ON (the default); this is the same
+        # 1-source pull with the receive fast path disabled — the
+        # reader-side copy cost in isolation
+        _cfg.set_system_config({"transfer_scatter_read": False})
+        off = []
+        for _ in range(iters):
+            oid = seed(agents[0])
+            off.append(pull(agents[1], oid))
+            agents[1].store.delete(oid)
+            agents[0].store.pin(oid, False)
+            agents[0].store.delete(oid)
+        _cfg.set_system_config({"transfer_scatter_read": True})
+        record("cross-node pull 64MB (scatter off)", 1.0 / min(off),
+               gb_per_s=round(nbytes / min(off) / 1e9, 3))
+        if not quick:
+            # 1GB tier, scatter on vs off (needs the 1.5GB stores)
+            gbytes = 1024 * 1024 * 1024
+            gblob = _os.urandom(gbytes)
+
+            def seed_big(agent):
+                oid = _os.urandom(16)
+                agent.store.put_bytes(oid, gblob, metadata=b"")
+                io.run(agent.rpc_object_sealed(
+                    None, {"object_id": oid, "size": gbytes}))
+                return oid
+
+            for flag, tag in ((True, "scatter on"),
+                              (False, "scatter off")):
+                _cfg.set_system_config({"transfer_scatter_read": flag})
+                times = []
+                for _ in range(2):
+                    oid = seed_big(agents[0])
+                    times.append(pull(agents[1], oid))
+                    agents[1].store.delete(oid)
+                    agents[0].store.pin(oid, False)
+                    agents[0].store.delete(oid)
+                record(f"cross-node pull 1GB ({tag})", 1.0 / min(times),
+                       gb_per_s=round(gbytes / min(times) / 1e9, 3))
+            del gblob
+            _cfg.set_system_config({"transfer_scatter_read": True})
     finally:
         for a in agents:
             try:
@@ -269,6 +311,48 @@ def run_transfer_benchmarks(*, quick: bool = False) -> list[dict]:
         except Exception:
             pass
         io.stop()
+
+    # -- consumer tier (driver-attached pool): the serve-side transfers
+    #    that ride the pull fast path with declared fetch tags --
+    import jax
+
+    from ray_tpu.serve.llm import build_model
+    from ray_tpu.serve.llm_pool import LLMPool
+
+    pool = LLMPool(model_size="tiny", slots=4, max_len=96, chunk_tokens=8,
+                   prompt_buckets=(8, 16), min_replicas=2, max_replicas=2,
+                   prefill_workers=1, prefill_threshold=12,
+                   autoscale=False)
+    try:
+        params, _ = build_model("tiny", max_len=96, seed=1)
+        host = jax.tree_util.tree_map(
+            lambda a: np.asarray(jax.device_get(a)), params)
+        lats = []
+        for _ in range(2 if quick else 4):
+            t0 = time.perf_counter()
+            v = pool.publish_weights(host)
+            assert pool.wait_version(v, timeout=60.0), "adoption timeout"
+            lats.append(time.perf_counter() - t0)
+        record("transfer weight publish-to-adoption (2 replicas)",
+               1.0 / min(lats), latency_s=round(min(lats), 4),
+               weight_bytes=int(sum(
+                   a.nbytes for a in jax.tree_util.tree_leaves(host))))
+        # prefill-to-decode kv handoff: a disaggregated 1-token generate
+        # (prompt over prefill_threshold) — prefill on the worker, kv
+        # adoption on the decode replica, one decode chunk
+        rng = np.random.RandomState(11)
+        pool.generate([int(x) for x in rng.randint(1, 250, 14)], 1)  # warm
+        lats = []
+        for i in range(3 if quick else 6):
+            p2 = [int(x) for x in np.random.RandomState(20 + i)
+                  .randint(1, 250, 14)]
+            t0 = time.perf_counter()
+            pool.generate(p2, 1)
+            lats.append(time.perf_counter() - t0)
+        record("transfer kv handoff (prefill to decode, 1 token)",
+               1.0 / min(lats), latency_s=round(min(lats), 4))
+    finally:
+        pool.shutdown()
     return results
 
 

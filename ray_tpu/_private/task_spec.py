@@ -104,6 +104,10 @@ _TASK_FIELDS = {
     # owner→leased-worker direct pushes mark this so the executor batches
     # its done-reports to the agent instead of acking per task
     "leased": (False, _is_bool, "bool"),
+    # consumer attribution {qos, owner} applied while the executor
+    # resolves this task's ObjectRef args: the fetches (and the pulls
+    # they trigger) are tagged with the subsystem they serve
+    "fetch_tags": (False, _is_dict, "dict"),
 }
 
 _ACTOR_FIELDS = {
@@ -140,6 +144,8 @@ _ACTOR_TASK_FIELDS = {
                           "str|None"),
     "seq": (True, _is_int, "int"),
     "trace": (False, _is_dict, "dict"),
+    # consumer attribution for arg-staging fetches (see _TASK_FIELDS)
+    "fetch_tags": (False, _is_dict, "dict"),
 }
 
 _ID_LENGTHS = {

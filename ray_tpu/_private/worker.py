@@ -51,6 +51,42 @@ from ray_tpu._private import config as _config
 INLINE_MAX = _config.get("inline_object_max_bytes")  # under: inline; over: shm
 FUNC_NS = "funcs"
 
+# Ambient consumer tags for plasma fetches issued on this thread: a
+# fetch_context(qos=, owner=) scope makes every fetch_object RPC inside
+# it declare WHICH subsystem the pull serves (weights broadcast, kv
+# handoff, checkpoint restore). The agent threads the tags into the
+# pull's pacer grants and net_accounting rows, so per-consumer transfer
+# numbers need no bespoke plumbing at each call site.
+_fetch_tags = threading.local()
+
+
+class fetch_context:
+    """with fetch_context(qos="kv", owner="kv-handoff"): ray_tpu.get(ref)
+
+    Nestable; the innermost scope wins. `qos` is a pacer class
+    ("kv" | "collective" | "bulk"), `owner` a free-form consumer label."""
+
+    def __init__(self, qos: str | None = None, owner: str | None = None):
+        self._tags = {}
+        if qos is not None:
+            self._tags["qos"] = str(qos)
+        if owner is not None:
+            self._tags["owner"] = str(owner)
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_fetch_tags, "tags", None)
+        _fetch_tags.tags = self._tags or None
+        return self
+
+    def __exit__(self, *exc):
+        _fetch_tags.tags = self._prev
+        return False
+
+
+def current_fetch_tags() -> dict | None:
+    return getattr(_fetch_tags, "tags", None)
+
 
 class RayTaskError(Exception):
     """A task raised; carries the remote traceback (reference RayTaskError)."""
@@ -901,9 +937,11 @@ class CoreWorker:
             fetch_cap = _config.get("fetch_retry_timeout_s")
             timeout = fetch_cap if deadline is None else max(
                 0.1, deadline - time.monotonic())
-            ok = self.agent.call("fetch_object", {
-                "object_id": oid, "timeout": min(timeout, fetch_cap),
-            })
+            req = {"object_id": oid, "timeout": min(timeout, fetch_cap)}
+            tags = current_fetch_tags()
+            if tags:
+                req.update(tags)  # consumer {qos, owner} attribution
+            ok = self.agent.call("fetch_object", req)
             if not ok:
                 if deadline is not None and time.monotonic() > deadline:
                     raise GetTimeoutError(oid.hex())
@@ -1110,7 +1148,8 @@ class CoreWorker:
                     bundle_index: int = -1, bundle_nodes: list | None = None,
                     scheduling_strategy=None, runtime_env: dict | None = None,
                     name: str = "",
-                    func_id: bytes | None = None) -> list[bytes]:
+                    func_id: bytes | None = None,
+                    fetch_tags: dict | None = None) -> list[bytes]:
         if func_id is None:
             func_id = self.export_function(func)
         # parent chain: drivers are roots; executor-submitted tasks chain
@@ -1142,6 +1181,7 @@ class CoreWorker:
             runtime_env=(self._prepare_runtime_env(runtime_env)
                          if runtime_env else None),
             trace=_trace.for_submit(),
+            fetch_tags=fetch_tags,
         )
         n_ret = 1 if num_returns == "dynamic" else num_returns
         return_ids = [
@@ -2023,7 +2063,8 @@ class CoreWorker:
 
     def submit_actor_task(self, actor_id: bytes, method_name: str,
                           args, kwargs, *, num_returns: int = 1,
-                          concurrency_group: str | None = None) -> list[bytes]:
+                          concurrency_group: str | None = None,
+                          fetch_tags: dict | None = None) -> list[bytes]:
         seq = self._actor_seq.setdefault(actor_id, _Counter()).next()
         task_id = TaskID.for_actor_task(ActorID(actor_id), seq).binary()
         args_spec, deps, inline_values = self._pack_args(args, kwargs)
@@ -2039,6 +2080,7 @@ class CoreWorker:
             seq=seq,
             concurrency_group=concurrency_group,
             trace=_trace.for_submit(),
+            fetch_tags=fetch_tags,
         )
         return_ids = [
             ObjectID.for_task_return(TaskID(task_id), i).binary()

@@ -201,6 +201,19 @@ class NodeAgent:
         self.store = ObjectStoreClient.create(
             self.store_name, store_capacity
         )
+        if (cfg.get("object_store_prefault")
+                and store_capacity
+                >= int(cfg.get("object_store_prefault_min_capacity"))):
+            # pay the first-touch page faults HERE, off the data path:
+            # pull-destination writes then land on warm pages (~10 GB/s
+            # vs ~0.4 GB/s faulting). First-fit allocates from the heap
+            # head, so the warmed prefix is the pull-buffer pool. Gated
+            # on capacity: production stores (multi-GB) amortize the
+            # ~0.6s/512MB touch over a long life; the small short-lived
+            # stores test clusters spin up by the hundred do not.
+            self.store.prewarm(
+                int(cfg.get("object_store_prewarm_bytes")),
+                hugepage=bool(cfg.get("object_store_hugepages")))
         self.head: AsyncRpcClient | None = None
         self.workers: dict[bytes, WorkerHandle] = {}
         self.task_queue: deque[dict] = deque()
@@ -215,6 +228,11 @@ class NodeAgent:
         self.bundle_available: dict[tuple[bytes, int], dict] = {}
         self._peer_clients: dict[bytes, AsyncRpcClient] = {}
         self._pull_sched: pull_manager.PullScheduler | None = None
+        # oid -> {"qos", "owner"} declared by the fetch_object caller
+        # (consumer attribution: weights broadcast, kv handoff,
+        # checkpoint restore); consumed by _pull_object. The scheduler
+        # dedups concurrent requests per oid, so first declarer wins.
+        self._fetch_tags: dict[bytes, dict] = {}
         # cross-host pull instrumentation (the OpStats complement: proves
         # the pipeline actually overlaps chunk requests; tests and the
         # perf harness read it, /metrics exports it)
@@ -1517,12 +1535,16 @@ class NodeAgent:
                        and not self._is_inline(d, spec)]
             if missing:
                 now = time.monotonic()
+                # the submitter's consumer tags (weights broadcast, kv
+                # handoff, checkpoint restore) attribute the dep pulls
+                ftags = spec.get("fetch_tags") or None
                 if not spec.get("_fetching"):
                     spec["_fetching"] = True
                     spec["_fetching_since"] = now
                     for d in missing:
                         asyncio.ensure_future(self._ensure_local(
-                            d, priority=pull_manager.PRI_TASK_ARG))
+                            d, priority=pull_manager.PRI_TASK_ARG,
+                            tags=ftags))
                 elif now - spec.get("_fetching_since", now) > DEP_LOST_S:
                     # No copy appeared anywhere: tell the owner so it can
                     # lineage-reconstruct (object_recovery_manager.h:90),
@@ -1534,7 +1556,8 @@ class NodeAgent:
                     spec["_fetching_since"] = now
                     for d in missing:
                         asyncio.ensure_future(self._ensure_local(
-                            d, priority=pull_manager.PRI_TASK_ARG))
+                            d, priority=pull_manager.PRI_TASK_ARG,
+                            tags=ftags))
                 self.task_queue.append(spec)
                 stalled += 1
                 continue
@@ -2360,17 +2383,30 @@ class NodeAgent:
         # Release once this connection has served the whole object,
         # counted in BYTES — pipelined pulls complete out of order, so
         # "served the final offset" alone says nothing about earlier
-        # chunks still in flight. Serving the tail chunk also releases:
-        # a STRIPED pull splits the object across sources, so no single
-        # connection ever reaches total — the tail-serving source drops
-        # its pin here and the other sources' pins fall to the idle
-        # sweep (SERVE_PIN_TTL_S). A retried chunk can double-count and
-        # release early; later chunks then simply re-pin.
+        # chunks still in flight. The byte count lives OUTSIDE the pin
+        # entry (serve_counts): out-of-order serving can release the
+        # pin on the tail chunk while earlier chunks are still queued,
+        # and those must re-pin WITHOUT resetting the count or the
+        # re-pin never reaches total and holds the store until the TTL
+        # sweep (a 1GB pull would strand 7x64MB behind such pins). A
+        # striped pull splits the object across sources so no single
+        # connection reaches total — the count is dropped once the full
+        # object (or the tail) has been served, and stragglers fall to
+        # the idle sweep (SERVE_PIN_TTL_S). A retried chunk can
+        # double-count and release early; later chunks simply re-pin.
+        counts = conn.state.setdefault("serve_counts", {})
         if ent is None:
-            ent = pins[oid] = [buf, time.monotonic(), 0]
+            ent = pins[oid] = [buf, time.monotonic()]
         ent[1] = time.monotonic()
-        ent[2] += end - offset
-        if ent[2] >= total or end >= total:
+        n = counts.get(oid, 0) + (end - offset)
+        if n >= total:
+            # fully served — drop the count too
+            counts.pop(oid, None)
+        else:
+            # keep the count even when the tail releases the pin below:
+            # chunks still in flight re-pin and must keep accumulating
+            counts[oid] = n
+        if n >= total or end >= total:
             pins.pop(oid, None)
             release = buf.release
         else:
@@ -2380,13 +2416,21 @@ class NodeAgent:
 
     def _release_serve_pins(self, conn, *, older_than: float | None = None):
         pins = conn.state.get("serve_pins")
-        if not pins:
-            return
-        now = time.monotonic()
-        for oid, ent in list(pins.items()):
-            if older_than is None or now - ent[1] > older_than:
-                pins.pop(oid, None)
-                ent[0].release()
+        if pins:
+            now = time.monotonic()
+            for oid, ent in list(pins.items()):
+                if older_than is None or now - ent[1] > older_than:
+                    pins.pop(oid, None)
+                    ent[0].release()
+        # served-byte counts that outlived their pin (striped pulls
+        # never reach total on one connection) hold no store resource,
+        # but prune them so the dict can't grow without bound
+        counts = conn.state.get("serve_counts")
+        if counts:
+            pins = conn.state.get("serve_pins") or {}
+            for oid in list(counts):
+                if oid not in pins:
+                    counts.pop(oid, None)
 
     async def _serve_pin_sweep_loop(self):
         while not self._dead:
@@ -2402,29 +2446,50 @@ class NodeAgent:
         self._release_serve_pins(conn)
 
     async def rpc_fetch_object(self, conn, p):
-        """Local worker asks: make this object present in the node store."""
-        ok = await self._ensure_local(p["object_id"],
-                                      timeout=p.get("timeout", 60.0))
-        return bool(ok)
+        """Local worker asks: make this object present in the node store.
+        Optional {"qos", "owner"} tags declare the CONSUMER the pull
+        serves (weights broadcast, kv handoff, checkpoint restore) —
+        they ride into the pull's pacer grants and byte attribution so
+        per-consumer transfer numbers fall out of net_accounting."""
+        oid = p["object_id"]
+        tags = None
+        if p.get("qos") or p.get("owner"):
+            tags = {"qos": str(p.get("qos") or "bulk"),
+                    "owner": str(p.get("owner") or "unknown")}
+        return bool(await self._ensure_local(
+            oid, timeout=p.get("timeout", 60.0), tags=tags))
 
     async def _ensure_local(self, oid: bytes, timeout: float = 60.0,
-                            priority: int = pull_manager.PRI_GET) -> bool:
+                            priority: int = pull_manager.PRI_GET,
+                            tags: dict | None = None) -> bool:
         """Make the object present locally via the pull scheduler:
         priority-ordered (task args > gets > restores) and admission-
         gated on store headroom (pull_manager.py; reference
-        pull_manager.h:52)."""
+        pull_manager.h:52). `tags` ({"qos", "owner"}) declare the
+        consumer the pull serves; the scheduler dedups concurrent
+        requests per oid, so the first declarer's tags win."""
         if self.store.contains(oid):
             return True
+        own_tags = bool(tags) and oid not in self._fetch_tags
+        if own_tags:
+            self._fetch_tags[oid] = dict(tags)
         if self._pull_sched is None:
             self._pull_sched = pull_manager.PullScheduler(
                 self._pull_object, self.store,
                 max_active=cfg.get("pull_max_active"),
                 watermark=cfg.get("pull_admission_watermark"))
-        return await asyncio.shield(
-            self._pull_sched.request(oid, priority, timeout))
+        try:
+            return await asyncio.shield(
+                self._pull_sched.request(oid, priority, timeout))
+        finally:
+            if own_tags:
+                self._fetch_tags.pop(oid, None)
 
     async def _pull_object(self, oid: bytes, deadline: float,
                            reserve=lambda n: None) -> bool:
+        # consumer tags declared by the fetch_object caller (read, not
+        # popped: the declaring RPC owns the entry's lifetime)
+        tags = self._fetch_tags.get(oid) or {}
         while time.monotonic() < deadline:
             try:
                 info = await self.head.call("object_wait_location", {
@@ -2476,7 +2541,9 @@ class NodeAgent:
                     # fails over chunk-by-chunk
                     pulled = await self._pull_from(
                         clis, oid, nids=nids,
-                        owner=_owner_label(info.get("owner")))
+                        owner=(tags.get("owner")
+                               or _owner_label(info.get("owner"))),
+                        qos=tags.get("qos", "bulk"))
                 except StoreFullError:
                     # store saturated even after LRU eviction: back off
                     # and retry within the deadline — the admission
@@ -2492,19 +2559,30 @@ class NodeAgent:
         return False
 
     async def _read_chunk_backoff(self, cli: AsyncRpcClient, oid: bytes,
-                                  offset: int, budget_s: float = 60.0,
+                                  offset: int, budget_s: float | None = None,
                                   attrib: dict | None = None,
-                                  peer: str | None = None):
+                                  peer: str | None = None,
+                                  into: memoryview | None = None):
         """read_object_chunk with bounded backoff on the server's
         retryable {"busy": True} refusal (its pacing deadline expired:
         our own connection is flooded, or the QoS window parked us
         behind a higher class). Bounded by WALL CLOCK, not
         attempt count — each refused attempt can itself block in the
         server's drain wait, so counting attempts alone could pin a pull
-        on one flooded location for minutes. Returns the chunk dict, or
-        None (missing / still flooded — the outer pull loop retries
-        other locations within its own deadline)."""
-        backoff = 0.1
+        on one flooded location for minutes. The backoff curve is live-
+        tunable (transfer_busy_backoff_initial_s / _mult / _max_s and
+        transfer_busy_budget_s, read per-use like
+        object_transfer_chunk_bytes). `into` pre-registers a scatter
+        destination: the chunk's OOB bytes land directly in it (the shm
+        write buffer) with no intermediate copy — the call deliberately
+        carries NO rpc timeout (see AsyncRpcClient.call), so only
+        connection death interrupts it, and a dead read loop can no
+        longer write into the buffer. Returns the chunk dict, or None
+        (missing / still flooded — the outer pull loop retries other
+        locations within its own deadline)."""
+        backoff = float(cfg.get("transfer_busy_backoff_initial_s"))
+        if budget_s is None:
+            budget_s = float(cfg.get("transfer_busy_budget_s"))
         deadline = time.monotonic() + budget_s
         req = {"object_id": oid, "offset": offset}
         if attrib:
@@ -2526,14 +2604,18 @@ class NodeAgent:
                     timeout=max(1.0, deadline - time.monotonic()))
             except _qos.NetPaceError:
                 return None
+        # only pass oob_into when scatter is actually engaged: test
+        # doubles (and any duck-typed client) need not know the kwarg
+        kw = {"oob_into": into} if into is not None else {}
         while True:
-            part = await cli.call("read_object_chunk", req)
+            part = await cli.call("read_object_chunk", req, **kw)
             if not (isinstance(part, dict) and part.get("busy")):
                 return part
             if time.monotonic() > deadline:
                 return None
-            await asyncio.sleep(min(backoff, 2.0))
-            backoff *= 1.6
+            await asyncio.sleep(
+                min(backoff, float(cfg.get("transfer_busy_backoff_max_s"))))
+            backoff *= float(cfg.get("transfer_busy_backoff_mult"))
 
     async def _await_sealed(self, oid: bytes, timeout: float = 10.0) -> bool:
         """Another writer (concurrent pull or local producer) holds the
@@ -2547,16 +2629,23 @@ class NodeAgent:
         return False
 
     async def _pull_from(self, clis, oid: bytes, *, nids=None,
-                         owner: str = "unknown") -> bool:
+                         owner: str = "unknown",
+                         qos: str = "bulk") -> bool:
         """Pipelined multi-source pull (object_manager.cc:633 redesigned
         around the pull RTT): chunk 0 establishes total size + metadata,
         then a sliding window of transfer_pull_pipeline_depth concurrent
         chunk requests keeps the pipe full — arriving chunks land at
         their offset in the pre-created write buffer, so out-of-order
-        completion is fine. With several source locations the window is
-        striped across them (round-robin by worker), and a chunk whose
-        assigned source fails retries the remaining sources before the
-        pull gives up. Failure paths abort the half-written buffer."""
+        completion is fine. Under transfer_scatter_read (the default)
+        each chunk is scatter-read DIRECTLY into its offset slice of the
+        write buffer — no reader-side bytes, one copy socket→shm. With
+        several source locations the window is striped across them
+        (round-robin by worker), and a chunk whose assigned source fails
+        retries the remaining sources before the pull gives up (a retry
+        rewrites the same slice byte-identically, so a half-scattered
+        chunk can never leak a silent zero gap). Failure paths abort the
+        half-written buffer. `qos`/`owner` tag the pacer grants and byte
+        attribution with the consuming subsystem."""
         if not isinstance(clis, (list, tuple)):
             clis = [clis]
         t0 = time.monotonic()
@@ -2568,7 +2657,7 @@ class NodeAgent:
             labels = [f"src{i}" for i in range(len(clis))]
         label_of = {id(c): lbl for c, lbl in zip(clis, labels)}
         rx_by: dict[str, int] = {}
-        attrib = {"requester": self.node_id.hex()[:8], "qos": "bulk",
+        attrib = {"requester": self.node_id.hex()[:8], "qos": qos,
                   "owner": owner}
         try:
             first = None
@@ -2604,43 +2693,56 @@ class NodeAgent:
                 # so offsets line up even if our config disagrees
                 offsets = deque(range(n0, total, n0)) if n0 else deque()
                 depth = max(1, int(cfg.get("transfer_pull_pipeline_depth")))
-                st = {"inflight": 0, "peak": 1, "chunks": 1, "failed": False}
+                st = {"inflight": 0, "peak": 1, "chunks": 1,
+                      "scattered": 0, "failed": False}
 
-                async def read_one(cli, off, want):
-                    """One source's chunk, or None: connection loss /
-                    rpc errors / a WRONG-SIZED reply (a source with a
-                    different chunk-size config would leave a silent
-                    zero gap in the sealed object) all mean 'try the
-                    next source', not 'abort the pull'."""
+                async def read_one(cli, off, want, into):
+                    """One source's chunk, or (None, False): connection
+                    loss / rpc errors / a WRONG-SIZED reply (a source
+                    with a different chunk-size config would leave a
+                    silent zero gap in the sealed object) all mean 'try
+                    the next source', not 'abort the pull'. Returns
+                    (data, scattered): scattered means the bytes already
+                    sit at their offset in the write buffer and `data`
+                    aliases it — no copy needed (or allowed)."""
                     try:
                         part = await self._read_chunk_backoff(
                             cli, oid, off, attrib=attrib,
-                            peer=label_of[id(cli)])
+                            peer=label_of[id(cli)], into=into)
                     except (rpc.ConnectionLost, rpc.RpcError, OSError):
-                        return None
+                        return None, False
                     if part is None:
-                        return None
+                        return None, False
                     data = _part_chunk(part)
                     if len(data) != want:
-                        return None
+                        return None, False
                     lbl = label_of[id(cli)]
                     rx_by[lbl] = rx_by.get(lbl, 0) + len(data)
-                    return data
+                    return data, bool(part.get("oob_scattered"))
 
                 async def fetch_chunks(widx: int):
                     own = clis[widx % len(clis)]
                     while offsets and not st["failed"]:
                         off = offsets.popleft()
                         want = min(n0, total - off)
+                        # scatter destination: the chunk's slice of the
+                        # shm write buffer (knob read per-chunk so the
+                        # bench can flip it live). A failed attempt may
+                        # leave it half-written; the failover below
+                        # rewrites the SAME slice in full.
+                        into = wbuf.data[off:off + want] \
+                            if cfg.get("transfer_scatter_read") else None
                         st["inflight"] += 1
                         st["peak"] = max(st["peak"], st["inflight"])
                         try:
-                            data = await read_one(own, off, want)
+                            data, scat = await read_one(
+                                own, off, want, into)
                             if data is None:
                                 for alt in clis:
                                     if alt is own:
                                         continue
-                                    data = await read_one(alt, off, want)
+                                    data, scat = await read_one(
+                                        alt, off, want, into)
                                     if data is not None:
                                         break
                         finally:
@@ -2648,7 +2750,10 @@ class NodeAgent:
                         if data is None:
                             st["failed"] = True
                             return
-                        wbuf.data[off:off + len(data)] = data
+                        if not scat:
+                            wbuf.data[off:off + len(data)] = data
+                        else:
+                            st["scattered"] += 1
                         st["chunks"] += 1
 
                 n_workers = min(depth, len(offsets))
@@ -2670,13 +2775,14 @@ class NodeAgent:
                     wbuf.meta[:] = meta
                 wbuf.seal()
                 dt = time.monotonic() - t0
-                self._record_pull(oid, total, st, len(clis), dt)
+                self._record_pull(oid, total, st, len(clis), dt,
+                                  owner=owner, qos=qos)
                 try:
                     from ray_tpu._private import flight_recorder as _fr
                     from ray_tpu._private import net_accounting as _net
 
                     for lbl, n in rx_by.items():
-                        _net.account_rx(lbl, "bulk", owner, n)
+                        _net.account_rx(lbl, qos, owner, n)
                     _fr.record(
                         "transfer", "transfer.pull", t0, t0 + dt,
                         attrs={"oid": oid.hex()[:16], "bytes": total,
@@ -2694,7 +2800,8 @@ class NodeAgent:
             return False
 
     def _record_pull(self, oid: bytes, total: int, st: dict,
-                     n_sources: int, dt: float):
+                     n_sources: int, dt: float, *,
+                     owner: str = "unknown", qos: str = "bulk"):
         ts = self.transfer_stats
         ts["pulls"] += 1
         ts["pull_bytes"] += total
@@ -2702,8 +2809,9 @@ class NodeAgent:
         ts["pull_max_inflight"] = max(ts["pull_max_inflight"], st["peak"])
         ts["last_pull"] = {
             "oid": oid.hex(), "bytes": total, "chunks": st["chunks"],
+            "scattered": st.get("scattered", 0),
             "sources": n_sources, "max_inflight": st["peak"],
-            "seconds": round(dt, 6),
+            "seconds": round(dt, 6), "owner": owner, "qos": qos,
         }
         try:
             m = _transfer_metrics()
@@ -2890,43 +2998,89 @@ class NodeAgent:
         return await self._restore_from_disk(oid)
 
     async def _restore_from_disk(self, oid: bytes) -> bool:
-        """The actual spill-file -> store reload."""
+        """The actual spill-file -> store reload, through the same
+        chunked zero-intermediate-copy discipline as the wire path: the
+        payload is readinto() the store write buffer chunk by chunk —
+        no whole-file bytes materialization (the old path paid
+        file -> bytes -> shm, two copies of the object) — yielding to
+        the loop between chunks so a multi-GB restore cannot wedge the
+        agent's io loop."""
         if self.store.contains(oid):
             return True
         path = self.spilled_files.get(oid)
         if path is None:
             return False
+        t0 = time.monotonic()
         try:
-            with open(path, "rb") as f:
-                meta_len = int.from_bytes(f.read(8), "little")
-                meta = f.read(meta_len)
-                data = f.read()
+            fsize = os.path.getsize(path)
+            f = open(path, "rb")
         except OSError:
             return False
-        need = len(data) + len(meta)
         stored = False
-        for _ in range(len(self.primaries) + 2):
-            try:
-                self.store.put_bytes(oid, data, metadata=meta)
-                stored = True
-                break
-            except Exception:
-                # store full: evict unpinned copies, then swap out other
-                # primaries (spill) until the restore fits
-                self.store.evict(need)
-                swapped = False
-                for other in list(self.primaries):
-                    if other != oid:
-                        swapped = await self._spill_one(other)
-                        if swapped:
-                            break
-                if not swapped:
+        dsize = 0
+        try:
+            meta_len = int.from_bytes(f.read(8), "little")
+            meta = f.read(meta_len)
+            dsize = max(0, fsize - 8 - meta_len)
+            need = dsize + meta_len
+            for _ in range(len(self.primaries) + 2):
+                wbuf = None
+                try:
+                    wbuf = self.store.create_object(oid, dsize, meta_len)
+                    step = _chunk_size()
+                    off = 0
+                    while off < dsize:
+                        want = min(step, dsize - off)
+                        got = f.readinto(wbuf.data[off:off + want])
+                        if not got:
+                            raise OSError(f"short spill file {path}")
+                        off += got
+                        await asyncio.sleep(0)
+                    if meta:
+                        wbuf.meta[:] = meta
+                    wbuf.seal()
+                    wbuf = None
+                    stored = True
                     break
+                except ObjectExistsError:
+                    # concurrent writer (another restore/pull) owns the
+                    # buffer: wait for its seal rather than fighting
+                    stored = await self._await_sealed(oid)
+                    break
+                except OSError:
+                    if wbuf is not None:
+                        wbuf.abort()
+                    break  # truncated/unreadable spill file
+                except Exception:
+                    if wbuf is not None:
+                        wbuf.abort()
+                    f.seek(8 + meta_len)
+                    # store full: evict unpinned copies, then swap out
+                    # other primaries (spill) until the restore fits
+                    self.store.evict(need)
+                    swapped = False
+                    for other in list(self.primaries):
+                        if other != oid:
+                            swapped = await self._spill_one(other)
+                            if swapped:
+                                break
+                    if not swapped:
+                        break
+        finally:
+            f.close()
         if not stored:
             # keep the spill file: the object is still recoverable later
             return False
+        try:
+            from ray_tpu._private import flight_recorder as _fr
+
+            _fr.record("transfer", "transfer.restore", t0, time.monotonic(),
+                       attrs={"oid": oid.hex()[:16], "bytes": dsize,
+                              "owner": "checkpoint"})
+        except Exception:  # noqa: BLE001 — observability best-effort
+            pass
         self.store.pin(oid, True)
-        self.primaries[oid] = len(data)
+        self.primaries[oid] = dsize
         self.spilled_files.pop(oid, None)
         try:
             os.unlink(path)

@@ -60,6 +60,8 @@ def _load_lib():
         getattr(lib, fn).argtypes = [p]
     lib.store_base_ptr.restype = ctypes.c_void_p
     lib.store_base_ptr.argtypes = [p]
+    lib.store_prewarm.restype = u64
+    lib.store_prewarm.argtypes = [p, u64, ctypes.c_int]
     lib.store_list.restype = u64
     lib.store_list.argtypes = [p, ctypes.c_char_p, u64]
     return lib
@@ -264,6 +266,18 @@ class ObjectStoreClient:
 
     def evict(self, needed: int) -> int:
         return lib().store_evict(self._h, needed)
+
+    def prewarm(self, nbytes: int, hugepage: bool = True) -> int:
+        """Pre-fault the leading `nbytes` of the heap (content-preserving
+        page touches; optionally request transparent hugepages for the
+        mapping). First-fit allocation hands out the heap head first, so
+        the warmed prefix is the pool pull-sized write buffers come from
+        — paid once at creation instead of as ~0.4 GB/s first-touch
+        faults on the receive path. Returns bytes touched."""
+        if nbytes < 0:
+            nbytes = self.capacity()
+        return int(lib().store_prewarm(
+            self._h, int(nbytes), 1 if hugepage else 0))
 
     def list_objects(self, max_n: int = 65536) -> list[bytes]:
         buf = ctypes.create_string_buffer(max_n * _ID_SIZE)

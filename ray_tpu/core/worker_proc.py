@@ -477,9 +477,17 @@ class Executor(CoreWorker):
                 return self._get_one(oid, None)
             return x
 
+        # spec-declared consumer tags scope every fetch below (and the
+        # cross-node pulls they trigger): the submitter knows which
+        # subsystem these args serve (weights broadcast, kv handoff)
+        from ray_tpu._private.worker import fetch_context
+
+        ftags = spec.get("fetch_tags") or {}
         try:
-            args = tuple(_resolve(a) for a in args)
-            kwargs = {k: _resolve(v) for k, v in kwargs.items()}
+            with fetch_context(qos=ftags.get("qos"),
+                               owner=ftags.get("owner")):
+                args = tuple(_resolve(a) for a in args)
+                kwargs = {k: _resolve(v) for k, v in kwargs.items()}
         finally:
             if blocked:
                 self._notify_unblocked()

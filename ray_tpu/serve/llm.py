@@ -42,8 +42,12 @@ def build_model(model_size: str = "tiny", *, max_len: int = 512,
     if isinstance(params_blob, ray_tpu.ObjectRef):
         # actor CONSTRUCTOR args ship as an opaque payload (no dep
         # staging, unlike method calls) — resolve the published weight
-        # ref here, via the pipelined multi-source pull
-        params_blob = ray_tpu.get(params_blob, timeout=600)
+        # ref here, via the pipelined multi-source pull, tagged as the
+        # weights broadcast for pacing + byte attribution
+        from ray_tpu._private.worker import fetch_context
+
+        with fetch_context(qos="bulk", owner="weights"):
+            params_blob = ray_tpu.get(params_blob, timeout=600)
 
     if model_size == "tiny":  # test-sized config
         cfg = llama.LlamaConfig(
@@ -440,7 +444,10 @@ class LLMServer:
         import ray_tpu
 
         if isinstance(params_blob, ray_tpu.ObjectRef):
-            params_blob = ray_tpu.get(params_blob, timeout=600)
+            from ray_tpu._private.worker import fetch_context
+
+            with fetch_context(qos="bulk", owner="weights"):
+                params_blob = ray_tpu.get(params_blob, timeout=600)
         with self._lock:
             self._pending_weights = (params_blob, int(version))
         return int(version)

@@ -48,6 +48,12 @@ from ray_tpu.serve.llm import LLMServer, build_model
 
 logger = logging.getLogger(__name__)
 
+# consumer tags for the two data-plane fast paths this pool drives:
+# the executor-side pulls behind these calls carry them into pacer
+# grants and net_accounting rows (per-consumer transfer numbers)
+_WEIGHTS_TAGS = {"qos": "bulk", "owner": "weights"}
+_KV_TAGS = {"qos": "kv", "owner": "kv-handoff"}
+
 
 class PrefillWorker:
     """Dedicated prefill pool member: computes KV rows + the first
@@ -538,7 +544,8 @@ class LLMPool:
                                           "queued": self._waiting})
             try:
                 if kv_ref is not None:
-                    ref = rep.handle.adopt_prefilled.remote(
+                    ref = rep.handle.adopt_prefilled.options(
+                        fetch_tags=_KV_TAGS).remote(
                         kv_ref, prompt_ids, max_tokens, tenant=tenant,
                         **sampling)
                 else:
@@ -656,7 +663,8 @@ class LLMPool:
                 # restarts re-decode from the prompt (offset dedup)
                 try:
                     sid = ray_tpu.get(
-                        rep.handle.submit_stream_prefilled.remote(
+                        rep.handle.submit_stream_prefilled.options(
+                            fetch_tags=_KV_TAGS).remote(
                             rec["kv_ref"], rec["prompt_ids"],
                             rec["max_tokens"], tenant=tenant,
                             **rec["sampling"]),
@@ -883,14 +891,19 @@ class LLMPool:
         rep_refs = []
         for r in reps:
             try:
+                # fetch_tags: the executor-side pull of `params` is the
+                # weights BROADCAST — tag its pacer grants + rx bytes so
+                # net_accounting shows the publish per consumer
                 rep_refs.append(
-                    (r, r.handle.update_weights.remote(params, version)))
+                    (r, r.handle.update_weights.options(
+                        fetch_tags=_WEIGHTS_TAGS).remote(params, version)))
             except Exception:  # noqa: BLE001
                 rep_refs.append((r, None))
         pw_refs = []
         for p in pws:
             try:
-                pw_refs.append(p.update_weights.remote(params, version))
+                pw_refs.append(p.update_weights.options(
+                    fetch_tags=_WEIGHTS_TAGS).remote(params, version))
             except Exception:  # noqa: BLE001
                 pass
         for r, ref in rep_refs:
@@ -1013,7 +1026,9 @@ class LLMPool:
             if cur_v > 0:
                 for r in fresh:
                     try:
-                        r.handle.update_weights.remote(cur_ref, cur_v)
+                        r.handle.update_weights.options(
+                            fetch_tags=_WEIGHTS_TAGS).remote(
+                            cur_ref, cur_v)
                     except Exception:  # noqa: BLE001
                         pass
             self._last_scale_up = time.monotonic()

@@ -24,6 +24,8 @@ from ray_tpu.train.checkpoint import (  # noqa: F401
     save_state,
     restore_state,
     verify_checkpoint,
+    ship_checkpoint,
+    fetch_checkpoint,
 )
 from ray_tpu.train.trainer import (  # noqa: F401
     JaxTrainer,

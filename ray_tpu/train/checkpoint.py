@@ -504,6 +504,79 @@ def restore_state(path: str, mesh=None, shardings=None, *,
         reader.close()
 
 
+def ship_checkpoint(ckpt: "Checkpoint | str") -> Any:
+    """Ship a checkpoint directory through the object store.
+
+    Returns an ObjectRef whose value is ``{"dir": basename, "members":
+    {fname: uint8 array}}``. Members are mmapped, so the put writes
+    page cache → shm directly (the single copy); a cross-node
+    :func:`fetch_checkpoint` then rides the pipelined multi-source pull
+    with its chunked OOB framing — the same receive fast path as weight
+    broadcast — instead of a filesystem copy. Spill/restore of the
+    shipped object goes through the agent's chunked readinto paths.
+    """
+    import mmap
+
+    import ray_tpu
+
+    path = ckpt.path if isinstance(ckpt, Checkpoint) else \
+        os.path.abspath(ckpt)
+    if not os.path.isdir(path):
+        raise CheckpointCorruptError(path, "checkpoint directory missing")
+    members: dict[str, Any] = {}
+    maps = []
+    try:
+        for fn in sorted(os.listdir(path)):
+            full = os.path.join(path, fn)
+            if not os.path.isfile(full):
+                continue
+            size = os.path.getsize(full)
+            if size == 0:
+                members[fn] = np.empty(0, dtype=np.uint8)
+                continue
+            f = open(full, "rb")  # noqa: SIM115 — lifetime spans the put
+            mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+            maps.append((f, mm))
+            members[fn] = np.frombuffer(mm, dtype=np.uint8)
+        # _inline=False: the ref travels side channels (trainer state,
+        # resume messages) — third processes need the store copy
+        return ray_tpu.put(
+            {"dir": os.path.basename(path), "members": members},
+            _inline=False)
+    finally:
+        members.clear()  # release the mmap views before closing
+        for f, mm in maps:
+            try:
+                mm.close()
+            except BufferError:
+                pass  # a straggler view pins pages until gc; harmless
+            f.close()
+
+
+def fetch_checkpoint(ref: Any, dest_root: str, *,
+                     timeout: float = 600.0) -> Checkpoint:
+    """Materialize a shipped checkpoint under ``dest_root``.
+
+    The get runs under ``fetch_context(qos="bulk", owner="checkpoint")``
+    so a cross-node restore is attributed to the checkpoint consumer in
+    net_accounting and pulls through the scatter-read data plane; member
+    arrays view the shm segment directly (zero-copy get), so writing
+    them out is the only post-transfer copy. Verifies integrity before
+    returning."""
+    import ray_tpu
+    from ray_tpu._private.worker import fetch_context
+
+    with fetch_context(qos="bulk", owner="checkpoint"):
+        blob = ray_tpu.get(ref, timeout=timeout)
+    path = os.path.join(os.path.abspath(dest_root), blob["dir"])
+    os.makedirs(path, exist_ok=True)
+    for fn, arr in blob["members"].items():
+        with open(os.path.join(path, fn), "wb") as f:
+            f.write(memoryview(np.ascontiguousarray(arr)))
+    verify_checkpoint(path)
+    return Checkpoint(path)
+
+
 class CheckpointManager:
     """Retention + ranking (air/_internal/checkpoint_manager.py analog)."""
 

@@ -342,6 +342,255 @@ def test_serve_pin_cached_across_chunks_and_released(cluster3):
 
 
 # ---------------------------------------------------------------------------
+# receive-side zero-copy (scatter-read)
+# ---------------------------------------------------------------------------
+
+
+def _oob_server(payload: bytes):
+    """A one-method rpc server whose `chunk` handler replies with
+    `payload` out-of-band; returns (io, server, client)."""
+    from ray_tpu._private.rpc import EventLoopThread, RpcServer
+
+    io = EventLoopThread("scatter-test")
+    server = RpcServer("127.0.0.1", 0)
+
+    async def handler(conn, p):
+        return rpc.OobReply({"total": len(payload)}, [memoryview(payload)])
+
+    server.handlers["chunk"] = handler
+    port = io.run(server.start())
+    cli = rpc.SyncRpcClient("127.0.0.1", port, io)
+    return io, server, cli
+
+
+def test_scatter_read_lands_in_registered_buffer_zero_copy():
+    """rpc-layer proof of the receive fast path: a call with `oob_into`
+    scatters the OOB payload directly into the registered buffer —
+    result["oob"] views SHARE MEMORY with it (np.shares_memory), so no
+    intermediate reader-side bytes object ever exists."""
+    payload = os.urandom(2 << 20)
+    io, server, cli = _oob_server(payload)
+    dest = np.zeros(2 << 20, dtype=np.uint8)
+    try:
+        r = cli.call("chunk", {}, oob_into=memoryview(dest))
+        assert r.get("oob_scattered") is True
+        got = np.frombuffer(r["oob"][0], dtype=np.uint8)
+        assert np.shares_memory(got, dest)  # aliases the registered buffer
+        assert bytes(dest) == payload
+    finally:
+        cli.close()
+        io.run(server.stop())
+        io.stop()
+
+
+def test_scatter_read_oversized_reply_falls_back_no_overflow():
+    """A reply larger than the registered buffer must NOT scatter (no
+    buffer overflow): the client falls back to the copying path and the
+    destination stays untouched."""
+    payload = os.urandom(1 << 20)
+    io, server, cli = _oob_server(payload)
+    dest = np.zeros(1 << 19, dtype=np.uint8)  # half the payload size
+    try:
+        r = cli.call("chunk", {}, oob_into=memoryview(dest))
+        assert "oob_scattered" not in r
+        assert bytes(r["oob"][0]) == payload  # copying fallback, intact
+        assert not dest.any()  # registered buffer untouched
+    finally:
+        cli.close()
+        io.run(server.stop())
+        io.stop()
+
+
+def test_oob_into_and_timeout_mutually_exclusive():
+    """An abandoned-but-registered destination buffer would be written
+    by a late reply — the API forbids the combination outright."""
+    payload = b"x"
+    io, server, cli = _oob_server(payload)
+    try:
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            cli.call("chunk", {}, timeout=5,
+                     oob_into=memoryview(bytearray(8)))
+    finally:
+        cli.close()
+        io.run(server.stop())
+        io.stop()
+
+
+def test_pull_scatter_writes_chunks_in_place(cluster3):
+    """With transfer_scatter_read on (the default) every pipelined chunk
+    after the lead lands directly in the shm write buffer — the agent's
+    scattered counter equals chunks-1 and the object is byte-identical."""
+    c = cluster3
+    data = os.urandom(24 * 2**20)  # 6 chunks at the default 4MB
+    oid = _seed(c, c.agents[0], data)
+    assert _pull(c, c.agents[1], oid)
+    last = c.agents[1].transfer_stats["last_pull"]
+    assert last["chunks"] == 6
+    assert last["scattered"] == last["chunks"] - 1  # all but the lead
+    assert _stored_bytes(c.agents[1], oid) == data
+
+
+def test_pull_scatter_off_knob_falls_back_byte_identical(cluster3):
+    """Flipping the knob off live routes every chunk through the
+    copying path (scattered == 0) with identical bytes."""
+    c = cluster3
+    with _flag(transfer_scatter_read=False):
+        data = os.urandom(8 * 2**20)
+        oid = _seed(c, c.agents[0], data)
+        assert _pull(c, c.agents[1], oid)
+        last = c.agents[1].transfer_stats["last_pull"]
+        assert last["scattered"] == 0
+        assert _stored_bytes(c.agents[1], oid) == data
+
+
+def test_scatter_failed_pull_aborts_half_written_buffer(cluster3):
+    """Chaos coverage for the scatter path: persistent object.read_chunk
+    drops exhaust the busy budget mid-transfer — the half-scattered
+    write buffer must be ABORTED (the store never exposes a sealed
+    object with silent zero gaps), and a retry after the fault clears
+    is byte-identical."""
+    c = cluster3
+    dst = c.agents[1]
+    with _flag(object_transfer_chunk_bytes=256 * 1024,
+               transfer_busy_budget_s=1.0,
+               transfer_busy_backoff_initial_s=0.05):
+        # every byte nonzero, so any leaked gap would be detectable
+        data = bytes((i % 255) + 1 for i in range(2 * 2**20))  # 8 chunks
+        oid = _seed(c, c.agents[0], data)
+        cli = c.io.run(dst._peer_agent(c.agents[0].node_id))
+        fault_injection.configure([
+            {"site": "object.read_chunk", "action": "drop",
+             "after": 3, "count": 10_000},
+        ])
+        try:
+            assert c.io.run(dst._pull_from([cli], oid)) is False
+            assert not dst.store.contains(oid)  # aborted, never sealed
+        finally:
+            fault_injection.clear()
+        assert c.io.run(dst._pull_from([cli], oid)) is True
+        assert dst.transfer_stats["last_pull"]["scattered"] >= 1
+        assert _stored_bytes(dst, oid) == data
+
+
+def test_scatter_retry_after_stall_byte_identical(cluster3):
+    """A stalled chunk read under scatter (delay fault) completes late
+    but lands at the right offset — byte-identity holds with the
+    pipeline reordering around it."""
+    c = cluster3
+    with _flag(object_transfer_chunk_bytes=256 * 1024):
+        data = bytes((i * 7 % 255) + 1 for i in range(4 * 2**20))
+        oid = _seed(c, c.agents[0], data)
+        fault_injection.configure([
+            {"site": "object.read_chunk", "action": "delay",
+             "match": {"offset": 768 * 1024}, "delay_s": 0.4, "count": 1},
+        ])
+        try:
+            assert _pull(c, c.agents[1], oid)
+        finally:
+            fault_injection.clear()
+        last = c.agents[1].transfer_stats["last_pull"]
+        assert last["scattered"] == last["chunks"] - 1
+        assert _stored_bytes(c.agents[1], oid) == data
+
+
+def test_fetch_tags_attribute_pull_owner_and_qos(cluster3):
+    """Consumer tags carried by fetch_object (the fetch_context /
+    fetch_tags plumbing) flow through to the pull's pacer class and
+    net_accounting owner attribution."""
+    from ray_tpu._private import net_accounting as _net
+
+    c = cluster3
+    data = os.urandom(4 * 2**20)
+    oid = _seed(c, c.agents[0], data)
+    _net.reset_local()
+    ok = c.io.run(c.agents[1].rpc_fetch_object(
+        None, {"object_id": oid, "timeout": 60,
+               "qos": "kv", "owner": "kv-handoff"}))
+    assert ok
+    last = c.agents[1].transfer_stats["last_pull"]
+    assert last["owner"] == "kv-handoff"
+    assert last["qos"] == "kv"
+    assert _net.total("rx", qos_class="kv", owner="kv-handoff") >= len(data)
+
+
+def test_task_fetch_tags_drive_dep_prefetch_attribution():
+    """END-TO-END consumer path: `fn.options(fetch_tags=...)` rides the
+    task spec to the executing node, whose dispatch-time dep prefetch
+    pulls the arg cross-node with the declared owner/qos — scattered,
+    paced in the declared class, and attributed in net_accounting."""
+    from ray_tpu._private import api
+    from ray_tpu._private import net_accounting as _net
+
+    prev_worker = api._worker
+    c = Cluster(head_resources={"CPU": 0, "memory": 2 * 2**30},
+                store_capacity=256 * 2**20)
+    n2 = c.add_node(resources={"CPU": 2, "memory": 2 * 2**30})
+    c.connect()
+    try:
+        _net.reset_local()
+        ref = ray_tpu.put(np.arange(1 << 19, dtype=np.float64))  # 4MB
+
+        @ray_tpu.remote(num_cpus=1)
+        def consume(x):
+            return float(x[5])
+
+        out = ray_tpu.get(consume.options(
+            fetch_tags={"qos": "kv", "owner": "kv-handoff"}).remote(ref),
+            timeout=90)
+        assert out == 5.0
+        last = n2.transfer_stats["last_pull"]
+        assert last["owner"] == "kv-handoff"
+        assert last["qos"] == "kv"
+        assert last["scattered"] == last["chunks"] - 1
+        assert _net.total("rx", qos_class="kv",
+                          owner="kv-handoff") >= 4 * 2**20
+    finally:
+        c.shutdown()
+        api._set_global_worker(prev_worker)
+
+
+def test_prewarmed_segment_allocates_from_warm_prefix(cluster3):
+    """object_store_prefault pre-touches the heap head at agent start;
+    a pull-sized create_object then allocates from the warmed prefix
+    (first-fit from the heap head) and round-trips correctly."""
+    dst = cluster3.agents[1]
+    n = dst.store.prewarm(8 * 2**20)  # idempotent re-touch
+    assert n == 8 * 2**20
+    oid = os.urandom(16)
+    wbuf = dst.store.create_object(oid, 1 << 20, 0)
+    wbuf.data[:] = b"\x5a" * (1 << 20)
+    wbuf.seal()
+    assert _stored_bytes(dst, oid) == b"\x5a" * (1 << 20)
+    dst.store.delete(oid)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint transport over the object store
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_ships_and_fetches_through_object_store(cluster,
+                                                           tmp_path):
+    """ship_checkpoint / fetch_checkpoint round-trip a checkpoint
+    directory through the object store with owner="checkpoint"
+    attribution — the restore path of the receive-side data plane."""
+    from ray_tpu._private import net_accounting as _net
+    from ray_tpu.train.checkpoint import (
+        Checkpoint, fetch_checkpoint, ship_checkpoint)
+
+    src_dir = tmp_path / "src"
+    ckpt = Checkpoint.from_dict(
+        {"step": 7, "blob": os.urandom(2 * 2**20)}, str(src_dir))
+    _net.reset_local()
+    ref = ship_checkpoint(ckpt)
+    out = fetch_checkpoint(ref, str(tmp_path / "dst"))
+    assert out.to_dict()["step"] == 7
+    assert out.to_dict()["blob"] == ckpt.to_dict()["blob"]
+    # local fetch needs no pull, but the fetch_context tags must be in
+    # effect during the get — verified cross-node by the tag test above
+
+
+# ---------------------------------------------------------------------------
 # proactive reconstruction on node_dead
 # ---------------------------------------------------------------------------
 

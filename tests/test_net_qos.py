@@ -332,6 +332,67 @@ def test_bulk_pull_preempted_by_kv_resumes_byte_identical(agents_cluster):
         qos.reset()
 
 
+def test_scatter_pull_preempted_by_kv_resumes_byte_identical(agents_cluster):
+    """Scatter-read × QoS: a bulk pull whose chunks scatter directly
+    into the shm write buffer is preempted mid-transfer by a kv hammer
+    (chunk-granularity park/resume), resumes byte-identically, keeps
+    the zero-copy path for resumed chunks (scattered counter), and the
+    byte attribution stays exact."""
+    c = agents_cluster
+    src, dst = c.agents[0], c.agents[1]
+    src_label = src.node_id.hex()[:8]
+    old_chunk = cfg.get("object_transfer_chunk_bytes")
+    qos.reset()
+    net.reset_local()
+    try:
+        cfg.set_system_config({
+            "object_transfer_chunk_bytes": 256 * 1024,
+            "net_qos_rate_mbps": 8.0,
+            "net_qos_window_bytes": 256 * 1024,
+            "transfer_scatter_read": True,
+        })
+        wid = bytes([0xCD]) * 16
+        data = os.urandom(2 * 2**20)  # 8 chunks
+        oid = _seed_owned(c, src, data, wid)
+
+        pulled = []
+
+        def pull():
+            pulled.append(c.io.run(dst.rpc_fetch_object(
+                None, {"object_id": oid, "timeout": 120})))
+
+        pt = threading.Thread(target=pull)
+        pt.start()
+        time.sleep(0.2)  # mid-flight
+        t_end = time.monotonic() + 1.5
+        while time.monotonic() < t_end and pt.is_alive():
+            qos.acquire(src_label, "kv", 128 * 1024, owner="tenant-kv",
+                        timeout=5.0)
+        pt.join(timeout=120)
+        assert pulled == [True], "preempted scatter pull never completed"
+
+        st = qos.stats(src_label)
+        assert st["parks"]["bulk"] >= 1, st
+        assert st["preemptions"] >= 1, st
+        last = dst.transfer_stats["last_pull"]
+        # park/resume kept the zero-copy receive path: resumed chunks
+        # still scatter straight into the write buffer
+        assert last["scattered"] == last["chunks"] - 1, last
+        buf = dst.store.get(oid)
+        assert buf is not None and bytes(buf.data) == data
+        buf.release()
+        owner = wid.hex()[:12]
+        assert net.total("rx", qos_class="bulk", owner=owner) == len(data)
+        assert net.total("tx", qos_class="bulk", owner=owner) == len(data)
+    finally:
+        cfg.set_system_config({
+            "object_transfer_chunk_bytes": old_chunk,
+            "net_qos_rate_mbps": 0.0,
+            "net_qos_window_bytes": 0,
+        })
+        qos.reset()
+
+
 def test_peer_death_purges_pacer_state(agents_cluster):
     """Chaos safety: a dead peer's exhausted window must not throttle a
     reused address forever — the node-death push purges it."""

@@ -83,6 +83,7 @@ def _gae_batch(batch, learner, gamma=0.99, lam=0.95):
     return {**batch, "advantages": adv, "returns": ret}
 
 
+@pytest.mark.slow  # ~17s learning loop; tier-1 keeps the weight-push test
 def test_external_env_learns_through_policy_server(cluster):
     from ray_tpu.rl.learner import Learner
     from ray_tpu.rl.rl_module import DiscretePolicyModule

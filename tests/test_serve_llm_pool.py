@@ -258,6 +258,41 @@ def test_pool_generate_stream_and_disagg_parity(cluster):
         pool.shutdown()
 
 
+def test_pool_consumer_tags_ride_fetch_path(cluster):
+    """The pool's two big transfers declare their consumer identity:
+    weight broadcast submits with {owner: weights, qos: bulk} and the
+    executor's param fetch carries those tags to fetch_object; the
+    prefill→decode KV handoff submits with {owner: kv-handoff, qos: kv}.
+    (Cross-node, these tags select the pull's pacer class and owner
+    attribution — test_data_plane asserts that half.)"""
+    w = cluster._driver
+    submits = []
+    orig_submit = w.submit_actor_task
+
+    def rec_submit(*a, **k):
+        if k.get("fetch_tags"):
+            submits.append(dict(k["fetch_tags"]))
+        return orig_submit(*a, **k)
+
+    w.submit_actor_task = rec_submit
+    pool = LLMPool(model_size="tiny", slots=2, max_len=96,
+                   chunk_tokens=4, prompt_buckets=(8, 16),
+                   min_replicas=2, max_replicas=2, prefill_workers=1,
+                   prefill_threshold=12, autoscale=False)
+    try:
+        rng = np.random.RandomState(5)
+        p = rng.randint(1, 256, size=14).astype(np.int32)  # disagg path
+        pool.generate(p.tolist(), 4)
+        params = llama.init_params(TINY, jax.random.PRNGKey(1))
+        v = pool.publish_weights(params)
+        assert pool.wait_version(v, timeout=60)
+        assert {"qos": "bulk", "owner": "weights"} in submits, submits
+        assert {"qos": "kv", "owner": "kv-handoff"} in submits, submits
+    finally:
+        w.submit_actor_task = orig_submit
+        pool.shutdown()
+
+
 def test_pool_chaos_replica_kill_no_client_visible_error(cluster):
     """THE chaos acceptance: kill a decode replica mid-stream and
     mid-generate; the pool re-queues in-flight work to survivors and

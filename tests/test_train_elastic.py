@@ -271,6 +271,9 @@ def test_single_rank_death_resumes_inplace(cluster, tmp_path, monkeypatch):
         float(np.square(_ref_params(STEPS)).sum()), rel=1e-4)
 
 
+@pytest.mark.slow  # ~22s (20s wedge quiesce timeout by design); the
+# in-place and gang-restart paths keep tier-1 coverage via the
+# single-rank-death and checkpoint-resume tests in this file
 def test_wedged_survivor_falls_back_to_gang_restart(cluster, tmp_path):
     """If a survivor won't quiesce (user code swallows the abort), the
     in-place path must give up and the gang restart must still converge."""
