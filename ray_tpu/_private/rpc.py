@@ -92,6 +92,14 @@ async def _readinto_exactly(reader: asyncio.StreamReader,
         return
     off = 0
     while off < n:
+        # Mirror readexactly(): surface a connection error recorded while
+        # no waiter was outstanding. set_exception() only wakes an
+        # EXISTING waiter, so without this check a connection_lost(exc)
+        # that lands between chunks would let the next _wait_for_data()
+        # park on a waiter nothing will ever wake.
+        exc = reader.exception()
+        if exc is not None:
+            raise exc
         if not buf:
             if reader.at_eof():
                 raise asyncio.IncompleteReadError(bytes(view[:off]), n)

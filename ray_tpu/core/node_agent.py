@@ -2398,14 +2398,18 @@ class NodeAgent:
         if ent is None:
             ent = pins[oid] = [buf, time.monotonic()]
         ent[1] = time.monotonic()
-        n = counts.get(oid, 0) + (end - offset)
+        cent = counts.get(oid)
+        n = (cent[0] if cent is not None else 0) + (end - offset)
         if n >= total:
             # fully served — drop the count too
             counts.pop(oid, None)
         else:
             # keep the count even when the tail releases the pin below:
-            # chunks still in flight re-pin and must keep accumulating
-            counts[oid] = n
+            # chunks still in flight re-pin and must keep accumulating.
+            # The timestamp lets the sweep distinguish a live pin-less
+            # count (tail released the pin, earlier chunks in flight)
+            # from an abandoned one.
+            counts[oid] = [n, time.monotonic()]
         if n >= total or end >= total:
             pins.pop(oid, None)
             release = buf.release
@@ -2424,12 +2428,19 @@ class NodeAgent:
                     ent[0].release()
         # served-byte counts that outlived their pin (striped pulls
         # never reach total on one connection) hold no store resource,
-        # but prune them so the dict can't grow without bound
+        # but prune them so the dict can't grow without bound. A
+        # pin-less count can be LIVE, though: a pipelined pull's tail
+        # chunk releases the pin while earlier chunks are still in
+        # flight, and resetting the count then would strand the re-pin
+        # until the TTL — so only prune counts idle past the same
+        # older_than threshold as the pins (disconnect drops all).
         counts = conn.state.get("serve_counts")
         if counts:
             pins = conn.state.get("serve_pins") or {}
-            for oid in list(counts):
-                if oid not in pins:
+            now = time.monotonic()
+            for oid, cent in list(counts.items()):
+                if oid not in pins and (
+                        older_than is None or now - cent[1] > older_than):
                     counts.pop(oid, None)
 
     async def _serve_pin_sweep_loop(self):
@@ -2478,17 +2489,25 @@ class NodeAgent:
                 self._pull_object, self.store,
                 max_active=cfg.get("pull_max_active"),
                 watermark=cfg.get("pull_admission_watermark"))
-        try:
-            return await asyncio.shield(
-                self._pull_sched.request(oid, priority, timeout))
-        finally:
-            if own_tags:
-                self._fetch_tags.pop(oid, None)
+        req = asyncio.ensure_future(
+            self._pull_sched.request(oid, priority, timeout))
+        if own_tags:
+            # The tag entry must outlive the REQUEST, not this await:
+            # the request is shielded, so a cancelled/timed-out caller
+            # returns while the pull is still running and may not have
+            # read its tags yet — a finally here would silently strip
+            # the transfer's consumer attribution. Pop when the request
+            # itself completes instead.
+            req.add_done_callback(
+                lambda _f: self._fetch_tags.pop(oid, None))
+        return await asyncio.shield(req)
 
     async def _pull_object(self, oid: bytes, deadline: float,
                            reserve=lambda n: None) -> bool:
         # consumer tags declared by the fetch_object caller (read, not
-        # popped: the declaring RPC owns the entry's lifetime)
+        # popped: the declaring request's done-callback owns the
+        # entry's lifetime, which spans this whole pull even if the
+        # declaring RPC was cancelled mid-await)
         tags = self._fetch_tags.get(oid) or {}
         while time.monotonic() < deadline:
             try:

@@ -268,12 +268,16 @@ class ObjectStoreClient:
         return lib().store_evict(self._h, needed)
 
     def prewarm(self, nbytes: int, hugepage: bool = True) -> int:
-        """Pre-fault the leading `nbytes` of the heap (content-preserving
-        page touches; optionally request transparent hugepages for the
-        mapping). First-fit allocation hands out the heap head first, so
-        the warmed prefix is the pool pull-sized write buffers come from
-        — paid once at creation instead of as ~0.4 GB/s first-touch
-        faults on the receive path. Returns bytes touched."""
+        """Pre-fault the leading `nbytes` of the heap (content-preserving;
+        optionally request transparent hugepages for the mapping).
+        First-fit allocation hands out the heap head first, so the warmed
+        prefix is the pool pull-sized write buffers come from — paid once
+        at creation instead of as ~0.4 GB/s first-touch faults on the
+        receive path. Faulting uses MADV_POPULATE_WRITE (no data
+        read-modify-write, safe on a live store); on kernels without it
+        (< 5.14) the page-touch fallback only runs while the store holds
+        no objects, so a live-store call there is a no-op. Returns bytes
+        faulted."""
         if nbytes < 0:
             nbytes = self.capacity()
         return int(lib().store_prewarm(

@@ -564,14 +564,21 @@ uint64_t store_map_size(void* sp) { return ((Store*)sp)->map_size; }
 
 // Pre-fault the leading `bytes` of the heap (and optionally request
 // transparent hugepages for the whole mapping). First-touch page faults
-// on a fresh shm segment throttle writers to ~0.4 GB/s; touching the
+// on a fresh shm segment throttle writers to ~0.4 GB/s; faulting the
 // pages once up front — off the critical path, at store creation —
 // moves pull-destination writes onto warm pages (~10 GB/s). The
 // allocator is first-fit from the heap head, so the warmed prefix IS
-// the pool pull-sized allocations come from. Touches preserve content
-// (volatile read-modify-write of the first byte of each page): the
-// free-list header already lives inside the heap and must survive.
-// Returns the number of bytes actually touched.
+// the pool pull-sized allocations come from.
+//
+// Faulting must preserve content (the free-list headers live inside the
+// heap) WITHOUT read-modify-writing it: a volatile *p = *p racing a
+// writer in another process can store a stale byte back over live data.
+// So prefer MADV_POPULATE_WRITE (Linux 5.14+), which write-faults the
+// range entirely in the kernel; the touch-loop fallback runs only on a
+// pristine store (no objects, lock held — the free-list headers it
+// touches are themselves lock-protected), so calling prewarm on a live
+// store on an old kernel is a no-op rather than a corruption risk.
+// Returns the number of bytes actually faulted.
 uint64_t store_prewarm(void* sp, uint64_t bytes, int hugepage) {
   Store* s = (Store*)sp;
   ShmHeader* h = s->hdr;
@@ -584,10 +591,33 @@ uint64_t store_prewarm(void* sp, uint64_t bytes, int hugepage) {
 #endif
   long page = sysconf(_SC_PAGESIZE);
   if (page <= 0) page = 4096;
+  if (span == 0) return 0;
+#ifdef __linux__
+#ifndef MADV_POPULATE_WRITE
+#define MADV_POPULATE_WRITE 23
+#endif
+  {
+    // madvise needs a page-aligned start; widen the range down to the
+    // preceding boundary (those extra header bytes are long since
+    // faulted — populating them again is free).
+    uintptr_t misalign = (uintptr_t)heap % (uintptr_t)page;
+    if (madvise(heap - misalign, span + misalign,
+                MADV_POPULATE_WRITE) == 0)
+      return span;
+  }
+#endif
+  lock(h);
+  if (h->num_objects != 0) {
+    // live store without MADV_POPULATE_WRITE: the RMW touch loop is
+    // not safe against concurrent writers — skip
+    unlock(h);
+    return 0;
+  }
   for (uint64_t off = 0; off < span; off += (uint64_t)page) {
     volatile uint8_t* p = heap + off;
     *p = *p;  // dirty the page without changing it
   }
+  unlock(h);
   return span;
 }
 

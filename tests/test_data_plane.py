@@ -416,6 +416,30 @@ def test_oob_into_and_timeout_mutually_exclusive():
         io.stop()
 
 
+def test_readinto_exactly_surfaces_error_set_while_not_waiting():
+    """StreamReader.set_exception() only wakes an EXISTING waiter. An
+    error recorded while the scatter read is NOT parked (partial chunk
+    delivered, then the connection dies) must still abort the read —
+    without the explicit exception() check, the next _wait_for_data()
+    would create a waiter nothing ever wakes and the pull (scatter
+    calls are forbidden from using rpc timeouts) would hang forever."""
+    import asyncio
+
+    from ray_tpu._private.rpc import _readinto_exactly
+
+    async def run():
+        reader = asyncio.StreamReader()
+        reader.feed_data(b"ab")  # partial: 2 of 8 bytes arrived
+        # connection_lost(exc) lands while no waiter is outstanding
+        reader.set_exception(ConnectionResetError("peer reset"))
+        dest = memoryview(bytearray(8))
+        with pytest.raises(ConnectionResetError):
+            await asyncio.wait_for(_readinto_exactly(reader, dest),
+                                   timeout=5)
+
+    asyncio.run(run())
+
+
 def test_pull_scatter_writes_chunks_in_place(cluster3):
     """With transfer_scatter_read on (the default) every pipelined chunk
     after the lead lands directly in the shm write buffer — the agent's
