@@ -126,6 +126,28 @@ QOS_SITE_ACTIONS: dict[str, list[tuple[str, float]]] = {
     "net.pace": [("drop", 2.0), ("delay", 2.0), ("stall", 1.0)],
 }
 
+# ---- the cross-slice MPMD pipeline fault surface (profile="pipeline") -
+#
+# ``pipeline.stage`` trips inside a stage worker at the stage-boundary
+# p2p send/recv (mpmd_pipeline's activation/grad stream): die/exit kill
+# the stage rank mid-stream (the in-place heal + epoch-bumped p2p
+# reform path), delay/stall lengthen one boundary hop (the bubble the
+# flight recorder must attribute to the right stage). The dp-allreduce
+# and per-stage checkpoint sites ride along from the train surface —
+# a stage gang is still a DCN gang underneath.
+PIPELINE_SITE_WEIGHTS: dict[str, float] = {
+    "pipeline.stage": 3.0,       # stage-boundary send/recv death/stall
+    "ring.send": 1.5,            # dp allreduce sharing the stage links
+    "collective.send": 1.0,
+    "checkpoint.save": 0.75,
+    "checkpoint.restore": 0.5,
+}
+
+PIPELINE_SITE_ACTIONS: dict[str, list[tuple[str, float]]] = {
+    "pipeline.stage": [("die", 2.0), ("exit", 1.5), ("delay", 1.0),
+                       ("stall", 1.0)],
+}
+
 
 @dataclass
 class FaultPlan:
@@ -189,6 +211,14 @@ def gen_fault_plan(seed: int, *, world_size: int = 2,
     paced chunk-serve refusals, and serve-actor deaths that must purge
     pacer state — every action recoverable, so qos soaks assert
     liveness under pacing faults rather than process recovery.
+
+    ``profile="pipeline"`` sweeps the cross-slice MPMD surface
+    (PIPELINE_SITE_WEIGHTS): stage-boundary p2p kills and stalls
+    (``pipeline.stage``, rank-pinned against the pipeline p2p group's
+    world — pass the TOTAL stage-worker count as ``world_size``), plus
+    the dp-allreduce ring and per-stage checkpoint sites. Profile
+    selection happens before any rng draw, so train/rl/qos plans stay
+    byte-identical across seeds.
     """
     rng = random.Random(seed)
     if profile == "rl":
@@ -201,6 +231,9 @@ def gen_fault_plan(seed: int, *, world_size: int = 2,
         if n_prefill <= 0:
             default_weights.pop("serve.prefill", None)
         actions = {**SITE_ACTIONS, **RL_SITE_ACTIONS, **QOS_SITE_ACTIONS}
+    elif profile == "pipeline":
+        default_weights = dict(PIPELINE_SITE_WEIGHTS)
+        actions = {**SITE_ACTIONS, **PIPELINE_SITE_ACTIONS}
     elif profile == "train":
         default_weights = SITE_WEIGHTS
         actions = SITE_ACTIONS
@@ -217,6 +250,13 @@ def gen_fault_plan(seed: int, *, world_size: int = 2,
             # ring sites fire per chunk: spread trips over the first
             # steps' worth of occurrences so kills land mid-step at
             # different points of the schedule per seed
+            spec["after"] = rng.randrange(0, 10)
+        elif site == "pipeline.stage":
+            # pin one pipeline p2p rank (world_size = total stage
+            # workers); the site fires once per boundary send/recv, so
+            # spreading over ~a step's worth of microbatch hops lands
+            # kills at different points of the 1F1B schedule per seed
+            spec["match"] = {"rank": rng.randrange(world_size)}
             spec["after"] = rng.randrange(0, 10)
         elif site == "serve.replica_pump":
             # pin ONE initial replica by engine name; the pump ticks

@@ -175,6 +175,14 @@ _DEFS: dict[str, Any] = {
     "collective_abort_poll_s": 0.5,
     # rendezvous deadline for reform_group after a membership change
     "collective_reform_timeout_s": 120.0,
+    # -- cross-slice MPMD pipeline (parallel/mpmd_pipeline.py) --
+    # microbatches per optimizer step; the 1F1B bubble fraction is
+    # (S-1)/(M+S-1), so more microbatches amortize the pipeline fill
+    "pipeline_microbatches": 8,
+    # deadline for one stage-boundary activation/grad recv: a dead
+    # neighbor stage surfaces as CollectiveTimeoutError at most this
+    # late (abort frames usually beat it)
+    "pipeline_p2p_timeout_s": 60.0,
     # -- elastic training (JaxTrainer + BackendExecutor) --
     # resume a collective-abort failure IN-PLACE when the backend
     # supports it (backend="dcn"): survivors keep their processes, JIT

@@ -991,6 +991,71 @@ def run_obs_benchmarks(*, quick: bool = False) -> list[dict]:
     return results
 
 
+def run_pipeline_benchmarks(*, quick: bool = False) -> list[dict]:
+    """The `pipeline` family: cross-slice MPMD pipeline parallelism.
+
+    A 2-stage matmul pipeline — one WorkerGroup gang per stage, 1F1B
+    schedule, activations/activation-grads streamed stage-to-stage over
+    the paced collective p2p lanes — driven end-to-end through
+    `MpmdPipeline.fit` (gang spawn + p2p rendezvous included in the
+    wall, the honest cold-start number). Records optimizer steps/s,
+    stage-boundary microbatch hops/s, and the measured bubble fraction
+    (p2p-wait + allreduce-wait over wall, the flight-recorder span
+    decomposition) next to the analytic (S-1)/(M+S-1) floor."""
+    from ray_tpu.parallel import MpmdPipeline, StageSpec
+
+    results = []
+    bsz, dim = 256, 256
+    steps = 4 if quick else 10
+    mbs = 8
+
+    def data_fn(step, m):
+        rng = np.random.RandomState(1000 + step * 100 + m)
+        return (rng.standard_normal((bsz, dim)),
+                rng.standard_normal((bsz, dim)))
+
+    def init_fn(cfg):
+        return {"w": np.random.RandomState(7).standard_normal((dim, dim))}
+
+    def fwd(params, x):
+        return x @ params["w"], x
+
+    def bwd(params, x, dy):
+        return dy @ params["w"].T, {"w": x.T @ dy}
+
+    def loss_fn(params, y, t):
+        d = y - t
+        return 0.5 * float(np.mean(d * d)), d / d.size
+
+    pipe = MpmdPipeline(
+        [StageSpec(1, init_fn, fwd, bwd),
+         StageSpec(1, init_fn, fwd, bwd, loss_fn)],
+        data_fn=data_fn, num_steps=steps, microbatches=mbs,
+        name="bench-pipe")
+    start = time.perf_counter()
+    res = pipe.fit()
+    wall = time.perf_counter() - start
+    assert res.steps_completed == steps, res
+    assert res.heals == 0 and res.gang_restarts == 0, res
+    num_stages = 2
+    analytic = (num_stages - 1) / (mbs + num_stages - 1)
+    r = {"name": "pipeline 2-stage 1f1b (steps/s)",
+         "per_s": round(steps / wall, 3), "unit": "steps/s",
+         "steps": steps, "microbatches": mbs,
+         "bubble_measured": round(res.bubble_fraction, 4),
+         "bubble_analytic": round(analytic, 4),
+         "heals": res.heals, "gang_restarts": res.gang_restarts}
+    results.append(r)
+    print(json.dumps(r), flush=True)
+    # each microbatch makes one activation hop down and one grad hop up
+    # per stage boundary: 2 * mbs paced p2p round-trips per step
+    r = {"name": "pipeline stage-boundary hops (microbatches/s)",
+         "per_s": round(2 * mbs * steps / wall, 1), "unit": "hops/s"}
+    results.append(r)
+    print(json.dumps(r), flush=True)
+    return results
+
+
 def run_benchmarks(*, quick: bool = False) -> list[dict]:
     results = []
     windows = 1 if quick else 3
@@ -1156,7 +1221,7 @@ def main(argv=None):
     p.add_argument("--quick", action="store_true")
     p.add_argument("--family", default="all",
                    choices=["all", "collective", "transfer", "serve",
-                            "rl", "obs", "qos"],
+                            "rl", "obs", "qos", "pipeline"],
                    help="run one workload family only")
     p.add_argument("--in-process", action="store_true",
                    help="head in the driver process (debug only)")
@@ -1183,6 +1248,8 @@ def main(argv=None):
             results = run_obs_benchmarks(quick=args.quick)
         elif args.family == "qos":
             results = run_qos_benchmarks(quick=args.quick)
+        elif args.family == "pipeline":
+            results = run_pipeline_benchmarks(quick=args.quick)
         else:
             results = run_benchmarks(quick=args.quick)
     finally:

@@ -107,6 +107,43 @@ def link_tx_by_peer(rows: list[dict]) -> dict[str, float]:
     return out
 
 
+def ring_order(labels: list[str],
+               link_tx_bytes_per_s: dict[str, float] | None) -> list[int]:
+    """Ring rank placement off the same per-link signal replica
+    placement uses: a permutation of ``range(len(labels))`` giving the
+    ring traversal order (position k of the result is the member index
+    that gets rank k).
+
+    A ring makes every member adjacent to exactly two others, so what
+    placement controls is WHICH links become neighbors. Rank order (the
+    default) ignores load entirely; here members are sorted
+    lightest-link-first (the `get_nodes_to_launch` idiom) and then
+    woven front/back — lightest, heaviest, next-lightest, next-heaviest
+    — so the most saturated links are never ring-adjacent and each sits
+    between the lightest available neighbors instead of compounding
+    with another hot link.
+
+    With no signal (empty/uniform load — every test cluster at rest)
+    the permutation is the identity, so rank==position behavior is
+    byte-for-byte unchanged until the link counters actually diverge.
+    """
+    n = len(labels)
+    tx = link_tx_bytes_per_s or {}
+    load = [float(tx.get(lb, 0.0)) for lb in labels]
+    if n <= 2 or not load or max(load) <= min(load):
+        return list(range(n))
+    asc = sorted(range(n), key=lambda i: (load[i], i))
+    ring: list[int] = []
+    lo, hi = 0, n - 1
+    while lo <= hi:
+        ring.append(asc[lo])
+        lo += 1
+        if lo <= hi:
+            ring.append(asc[hi])
+            hi -= 1
+    return ring
+
+
 def _fits(need: dict, cap: dict) -> bool:
     return all(cap.get(r, 0.0) >= v for r, v in need.items() if v > 0)
 

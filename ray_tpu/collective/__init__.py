@@ -30,6 +30,8 @@ from ray_tpu.collective.collective import (  # noqa: F401
     get_rank,
     get_collective_group_size,
     init_collective_group,
+    paced_recv,
+    paced_send,
     recv,
     reduce,
     reducescatter,

@@ -1028,3 +1028,53 @@ def recv(src_rank: int, group_name: str = "default",
     g = _group(group_name)
     g.p2p_recv[src_rank] = seq = g.p2p_recv.get(src_rank, 0) + 1
     return np.asarray(g._recv_from(src_rank, seq, "p2p", timeout, op="recv"))
+
+
+def paced_send(tensor, dst_rank: int, group_name: str = "default", *,
+               owner: str | None = None):
+    """P2P send under the outbound QoS pacer, with per-link byte
+    attribution — the stage-boundary activation/grad stream of the MPMD
+    pipeline rides this instead of raw :func:`send`.
+
+    Mirrors the ring engine's chunk discipline: a ``qos_class=
+    "collective"`` grant against the destination's link (parked senders
+    wake on the group's abort poll, so a dead pipeline neighbor never
+    wedges a paced send), then the buffered fire-and-forget p2p frame,
+    then symmetric ``net_tx_bytes_total`` accounting keyed by the same
+    peer label replica placement and `WorkerGroup` ring ordering read.
+    Ordering per (src, dst) pair is the p2p seq counter, same as
+    :func:`send`."""
+    from ray_tpu._private import net_accounting as _net
+    from ray_tpu._private import net_qos as _qos
+    from ray_tpu.collective import ring as _ring
+
+    g = _group(group_name)
+    arr = _to_numpy(tensor)
+    label = _ring._peer_label(g, dst_rank)
+    own = owner or g.name
+
+    def _abort_poll():
+        g._poll_abort(op="p2p.send")
+
+    _qos.acquire(label, "collective", arr.nbytes, owner=own,
+                 poll=_abort_poll)
+    g.p2p_send[dst_rank] = seq = g.p2p_send.get(dst_rank, 0) + 1
+    g._send_obj(dst_rank, seq, "p2p", arr, fire=True)
+    _net.account_tx(label, "collective", own, arr.nbytes)
+    return arr
+
+
+def paced_recv(src_rank: int, group_name: str = "default", *,
+               timeout: float | None = None, owner: str | None = None):
+    """P2P recv pairing :func:`paced_send`: same frame tag/seq stream,
+    plus symmetric rx byte attribution against the source's link."""
+    from ray_tpu._private import net_accounting as _net
+    from ray_tpu.collective import ring as _ring
+
+    g = _group(group_name)
+    g.p2p_recv[src_rank] = seq = g.p2p_recv.get(src_rank, 0) + 1
+    arr = np.asarray(
+        g._recv_from(src_rank, seq, "p2p", timeout, op="recv"))
+    _net.account_rx(_ring._peer_label(g, src_rank), "collective",
+                    owner or g.name, arr.nbytes)
+    return arr

@@ -2348,7 +2348,11 @@ class NodeAgent:
         ent = pins.get(oid) if pins is not None else None
         buf = ent[0] if ent is not None else self.store.get(oid)
         if buf is None:
-            return None
+            # store miss but a spill file exists: serve the chunk from
+            # disk through the SAME OOB framing — the puller reads a
+            # spilled object without forcing the spilling node to
+            # re-materialize it in its (already pressured) store first
+            return self._read_spill_chunk(p, conn)
         total = buf.data.nbytes
         end = min(offset + _chunk_size(), total)
         view = buf.data[offset:end]
@@ -2417,6 +2421,50 @@ class NodeAgent:
             release = None
         return OobReply({"total": total, "meta": meta}, [view],
                         release=release)
+
+    def _read_spill_chunk(self, p, conn=None):
+        """Serve one chunk of a SPILLED object straight from its spill
+        file (layout: 8-byte meta_len | meta | data), closing the
+        restore detour: a remote puller no longer needs the spilling
+        node to reload the whole object into its store before the first
+        chunk can flow. No pin is involved — the file is immutable
+        until `delete_spilled` — so reads at any offset are safe, and
+        each read is one bounded chunk (never the whole file) on the
+        agent's loop."""
+        oid, offset = p["object_id"], p["offset"]
+        path = self.spilled_files.get(oid)
+        if path is None:
+            return None
+        try:
+            with open(path, "rb") as f:
+                fsize = os.fstat(f.fileno()).st_size
+                meta_len = int.from_bytes(f.read(8), "little")
+                total = max(0, fsize - 8 - meta_len)
+                if offset >= total and total:
+                    return None
+                meta = f.read(meta_len) if offset == 0 else b""
+                f.seek(8 + meta_len + offset)
+                chunk = f.read(min(_chunk_size(), total - offset))
+        except OSError:
+            return None
+        if conn is not None:
+            try:
+                from ray_tpu._private import flight_recorder as _fr
+                from ray_tpu._private import net_accounting as _net
+
+                _net.account_tx(p.get("requester", "?"),
+                                p.get("qos", "bulk"),
+                                p.get("owner", "unknown"), len(chunk))
+                now = time.monotonic()
+                _fr.record("transfer", "transfer.serve_chunk", now, now,
+                           attrs={"oid": oid.hex()[:16], "offset": offset,
+                                  "bytes": len(chunk), "spill": True,
+                                  "peer": p.get("requester", "?")},
+                           flush=False)
+            except Exception:  # noqa: BLE001 — serving must not fail
+                pass
+            return OobReply({"total": total, "meta": meta}, [chunk])
+        return {"total": total, "meta": meta, "chunk": chunk}
 
     def _release_serve_pins(self, conn, *, older_than: float | None = None):
         pins = conn.state.get("serve_pins")
@@ -2525,8 +2573,7 @@ class NodeAgent:
             if self.node_id in info["locations"]:
                 return True  # a local writer beat us to it
             if not info["locations"] and info.get("spilled"):
-                # only a spilled copy exists: ask the spilling node to
-                # restore it, then loop to pull the live copy
+                # only a spilled copy exists
                 spill_node = bytes.fromhex(
                     info["spilled"].split("//", 1)[1].split("/", 1)[0]
                 )
@@ -2538,6 +2585,30 @@ class NodeAgent:
                 else:
                     cli = await self._peer_agent(spill_node)
                     if cli is not None:
+                        # pull the chunks STRAIGHT off the peer's spill
+                        # file (served by _read_spill_chunk through the
+                        # same OOB framing as live objects) — no remote
+                        # store re-materialization, no double transfer
+                        try:
+                            if await self._pull_from(
+                                    [cli], oid, nids=[spill_node],
+                                    owner=(tags.get("owner")
+                                           or _owner_label(
+                                               info.get("owner"))),
+                                    qos=tags.get("qos", "bulk")):
+                                await self.head.call(
+                                    "object_add_location", {
+                                        "object_id": oid,
+                                        "node_id": self.node_id,
+                                    })
+                                self._kick_dispatch()
+                                return True
+                        except StoreFullError:
+                            await asyncio.sleep(0.2)
+                            continue
+                        # direct spill read failed (file gone? agent
+                        # mid-restart): fall back to the restore detour
+                        # and loop for the live copy
                         try:
                             await cli.call("restore_object",
                                            {"object_id": oid})
@@ -2960,10 +3031,19 @@ class NodeAgent:
             path = os.path.join(self.spill_dir, oid.hex())
             meta = bytes(buf.metadata)
             size = len(buf.data)
+            # chunked write through the same framing discipline as the
+            # wire path: one monolithic f.write(buf.data) of a multi-GB
+            # object would wedge the agent's io loop for the whole
+            # kernel copy — yield between chunks like _restore_from_disk
             with open(path, "wb") as f:
                 f.write(len(meta).to_bytes(8, "little"))
                 f.write(meta)
-                f.write(buf.data)
+                step = _chunk_size()
+                off = 0
+                while off < size:
+                    f.write(buf.data[off:off + step])
+                    off += step
+                    await asyncio.sleep(0)
         finally:
             buf.release()
         self.spilled_files[oid] = path

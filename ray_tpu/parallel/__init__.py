@@ -3,13 +3,21 @@
 The reference framework has no native model/sequence parallelism (SURVEY.md §2.7:
 DP arrives via torch DDP in `train/torch/config.py`, TP/PP only via out-of-tree
 Alpa, SP absent). Here every strategy is a mesh axis: dp / pp / fsdp / ep / sp /
-tp, and GSPMD inserts the collectives (pp is the one manual axis — a GPipe
-microbatch pipeline in parallel/pipeline.py).
+tp, and GSPMD inserts the collectives. pp exists at two scales: the in-mesh
+GPipe microbatch pipeline (parallel/pipeline.py, one slice, ppermute over ICI)
+and the cross-slice MPMD pipeline (parallel/mpmd_pipeline.py, one WorkerGroup
+gang per stage, activations streamed over the DCN p2p lanes).
 """
 
 from ray_tpu.parallel.pipeline import (  # noqa: F401
     pipeline_apply,
     pipeline_stages,
+)
+from ray_tpu.parallel.mpmd_pipeline import (  # noqa: F401
+    MpmdPipeline,
+    PipelineResult,
+    PipelineSchedule,
+    StageSpec,
 )
 from ray_tpu.parallel.mesh import (  # noqa: F401
     AXES,

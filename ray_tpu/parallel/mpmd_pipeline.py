@@ -1,0 +1,803 @@
+"""Cross-slice MPMD pipeline parallelism over the DCN engine.
+
+In-mesh pipelining (`pipeline.py`) shards stages over one slice's ICI
+mesh via ppermute — SPMD, one program. This module is the **MPMD**
+counterpart: each pipeline stage is a *different program* on a
+*different slice* (its own :class:`~ray_tpu.train.WorkerGroup` gang,
+asymmetric per-stage worker counts allowed), and microbatch activations
+/ activation-grads stream stage-to-stage over the collective p2p lanes
+(`paced_send`/`paced_recv`) carried by the zero-copy data plane with
+``qos_class="collective"`` pacing — so a pipeline's boundary traffic
+preempts bulk spills but yields to nothing.
+
+Layout: one global p2p group spans ALL stage workers; global rank =
+``stage_offset + dp_index`` where offsets are the cumsum of per-stage
+worker counts. Microbatch ``m`` of stage ``s`` is owned by data-parallel
+replica ``m % dp_s``, so boundary routing is a pure function of the
+stage sizes — sender ``offs[s] + m % dp_s`` → receiver
+``offs[s+1] + m % dp_(s+1)`` — and survives asymmetric dp widths.
+Within a stage, replicas sync gradients with the bucketed
+:func:`~ray_tpu.train.dcn_allreduce_grads` on a thread overlapped
+against the tail p2p sends of the same step.
+
+Elasticity composes: a stage-rank death aborts the p2p group (typed
+:class:`CollectiveAbortError`), the driver quiesces *all* stages, heals
+the dead stage in place (respawn-or-shrink via ``WorkerGroup.heal``),
+reforms every group under a bumped epoch, and resumes all stages from
+the last *common* per-stage checkpoint step — zero gang restarts. The
+flight recorder sees per-microbatch ``pipeline.microbatch`` spans and a
+per-step ``pipeline.step`` span decomposed into compute / p2p-wait /
+allreduce-wait, so bubble fraction is measured, not modeled (the 1F1B
+analytic floor is ``(S-1)/(M+S-1)``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu._private import config as _cfg
+
+logger = logging.getLogger(__name__)
+
+
+# --------------------------------------------------------------------------
+# schedule
+# --------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class PipelineSchedule:
+    """Per-stage action order for ``M`` microbatches over ``S`` stages.
+
+    ``style="1f1b"`` (default): stage ``s`` warms up with
+    ``min(M, S-1-s)`` forwards, then alternates one-forward-one-backward,
+    then drains the remaining backwards — peak live activations per
+    stage is ``S - s``, independent of ``M``. ``style="gpipe"`` is the
+    degenerate case (warmup = ``M``): all forwards, then all backwards,
+    holding ``M`` activations.
+    """
+
+    num_stages: int
+    microbatches: int
+    style: str = "1f1b"
+
+    def __post_init__(self):
+        if self.style not in ("1f1b", "gpipe"):
+            raise ValueError(f"unknown schedule style {self.style!r}")
+        if self.num_stages < 1 or self.microbatches < 1:
+            raise ValueError("need >=1 stage and >=1 microbatch")
+
+    def warmup(self, stage: int) -> int:
+        if self.style == "gpipe":
+            return self.microbatches
+        return min(self.microbatches, self.num_stages - 1 - stage)
+
+    def actions(self, stage: int) -> list[tuple[str, int]]:
+        """[("F", mb) | ("B", mb), ...] in execution order for `stage`."""
+        m = self.microbatches
+        warm = self.warmup(stage)
+        acts: list[tuple[str, int]] = []
+        f = b = 0
+        while f < min(warm, m):
+            acts.append(("F", f))
+            f += 1
+        while f < m:
+            acts.append(("F", f))
+            acts.append(("B", b))
+            f += 1
+            b += 1
+        while b < m:
+            acts.append(("B", b))
+            b += 1
+        return acts
+
+    def peak_live(self, stage: int) -> int:
+        """Max activations held at once — the 1F1B memory win."""
+        return min(self.microbatches, self.warmup(stage) + 1)
+
+    def bubble_fraction(self) -> float:
+        """Analytic pipeline-fill bubble: (S-1)/(M+S-1)."""
+        s, m = self.num_stages, self.microbatches
+        return (s - 1) / float(m + s - 1)
+
+
+# --------------------------------------------------------------------------
+# user-facing stage description
+# --------------------------------------------------------------------------
+
+@dataclass
+class StageSpec:
+    """One pipeline stage: its gang width and its math.
+
+    ``init_fn(config) -> params``;
+    ``forward_fn(params, x) -> (y, saved)``;
+    ``backward_fn(params, saved, dy) -> (dx, grads)`` where ``grads``
+    matches the params pytree. The LAST stage additionally provides
+    ``loss_fn(params, y, target) -> (loss, dy)``. All arrays are host
+    numpy at the boundary (the p2p lanes carry numpy); inside a stage
+    the fns are free to jit on the slice's devices.
+    """
+
+    num_workers: int = 1
+    init_fn: Callable[[dict], Any] = None
+    forward_fn: Callable[[Any, Any], tuple] = None
+    backward_fn: Callable[[Any, Any, Any], tuple] = None
+    loss_fn: Callable[[Any, Any, Any], tuple] | None = None
+
+
+@dataclass
+class PipelineResult:
+    """What :meth:`MpmdPipeline.fit` hands back."""
+
+    losses: list[float] = field(default_factory=list)
+    steps_completed: int = 0
+    heals: int = 0
+    gang_restarts: int = 0  # always 0: heal is in-place by construction
+    bubble_by_stage: dict[int, float] = field(default_factory=dict)
+    bubble_fraction: float = 0.0
+    stage_world_sizes: list[int] = field(default_factory=list)
+    final_params: list[Any] | None = None
+    metrics: dict = field(default_factory=dict)
+
+
+# --------------------------------------------------------------------------
+# worker-side stage loop (runs under backend_executor._start_training)
+# --------------------------------------------------------------------------
+
+def _tree_add(a, b):
+    import jax
+
+    return jax.tree_util.tree_map(lambda x, y: x + y, a, b)
+
+
+def _tree_scale(t, k):
+    import jax
+
+    return jax.tree_util.tree_map(lambda x: x * k, t)
+
+
+def _stage_loop(config: dict) -> None:
+    """The per-worker pipeline program. One process = one (stage,
+    dp-replica). Launched via ``backend_executor._start_training`` so it
+    inherits the session machinery (report backpressure, resume
+    checkpoint, resume_seq) unchanged."""
+    import threading
+
+    from ray_tpu._private import fault_injection as _fi
+    from ray_tpu._private import flight_recorder as _fr
+    from ray_tpu._private import serialization
+    from ray_tpu.collective import paced_recv, paced_send
+    from ray_tpu.train import dcn as _dcn
+    from ray_tpu.train import session as S
+    from ray_tpu.train.checkpoint import Checkpoint
+
+    stages = [StageSpec(
+        num_workers=b["num_workers"],
+        init_fn=serialization.unpack_payload(b["init"]),
+        forward_fn=serialization.unpack_payload(b["forward"]),
+        backward_fn=serialization.unpack_payload(b["backward"]),
+        loss_fn=(serialization.unpack_payload(b["loss"])
+                 if b["loss"] is not None else None),
+    ) for b in config["stages_blob"]]
+    data_fn = serialization.unpack_payload(config["data_blob"])
+
+    s_idx = int(config["stage"])
+    dp_rank = int(config["dp_rank"])
+    sizes = list(config["stage_sizes"])
+    n_stages = len(sizes)
+    dp_size = sizes[s_idx]
+    offs = [0] * n_stages
+    for i in range(1, n_stages):
+        offs[i] = offs[i - 1] + sizes[i - 1]
+    g_rank = offs[s_idx] + dp_rank
+    pipe = config["pipe_group"]
+    dp_group = config.get("dp_group")
+    num_steps = int(config["num_steps"])
+    m_total = int(config["microbatches"])
+    lr = float(config.get("lr", 0.05))
+    p2p_timeout = float(config.get("p2p_timeout_s")
+                        or _cfg.get("pipeline_p2p_timeout_s"))
+    ckpt_dir = config.get("ckpt_dir")
+    ckpt_every = int(config.get("ckpt_every", 1))
+    spec = stages[s_idx]
+    is_first, is_last = s_idx == 0, s_idx == n_stages - 1
+
+    # one-shot chaos arming: only the first incarnation arms, so healed
+    # reincarnations don't re-fire the same plan
+    if config.get("fault_specs") and S.get_resume_seq() == 0:
+        _fi.configure(config["fault_specs"])
+
+    params = spec.init_fn(dict(config.get("user_config") or {},
+                               stage=s_idx))
+    start_step = 0
+    ck = S.get_checkpoint()
+    if ck is not None:
+        d = ck.to_dict()  # raises CheckpointCorruptError on a torn file
+        params = d["params"]
+        start_step = int(d["step"])
+
+    sched = PipelineSchedule(n_stages, m_total,
+                             config.get("schedule", "1f1b"))
+    acts = [(kind, m) for kind, m in sched.actions(s_idx)
+            if m % dp_size == dp_rank]
+    n_my_backwards = sum(1 for kind, _ in acts if kind == "B")
+
+    def _boundary(op: str, m: int, step: int) -> str | None:
+        # the pipeline.stage fault site: die/exit/delay/stall execute
+        # inside fire(); "drop" is returned for US to implement (skip
+        # the send so the peer's recv deadline trips -> typed
+        # CollectiveTimeoutError -> driver heal)
+        return _fi.fire("pipeline.stage", stage=s_idx, mb=m, op=op,
+                        rank=g_rank, step=step)
+
+    for step in range(start_step, num_steps):
+        t_step = time.monotonic()
+        compute_s = p2p_wait_s = ar_wait_s = 0.0
+        saved: dict[int, Any] = {}
+        acc_grads = None
+        loss_sum, loss_n = 0.0, 0
+        ar_thread: threading.Thread | None = None
+        ar_box: dict[str, Any] = {}
+        done_b = 0
+
+        for kind, m in acts:
+            t_mb = time.monotonic()
+            if kind == "F":
+                if is_first:
+                    x, _tgt = data_fn(step, m)
+                    x = np.asarray(x)
+                else:
+                    _boundary("recv", m, step)
+                    t0 = time.monotonic()
+                    x = paced_recv(
+                        offs[s_idx - 1] + m % sizes[s_idx - 1],
+                        pipe, timeout=p2p_timeout, owner=pipe)
+                    p2p_wait_s += time.monotonic() - t0
+                t0 = time.monotonic()
+                y, sv = spec.forward_fn(params, x)
+                saved[m] = sv
+                compute_s += time.monotonic() - t0
+                if not is_last:
+                    if _boundary("send", m, step) != "drop":
+                        t0 = time.monotonic()
+                        paced_send(np.asarray(y),
+                                   offs[s_idx + 1] + m % sizes[s_idx + 1],
+                                   pipe, owner=pipe)
+                        p2p_wait_s += time.monotonic() - t0
+                else:
+                    _x, tgt = data_fn(step, m)
+                    t0 = time.monotonic()
+                    loss, dy = spec.loss_fn(params, y, np.asarray(tgt))
+                    compute_s += time.monotonic() - t0
+                    loss_sum += float(loss)
+                    loss_n += 1
+                    saved[m] = (saved[m], np.asarray(dy))
+            else:  # backward
+                if is_last:
+                    sv, dy = saved.pop(m)
+                else:
+                    _boundary("recv", m, step)
+                    t0 = time.monotonic()
+                    dy = paced_recv(
+                        offs[s_idx + 1] + m % sizes[s_idx + 1],
+                        pipe, timeout=p2p_timeout, owner=pipe)
+                    p2p_wait_s += time.monotonic() - t0
+                    sv = saved.pop(m)
+                t0 = time.monotonic()
+                dx, grads = spec.backward_fn(params, sv, dy)
+                compute_s += time.monotonic() - t0
+                acc_grads = grads if acc_grads is None \
+                    else _tree_add(acc_grads, grads)
+                done_b += 1
+                if done_b == n_my_backwards and dp_size > 1:
+                    # grad sum is complete: launch the bucketed dp
+                    # allreduce NOW, overlapped against the remaining
+                    # upstream dx send of this same microbatch
+                    local = _tree_scale(acc_grads, 1.0 / m_total)
+
+                    def _ar(local=local):
+                        try:
+                            ar_box["grads"] = _dcn.dcn_allreduce_grads(
+                                local, dp_group, op="sum",
+                                timeout=p2p_timeout)
+                        except BaseException as e:  # noqa: BLE001
+                            ar_box["error"] = e
+
+                    ar_thread = threading.Thread(
+                        target=_ar, daemon=True, name="pipeline_allreduce")
+                    ar_thread.start()
+                if not is_first:
+                    if _boundary("send", m, step) != "drop":
+                        t0 = time.monotonic()
+                        paced_send(np.asarray(dx),
+                                   offs[s_idx - 1] + m % sizes[s_idx - 1],
+                                   pipe, owner=pipe)
+                        p2p_wait_s += time.monotonic() - t0
+            _fr.record("train", "pipeline.microbatch", t_mb,
+                       time.monotonic(),
+                       attrs={"stage": s_idx, "mb": m, "op": kind,
+                              "rank": g_rank, "step": step},
+                       flush=False)
+
+        if dp_size > 1:
+            if ar_thread is None:  # no owned microbatch carried a grad
+                if acc_grads is None:
+                    acc_grads = _tree_scale(params, 0.0)
+                g_mean = _dcn.dcn_allreduce_grads(
+                    _tree_scale(acc_grads, 1.0 / m_total), dp_group,
+                    op="sum", timeout=p2p_timeout)
+            else:
+                t0 = time.monotonic()
+                ar_thread.join()
+                ar_wait_s += time.monotonic() - t0
+                if "error" in ar_box:
+                    raise ar_box["error"]
+                g_mean = ar_box["grads"]
+        else:
+            g_mean = _tree_scale(acc_grads, 1.0 / m_total)
+
+        import jax
+
+        params = jax.tree_util.tree_map(
+            lambda p, g: p - lr * g, params, g_mean)
+
+        wall = max(1e-9, time.monotonic() - t_step)
+        bubble = min(1.0, (p2p_wait_s + ar_wait_s) / wall)
+        S._add_step_time("collective", p2p_wait_s + ar_wait_s)
+        _fr.record("train", "pipeline.step", t_step, time.monotonic(),
+                   attrs={"stage": s_idx, "rank": g_rank, "step": step + 1,
+                          "compute_s": round(compute_s, 6),
+                          "p2p_wait_s": round(p2p_wait_s, 6),
+                          "allreduce_wait_s": round(ar_wait_s, 6),
+                          "bubble": round(bubble, 6)})
+
+        ckpt_path = ""
+        if (ckpt_dir and dp_rank == 0
+                and ((step + 1) % ckpt_every == 0
+                     or step + 1 == num_steps)):
+            ckpt_path = os.path.join(
+                ckpt_dir, f"stage{s_idx}", f"step_{step + 1:06d}")
+            Checkpoint.from_dict(
+                {"step": step + 1, "params": params}, path=ckpt_path)
+            _prune_stage_ckpts(os.path.join(ckpt_dir, f"stage{s_idx}"),
+                               keep=2)
+
+        metrics = {
+            "step": step + 1, "stage": s_idx, "dp_rank": dp_rank,
+            "compute_s": compute_s, "p2p_wait_s": p2p_wait_s,
+            "allreduce_wait_s": ar_wait_s, "bubble": bubble,
+            "ckpt": ckpt_path, "mbs": loss_n,
+        }
+        if is_last and loss_n:
+            metrics["loss"] = loss_sum / loss_n
+        if config.get("return_params") and step + 1 == num_steps:
+            metrics["params"] = params
+        S.report(metrics)
+
+
+def _lost_session(worker) -> bool:
+    """True when this process holds no train loop — the marker of a
+    runtime-RESTARTED actor (same id, fresh process): any in-flight
+    `_next_result` call it had was lost with the old process, so the
+    driver must heal rather than keep waiting on it."""
+    return "train_thread" not in worker.state
+
+
+def _prune_stage_ckpts(stage_dir: str, keep: int = 2) -> None:
+    import shutil
+
+    try:
+        kids = sorted(d for d in os.listdir(stage_dir)
+                      if d.startswith("step_"))
+    except OSError:
+        return
+    for d in kids[:-keep]:
+        shutil.rmtree(os.path.join(stage_dir, d), ignore_errors=True)
+
+
+# --------------------------------------------------------------------------
+# driver
+# --------------------------------------------------------------------------
+
+class MpmdPipeline:
+    """Driver for a cross-slice MPMD pipeline.
+
+    One :class:`~ray_tpu.train.WorkerGroup` gang per stage (one slice
+    per stage), one global p2p collective group spanning every stage
+    worker, per-stage data-parallel allreduce groups where a stage is
+    wider than one worker. ``fit()`` runs the lockstep monitor loop and
+    the in-place heal cycle; it never gang-restarts.
+    """
+
+    def __init__(self, stages: list[StageSpec], *,
+                 data_fn: Callable[[int, int], tuple],
+                 num_steps: int,
+                 microbatches: int | None = None,
+                 schedule: str = "1f1b",
+                 lr: float = 0.05,
+                 user_config: dict | None = None,
+                 ckpt_dir: str | None = None,
+                 ckpt_every: int = 1,
+                 resources_per_worker: dict | None = None,
+                 max_heals: int = 4,
+                 max_restarts: int = 2,
+                 quiesce_timeout_s: float | None = None,
+                 poll_s: float = 5.0,
+                 fault_specs: list[dict] | None = None,
+                 p2p_timeout_s: float | None = None,
+                 return_params: bool = False,
+                 name: str | None = None):
+        import uuid
+
+        from ray_tpu._private import serialization
+
+        if len(stages) < 1:
+            raise ValueError("need at least one stage")
+        if stages[-1].loss_fn is None:
+            raise ValueError("last stage needs a loss_fn")
+        self.stages = list(stages)
+        self.name = name or f"pipe-{uuid.uuid4().hex[:6]}"
+        self.num_steps = int(num_steps)
+        self.microbatches = int(microbatches
+                                or _cfg.get("pipeline_microbatches"))
+        self.schedule = schedule
+        # schedule validity is checked up front, not on the workers
+        PipelineSchedule(len(stages), self.microbatches, schedule)
+        self.lr = lr
+        self.user_config = dict(user_config or {})
+        self.ckpt_dir = ckpt_dir
+        self.ckpt_every = ckpt_every
+        self.max_heals = max_heals
+        # per-stage driver-side respawn budget: a 1-worker stage that
+        # shrinks to zero is fatal, so stages default to respawn-capable
+        self.max_restarts = max_restarts
+        self.quiesce_timeout_s = quiesce_timeout_s
+        self.poll_s = float(poll_s)
+        self.fault_specs = list(fault_specs or [])
+        self.p2p_timeout_s = p2p_timeout_s
+        self.return_params = return_params
+        self._res = dict(resources_per_worker or {"CPU": 0.1})
+        self._targets = [s.num_workers for s in stages]
+        self._wgs: list[Any] = []
+        self._dp_groups: list[str | None] = []
+        self._pipe = f"{self.name}-p2p"
+        self._fn_blob = serialization.pack_callable(_stage_loop)
+        # each hook packed individually so a test-module callable ships
+        # by value (pack_callable registers its defining module)
+        self._stages_blob = [{
+            "num_workers": s.num_workers,
+            "init": serialization.pack_callable(s.init_fn),
+            "forward": serialization.pack_callable(s.forward_fn),
+            "backward": serialization.pack_callable(s.backward_fn),
+            "loss": (serialization.pack_callable(s.loss_fn)
+                     if s.loss_fn is not None else None),
+        } for s in self.stages]
+        self._data_blob = serialization.pack_callable(data_fn)
+        self.heals = 0
+        self.gang_restarts = 0
+        # per-stage {step: ckpt_path} as reported by dp-rank-0 workers
+        self._ckpts: list[dict[int, str]] = [{} for _ in stages]
+
+    # -- topology ---------------------------------------------------------
+
+    def _sizes(self) -> list[int]:
+        return [wg.num_workers for wg in self._wgs]
+
+    def _offsets(self) -> list[int]:
+        sizes = self._sizes()
+        offs = [0] * len(sizes)
+        for i in range(1, len(sizes)):
+            offs[i] = offs[i - 1] + sizes[i - 1]
+        return offs
+
+    def _all_workers(self) -> list[tuple[int, int, Any]]:
+        return [(s, i, w) for s, wg in enumerate(self._wgs)
+                for i, w in enumerate(wg.workers)]
+
+    # -- setup ------------------------------------------------------------
+
+    def _setup(self) -> None:
+        from ray_tpu.collective import create_collective_group
+        from ray_tpu.train.worker_group import WorkerGroup
+
+        for s, spec in enumerate(self.stages):
+            self._wgs.append(WorkerGroup(
+                spec.num_workers, dict(self._res), strategy="SPREAD",
+                max_restarts=self.max_restarts))
+        # ONE create call across every stage: each member's init blocks
+        # until all world ranks publish, so the refs must all be in
+        # flight before any gather — a per-stage create would deadlock
+        offs = self._offsets()
+        actors, ranks = [], []
+        for s, i, w in self._all_workers():
+            actors.append(w)
+            ranks.append(offs[s] + i)
+        create_collective_group(actors, sum(self._sizes()), ranks,
+                                backend="cpu", group_name=self._pipe)
+        for s, wg in enumerate(self._wgs):
+            if wg.num_workers > 1:
+                self._dp_groups.append(
+                    wg.init_collective(f"{self.name}-dp{s}"))
+            else:
+                self._dp_groups.append(None)
+
+    def _launch(self, resume_seq: int,
+                resume_paths: dict[int, str | None]) -> None:
+        from ray_tpu.train.backend_executor import _start_training
+
+        sizes = self._sizes()
+        offs = self._offsets()
+        total = sum(sizes)
+        refs = []
+        for s, i, w in self._all_workers():
+            cfg = {
+                "stages_blob": self._stages_blob,
+                "data_blob": self._data_blob,
+                "stage": s, "dp_rank": i, "stage_sizes": sizes,
+                "pipe_group": self._pipe,
+                "dp_group": self._dp_groups[s],
+                "num_steps": self.num_steps,
+                "microbatches": self.microbatches,
+                "schedule": self.schedule, "lr": self.lr,
+                "user_config": self.user_config,
+                "ckpt_dir": self.ckpt_dir,
+                "ckpt_every": self.ckpt_every,
+                "p2p_timeout_s": self.p2p_timeout_s,
+                "fault_specs": self.fault_specs,
+                "return_params": self.return_params,
+            }
+            refs.append(w.execute.remote(
+                _start_training, self._fn_blob, cfg,
+                resume_paths.get(s), offs[s] + i, total, self._pipe,
+                None, resume_seq))
+        ray_tpu.get(refs, timeout=120)
+
+    # -- resume target ----------------------------------------------------
+
+    def _resume_paths(self) -> dict[int, str | None]:
+        """Latest checkpoint step every stage HAS — stages must resume
+        from the same step or the pipeline desynchronizes. No common
+        step -> everyone restarts from scratch."""
+        common: set[int] | None = None
+        for reg in self._ckpts:
+            steps = set(reg)
+            common = steps if common is None else (common & steps)
+        if not common:
+            return {}
+        t = max(common)
+        return {s: reg[t] for s, reg in enumerate(self._ckpts)}
+
+    def _discard_ckpt(self, path: str) -> None:
+        for reg in self._ckpts:
+            for step, p in list(reg.items()):
+                if p == path:
+                    del reg[step]
+
+    # -- heal cycle -------------------------------------------------------
+
+    def _heal(self, resume_seq: int,
+              suspect_stages: set[int] | None = None) -> None:
+        """Quiesce ALL stages, heal dead gangs in place, reform every
+        collective group under a bumped epoch, relaunch from the last
+        common checkpoint. Zero gang restarts by construction."""
+        import msgpack
+
+        from ray_tpu._private import flight_recorder as _fr
+        from ray_tpu._private.api import _get_worker
+        from ray_tpu.collective.collective import KV_NS, _epoch_key
+        from ray_tpu.train.backend_executor import (
+            _gather_tolerant, _quiesce)
+
+        t0 = time.monotonic()
+        logger.info("pipeline %s: quiescing %d workers for in-place heal",
+                    self.name, sum(self._sizes()))
+        quiesce_s = float(self.quiesce_timeout_s
+                          or _cfg.get("train_quiesce_timeout_s"))
+        workers = self._all_workers()
+        res = _gather_tolerant(
+            [w.execute.remote(_quiesce, quiesce_s) for _, _, w in workers],
+            quiesce_s + 10)
+        # attribution: stages whose rank died/restarted per the monitor
+        # loop, plus any quiesce that found a FRESH process (the runtime
+        # already restarted the actor — heal-by-runtime, same stage
+        # fault), plus whatever the probe below finds still dead
+        healed = set(suspect_stages or ())
+        # a survivor wedged in user code can't be resumed in this
+        # process; kill it so heal() respawns a fresh one — the gang
+        # itself still never restarts
+        for (s, i, w), r in zip(workers, res):
+            if isinstance(r, Exception) or (
+                    isinstance(r, dict) and r.get("fresh")):
+                healed.add(s)
+            if isinstance(r, dict) and not r.get("ok", True):
+                healed.add(s)
+                try:
+                    ray_tpu.kill(w)
+                except Exception:  # noqa: BLE001 — already gone
+                    pass
+
+        for s, wg in enumerate(self._wgs):
+            if all(wg.probe(timeout=5.0)):
+                continue
+            healed.add(s)
+            wg.heal(wait_restart_s=quiesce_s)
+            wg.grow(self._targets[s])
+            if wg.num_workers < 1:
+                raise RuntimeError(f"stage {s} lost every worker")
+
+        # every process's incarnations were aborted by the quiesce, so
+        # every dp group reforms (not just the healed stage's)
+        for s, wg in enumerate(self._wgs):
+            if self._dp_groups[s] is not None:
+                wg.reform_collective(
+                    self._dp_groups[s],
+                    timeout=float(_cfg.get("collective_reform_timeout_s")))
+
+        # pipe group spans all gangs, so the driver coordinates its
+        # epoch directly (WorkerGroup.reform_collective's idiom, lifted
+        # across stage boundaries)
+        hw = _get_worker()
+        raw = hw.head.call("kv_get",
+                           {"ns": KV_NS, "key": _epoch_key(self._pipe)})
+        cur = msgpack.unpackb(raw) if raw is not None else 1
+        live = _gather_tolerant(
+            [w.__ray_tpu_collective_epoch__.remote(self._pipe)
+             for _, _, w in self._all_workers()], 30)
+        epoch = max([cur] + [e for e in live if isinstance(e, int)]) + 1
+        hw.head.call("kv_put", {"ns": KV_NS, "key": _epoch_key(self._pipe),
+                                "value": msgpack.packb(epoch)})
+        offs = self._offsets()
+        total = sum(self._sizes())
+        refs = [w.__ray_tpu_reform_collective__.remote(
+            total, offs[s] + i, self._pipe, epoch)
+            for s, i, w in self._all_workers()]
+        ray_tpu.get(refs,
+                    timeout=float(_cfg.get("collective_reform_timeout_s")))
+
+        paths = self._resume_paths()
+        self._launch(resume_seq, paths)
+        self.heals += 1
+        _fr.record("train", "pipeline.heal", t0, time.monotonic(),
+                   attrs={"pipe": self._pipe, "stages": sorted(healed),
+                          "epoch": epoch,
+                          "resume_step": next(
+                              (int(os.path.basename(p).split("_")[1])
+                               for p in paths.values() if p), 0),
+                          "world": total})
+        logger.info("pipeline %s healed stages %s (epoch %d, %d heals)",
+                    self.name, sorted(healed), epoch, self.heals)
+
+    # -- monitor loop -----------------------------------------------------
+
+    def fit(self) -> PipelineResult:
+        from ray_tpu.train.backend_executor import (
+            TrainingFailedError, _gather_tolerant, _next_result)
+        from ray_tpu.train.trainer import INFRA_ERROR_TYPES
+
+        self._setup()
+        self._launch(0, {})
+        resume_seq = 0
+        result = PipelineResult()
+        # per (stage, pos): last step reported; losses keyed by step
+        losses: dict[int, list[tuple[float, int]]] = {}
+        bubbles: dict[int, list[float]] = {}
+        finished: set[tuple[int, int]] = set()
+        final_params: dict[int, Any] = {}
+
+        while True:
+            workers = self._all_workers()
+            pollers = [(s, i, w) for s, i, w in workers
+                       if (s, i) not in finished]
+            if not pollers:
+                break
+            res = _gather_tolerant(
+                [w.execute.remote(_next_result, self.poll_s)
+                 for _, _, w in pollers], self.poll_s + 10)
+            infra: str | None = None
+            suspects: set[int] = set()
+            for (s, i, w), r in zip(pollers, res):
+                if isinstance(r, Exception):
+                    # a timed-out fetch is ambiguous: the rank may be
+                    # dead, RESTARTED by the runtime (our call died with
+                    # the old process), or merely slow — only the first
+                    # two warrant a heal
+                    try:
+                        lost = ray_tpu.get(
+                            w.execute.remote(_lost_session), timeout=10)
+                    except Exception:  # noqa: BLE001 — actor is gone
+                        lost = True
+                    if lost:
+                        infra = infra or "WorkerDiedError"
+                        suspects.add(s)
+                    continue
+                typ = r.get("type")
+                if typ == "report":
+                    m = r["metrics"]
+                    step = int(m.get("step", 0))
+                    if "loss" in m:
+                        losses.setdefault(step, []).append(
+                            (float(m["loss"]), int(m.get("mbs", 1))))
+                    bubbles.setdefault(s, []).append(
+                        float(m.get("bubble", 0.0)))
+                    if m.get("ckpt"):
+                        self._ckpts[s][step] = m["ckpt"]
+                    if "params" in m:
+                        final_params[s] = m["params"]
+                    result.steps_completed = max(
+                        result.steps_completed, step)
+                elif typ == "finished":
+                    finished.add((s, i))
+                elif typ == "error":
+                    et = r.get("error_type", "")
+                    if et == "CheckpointCorruptError" and r.get(
+                            "error_path"):
+                        self._discard_ckpt(r["error_path"])
+                    if et in INFRA_ERROR_TYPES:
+                        infra = infra or et
+                        if et in ("WorkerDiedError", "InjectedFault"):
+                            suspects.add(s)
+                    else:
+                        self.shutdown()
+                        err = TrainingFailedError(
+                            f"pipeline stage {s} worker {i} failed:\n"
+                            f"{r.get('error', '')}")
+                        err.error_type = et
+                        err.error_path = r.get("error_path", "")
+                        raise err
+                # "pending": keep polling
+            if infra is not None:
+                if self.heals >= self.max_heals:
+                    self.shutdown()
+                    err = TrainingFailedError(
+                        f"pipeline {self.name}: heal budget exhausted "
+                        f"({self.max_heals}) after {infra}")
+                    err.error_type = infra
+                    raise err
+                resume_seq += 1
+                finished.clear()
+                self._heal(resume_seq, suspects)
+
+        for step in sorted(losses):
+            pairs = losses[step]
+            tot = sum(n for _, n in pairs) or 1
+            result.losses.append(
+                sum(v * n for v, n in pairs) / tot)
+        result.heals = self.heals
+        result.gang_restarts = self.gang_restarts
+        result.bubble_by_stage = {
+            s: sum(v) / len(v) for s, v in bubbles.items() if v}
+        if result.bubble_by_stage:
+            result.bubble_fraction = (
+                sum(result.bubble_by_stage.values())
+                / len(result.bubble_by_stage))
+        result.stage_world_sizes = self._sizes()
+        if final_params:
+            result.final_params = [final_params.get(s)
+                                   for s in range(len(self.stages))]
+        result.metrics = {"steps": result.steps_completed,
+                          "pipe_group": self._pipe}
+        self.shutdown()
+        return result
+
+    def shutdown(self) -> None:
+        refs = []
+        for _, _, w in self._all_workers():
+            try:
+                refs.append(
+                    w.__ray_tpu_destroy_collective__.remote(self._pipe))
+            except Exception:  # noqa: BLE001
+                pass
+        try:
+            ray_tpu.get(refs, timeout=30)
+        except Exception:  # noqa: BLE001 — teardown is best-effort
+            pass
+        for wg in self._wgs:
+            wg.shutdown()
+        self._wgs = []
+        self._dp_groups = []

@@ -102,6 +102,10 @@ class WorkerGroup:
         # survivor's worker_idx
         self._next_worker_idx = num_workers
         self._coll_group: str | None = None
+        # rank of each worker position in the live collective group
+        # (init_collective may permute it link-aware; reform compacts
+        # back to position order)
+        self.collective_ranks: list[int] = list(range(num_workers))
         # fail fast if any worker can't start
         ray_tpu.get([w.ping.remote() for w in self.workers], timeout=120)
 
@@ -246,21 +250,58 @@ class WorkerGroup:
         return self.num_workers
 
     def init_collective(self, group_name: str | None = None,
-                        backend: str = "cpu") -> str:
-        """Rendezvous a collective group over the gang (rank == worker
-        index) — the DCN fabric `train.dcn_allreduce_grads` rides for
-        cross-slice gradient sync. Returns the group name."""
+                        backend: str = "cpu", *,
+                        link_tx: dict[str, float] | None = None) -> str:
+        """Rendezvous a collective group over the gang — the DCN fabric
+        `train.dcn_allreduce_grads` rides for cross-slice gradient sync.
+
+        Rank placement is link-aware: ring neighbors are ordered off the
+        same ``link_tx_by_peer`` signal replica placement uses
+        (``demand_scheduler.ring_order``), so a member whose node link is
+        saturated by serving or bulk traffic is never placed ring-
+        adjacent to another hot link. With no byte signal (or uniform
+        load) ranks fall back to worker order, byte-identically to the
+        old behavior. ``link_tx`` overrides the live per-peer tally
+        (tests; an autoscaler passing head-aggregated rows). Returns the
+        group name."""
         import uuid
 
         from ray_tpu.collective import create_collective_group
 
         name = group_name or f"wg-{uuid.uuid4().hex[:8]}"
+        ranks = self._ring_ranks(link_tx)
         create_collective_group(
-            self.workers, self.num_workers, list(range(self.num_workers)),
+            self.workers, self.num_workers, ranks,
             backend=backend, group_name=name,
         )
         self._coll_group = name
+        self.collective_ranks = ranks
         return name
+
+    def _ring_ranks(self, link_tx: dict[str, float] | None = None
+                    ) -> list[int]:
+        """Rank of each worker position, link-aware (identity when the
+        byte signal is flat). Node labels match the accounting peer
+        labels ring/agent sends use (node-id hex prefix)."""
+        from ray_tpu.autoscaler.demand_scheduler import ring_order
+
+        n = self.num_workers
+        try:
+            labels = [(nid or "")[:8] for nid in self.node_ids()]
+        except Exception:  # noqa: BLE001 — placement is best-effort
+            return list(range(n))
+        if link_tx is None:
+            from ray_tpu._private import net_accounting as _net
+
+            link_tx = {}
+            for (_d, peer, _q, _o, _t), v in \
+                    _net.local_totals("tx").items():
+                link_tx[peer] = link_tx.get(peer, 0.0) + v
+        order = ring_order(labels, link_tx)
+        ranks = [0] * n
+        for r, pos in enumerate(order):
+            ranks[pos] = r
+        return ranks
 
     def reform_collective(self, group_name: str | None = None,
                           timeout: float = 120.0) -> str:
@@ -302,6 +343,7 @@ class WorkerGroup:
         ]
         ray_tpu.get(refs, timeout=timeout)
         self._coll_group = name
+        self.collective_ranks = list(range(self.num_workers))
         return name
 
     def destroy_collective(self):

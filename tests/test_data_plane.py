@@ -697,3 +697,56 @@ def test_put_free_race_converges(cluster):
             break
         time.sleep(0.1)
     assert w.store.used_bytes() <= baseline
+
+
+# ---------------------------------------------------------------------------
+# spilled-object serving (chunks straight off the spill file, no restore)
+# ---------------------------------------------------------------------------
+
+
+def test_pull_served_straight_from_spill_file(cluster3):
+    """A remote pull of a spilled object streams chunks directly from
+    the holder's spill file through the same OOB framing as store-backed
+    serves — the holder never reloads the object into its store."""
+    c = cluster3
+    src, dst = c.agents[0], c.agents[1]
+    data = os.urandom(10 * 2**20)  # 3 chunks at the default 4MB
+    oid = _seed(c, src, data, meta=b"spill-meta")
+    assert c.io.run(src._spill_one(oid))
+    assert src.store.get(oid) is None  # evicted: only the file remains
+    assert oid in src.spilled_files
+    assert _pull(c, dst, oid)
+    assert _stored_bytes(dst, oid) == data
+    buf = dst.store.get(oid)
+    assert bytes(buf.metadata) == b"spill-meta"
+    buf.release()
+    # served straight from disk: the holder still has no store copy and
+    # the spill file survives for the next puller
+    assert src.store.get(oid) is None
+    assert oid in src.spilled_files
+    from ray_tpu._private import flight_recorder as _fr
+
+    spans = [s for s in _fr._get().ring
+             if s["name"] == "transfer.serve_chunk"
+             and s["attrs"].get("spill")
+             and s["attrs"].get("oid") == oid.hex()[:16]]
+    assert len(spans) == 3
+    assert sum(s["attrs"]["bytes"] for s in spans) == len(data)
+
+
+def test_spill_serve_small_chunks_meta_only_at_offset_zero(cluster3):
+    """Many-chunk spill serve: metadata rides only the offset-0 chunk
+    (the framing contract), later offsets seek past `8B len | meta` into
+    the data region, and the reassembled bytes are identical."""
+    c = cluster3
+    src, dst = c.agents[0], c.agents[2]
+    data = os.urandom(3 * 256 * 1024 + 17)
+    oid = _seed(c, src, data, meta=b"m" * 100)
+    assert c.io.run(src._spill_one(oid))
+    with _flag(object_transfer_chunk_bytes=256 * 1024):
+        assert _pull(c, dst, oid)
+    assert _stored_bytes(dst, oid) == data
+    buf = dst.store.get(oid)
+    assert bytes(buf.metadata) == b"m" * 100
+    buf.release()
+    assert src.store.get(oid) is None

@@ -1,0 +1,500 @@
+"""Cross-slice MPMD pipeline parallelism tests.
+
+Covers the PipelineSchedule math (1F1B + GPipe degenerate), end-to-end
+bit-exact parity of a 2-stage pipeline against a sequential single-slice
+baseline, asymmetric per-stage data parallelism with the overlapped
+gradient allreduce, the elastic heal path (mid-run stage kill -> in-place
+respawn + epoch-bumped p2p reform + checkpoint resume, ZERO gang
+restarts), the `pipeline` chaos profile, link-aware ring rank placement
+(demand_scheduler.ring_order + WorkerGroup._ring_ranks), and multi-group
+p2p isolation (two pipeline lanes + a dp allreduce group sharing hosts
+without cross-talk; destroying one purges only its own state).
+"""
+
+import sys
+import uuid
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import chaos
+from ray_tpu._private import flight_recorder as _fr
+from ray_tpu.autoscaler.demand_scheduler import ring_order
+from ray_tpu.collective import collective as col
+from ray_tpu.parallel import MpmdPipeline, PipelineSchedule, StageSpec
+
+try:
+    import cloudpickle
+
+    cloudpickle.register_pickle_by_value(sys.modules[__name__])
+except Exception:  # noqa: BLE001 — pack_callable registers lazily too
+    pass
+
+
+# ---------------------------------------------------------------------------
+# schedule math (no cluster)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("stages,mbs", [(2, 4), (3, 4), (4, 8), (2, 1)])
+def test_schedule_1f1b_wellformed(stages, mbs):
+    sched = PipelineSchedule(stages, mbs)
+    for s in range(stages):
+        acts = sched.actions(s)
+        fs = [m for op, m in acts if op == "F"]
+        bs = [m for op, m in acts if op == "B"]
+        # every microbatch exactly once forward and once backward,
+        # each sub-sequence ascending (keeps p2p seq routing aligned)
+        assert fs == list(range(mbs))
+        assert bs == list(range(mbs))
+        # B(m) never before F(m)
+        pos = {("F", m): i for i, (op, m) in enumerate(acts) if op == "F"}
+        for i, (op, m) in enumerate(acts):
+            if op == "B":
+                assert i > pos[("F", m)]
+        # in-flight activations never exceed the stage's declared peak
+        live = peak = 0
+        for op, _ in acts:
+            live += 1 if op == "F" else -1
+            peak = max(peak, live)
+        assert peak == sched.peak_live(s)
+        assert sched.peak_live(s) == min(mbs, sched.warmup(s) + 1)
+
+
+def test_schedule_1f1b_order_s3m4():
+    sched = PipelineSchedule(3, 4)
+    assert sched.actions(0) == [("F", 0), ("F", 1), ("F", 2), ("B", 0),
+                                ("F", 3), ("B", 1), ("B", 2), ("B", 3)]
+    # last stage is fully interleaved: zero warmup
+    assert sched.actions(2) == [("F", 0), ("B", 0), ("F", 1), ("B", 1),
+                                ("F", 2), ("B", 2), ("F", 3), ("B", 3)]
+    assert [sched.peak_live(s) for s in range(3)] == [3, 2, 1]
+
+
+def test_schedule_gpipe_degenerate():
+    sched = PipelineSchedule(3, 4, style="gpipe")
+    for s in range(3):
+        # all forwards, then all backwards; peak = all mbs live
+        assert sched.actions(s) == (
+            [("F", m) for m in range(4)] + [("B", m) for m in range(4)])
+        assert sched.peak_live(s) == 4
+
+
+def test_schedule_bubble_fraction():
+    assert PipelineSchedule(1, 8).bubble_fraction() == 0.0
+    np.testing.assert_allclose(
+        PipelineSchedule(4, 8).bubble_fraction(), 3 / 11)
+    # more microbatches -> smaller bubble, same stage count
+    assert (PipelineSchedule(4, 32).bubble_fraction()
+            < PipelineSchedule(4, 8).bubble_fraction())
+
+
+# ---------------------------------------------------------------------------
+# shared toy model (2 matmul stages) + sequential baseline
+# ---------------------------------------------------------------------------
+
+D0, D1, D2, B = 6, 5, 4, 8
+LR = 0.05
+
+
+def data_fn(step, m):
+    rng = np.random.default_rng(1000 + step * 100 + m)
+    return (rng.standard_normal((B, D0)), rng.standard_normal((B, D2)))
+
+
+def init0(cfg):
+    return {"w": np.random.default_rng(7).standard_normal((D0, D1))}
+
+
+def init1(cfg):
+    return {"w": np.random.default_rng(8).standard_normal((D1, D2))}
+
+
+def fwd(params, x):
+    return x @ params["w"], x
+
+
+def bwd(params, x, dy):
+    return dy @ params["w"].T, {"w": x.T @ dy}
+
+
+def loss_fn(params, y, t):
+    d = y - t
+    return 0.5 * float(np.mean(d * d)), d / d.size
+
+
+def baseline(steps, mbs):
+    """Single-slice sequential reference: same math, no pipeline."""
+    p0, p1 = init0({}), init1({})
+    losses = []
+    for step in range(steps):
+        g0 = np.zeros_like(p0["w"])
+        g1 = np.zeros_like(p1["w"])
+        ls = []
+        for m in range(mbs):
+            x, t = data_fn(step, m)
+            y0, s0 = fwd(p0, x)
+            y1, s1 = fwd(p1, y0)
+            loss, dy = loss_fn(p1, y1, t)
+            ls.append(loss)
+            dx1, gg1 = bwd(p1, s1, dy)
+            _, gg0 = bwd(p0, s0, dx1)
+            g0 += gg0["w"]
+            g1 += gg1["w"]
+        p0["w"] = p0["w"] - LR * g0 / mbs
+        p1["w"] = p1["w"] - LR * g1 / mbs
+        losses.append(sum(ls) / len(ls))
+    return losses, p0, p1
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(head_resources={"CPU": 8, "memory": 4 * 2**30})
+    c.connect()
+    yield c
+    c.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end parity
+# ---------------------------------------------------------------------------
+
+
+def test_pipeline_parity_2stage_1f1b(cluster):
+    """2-stage MPMD pipeline == sequential baseline, bit for bit: same
+    per-worker accumulation order, so losses AND params match exactly."""
+    steps, mbs = 3, 4
+    pipe = MpmdPipeline(
+        [StageSpec(1, init0, fwd, bwd),
+         StageSpec(1, init1, fwd, bwd, loss_fn)],
+        data_fn=data_fn, num_steps=steps, microbatches=mbs, lr=LR,
+        return_params=True, name=f"par-{uuid.uuid4().hex[:6]}")
+    res = pipe.fit()
+    bl, p0, p1 = baseline(steps, mbs)
+    assert res.steps_completed == steps
+    assert res.heals == 0 and res.gang_restarts == 0
+    assert res.stage_world_sizes == [1, 1]
+    np.testing.assert_array_equal(res.losses, bl)
+    np.testing.assert_array_equal(res.final_params[0]["w"], p0["w"])
+    np.testing.assert_array_equal(res.final_params[1]["w"], p1["w"])
+    # measured bubble decomposition came back per stage
+    assert sorted(res.bubble_by_stage) == [0, 1]
+    assert all(0.0 <= b < 1.0 for b in res.bubble_by_stage.values())
+
+
+def test_pipeline_parity_gpipe(cluster):
+    """GPipe schedule hits the same numbers: accumulation order per
+    worker is still ascending-microbatch."""
+    steps, mbs = 2, 4
+    pipe = MpmdPipeline(
+        [StageSpec(1, init0, fwd, bwd),
+         StageSpec(1, init1, fwd, bwd, loss_fn)],
+        data_fn=data_fn, num_steps=steps, microbatches=mbs, lr=LR,
+        schedule="gpipe", name=f"gp-{uuid.uuid4().hex[:6]}")
+    res = pipe.fit()
+    bl, _, _ = baseline(steps, mbs)
+    np.testing.assert_array_equal(res.losses, bl)
+
+
+def test_pipeline_asymmetric_dp_parity(cluster):
+    """Asymmetric per-stage gangs ([1 worker, 2 workers]): microbatches
+    fan out across stage-1 dp replicas, grads sync via the overlapped
+    dcn allreduce. Allreduce reorders the sum, so parity is allclose."""
+    steps, mbs = 2, 4
+    pipe = MpmdPipeline(
+        [StageSpec(1, init0, fwd, bwd),
+         StageSpec(2, init1, fwd, bwd, loss_fn)],
+        data_fn=data_fn, num_steps=steps, microbatches=mbs, lr=LR,
+        return_params=True, name=f"dp-{uuid.uuid4().hex[:6]}")
+    res = pipe.fit()
+    bl, p0, p1 = baseline(steps, mbs)
+    assert res.stage_world_sizes == [1, 2]
+    np.testing.assert_allclose(res.losses, bl, rtol=0, atol=1e-12)
+    np.testing.assert_allclose(res.final_params[0]["w"], p0["w"],
+                               rtol=0, atol=1e-12)
+    np.testing.assert_allclose(res.final_params[1]["w"], p1["w"],
+                               rtol=0, atol=1e-12)
+
+
+def test_pipeline_stage_kill_heals_in_place(cluster, tmp_path):
+    """Mid-run stage-worker kill: the driver quiesces every stage, heals
+    the dead gang member in place, reforms the p2p group under a bumped
+    epoch, and resumes all stages from the last common checkpoint — zero
+    gang restarts, and the final losses still match the baseline."""
+    steps, mbs = 5, 4
+    name = f"heal-{uuid.uuid4().hex[:6]}"
+    pipe = MpmdPipeline(
+        [StageSpec(1, init0, fwd, bwd),
+         StageSpec(1, init1, fwd, bwd, loss_fn)],
+        data_fn=data_fn, num_steps=steps, microbatches=mbs, lr=LR,
+        ckpt_dir=str(tmp_path / "ck"), ckpt_every=1,
+        p2p_timeout_s=15.0, quiesce_timeout_s=5.0, poll_s=2.0,
+        fault_specs=[{"site": "pipeline.stage", "match": {"rank": 1},
+                      "after": 10, "action": "exit", "count": 1}],
+        name=name)
+    res = pipe.fit()
+    bl, _, _ = baseline(steps, mbs)
+    assert res.heals >= 1, "fault never fired / heal never ran"
+    assert res.gang_restarts == 0
+    assert res.steps_completed == steps
+    np.testing.assert_allclose(res.losses, bl, rtol=0, atol=0)
+    # the driver's flight ring attributes the heal: which stage died,
+    # the bumped p2p epoch, and the step every stage resumed from
+    spans = [s for s in _fr._get().ring
+             if s["name"] == "pipeline.heal"
+             and s["attrs"].get("pipe") == f"{name}-p2p"]
+    assert spans, "heal left no pipeline.heal span in the flight ring"
+    at = spans[-1]["attrs"]
+    assert at["stages"] == [1]
+    assert at["epoch"] >= 2
+    assert at["resume_step"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# satellite: `pipeline` chaos profile
+# ---------------------------------------------------------------------------
+
+
+def test_pipeline_fault_plan_deterministic():
+    a = chaos.gen_fault_plan(1234, profile="pipeline", world_size=3)
+    b = chaos.gen_fault_plan(1234, profile="pipeline", world_size=3)
+    assert a.env_value() == b.env_value()
+    assert a.describe() == b.describe()
+
+
+def test_pipeline_fault_plan_covers_site_space():
+    sites = set()
+    for seed in range(300):
+        plan = chaos.gen_fault_plan(seed, profile="pipeline", world_size=4)
+        for spec in plan.specs:
+            sites.add(spec["site"])
+            if spec["site"] == "pipeline.stage":
+                # rank-pinned against the pipeline p2p world, spread
+                # over ~a step's worth of boundary hops, worker-armed
+                assert 0 <= spec["match"]["rank"] < 4
+                assert 0 <= spec["after"] < 10
+                assert spec in plan.worker_specs
+    assert sites == set(chaos.PIPELINE_SITE_WEIGHTS)
+
+
+def test_pipeline_surface_does_not_leak_into_other_profiles():
+    """Profile selection happens before any rng draw: train/rl/qos plans
+    never contain pipeline-only sites."""
+    for profile in ("train", "rl", "qos"):
+        for seed in range(200):
+            plan = chaos.gen_fault_plan(seed, profile=profile,
+                                        world_size=4)
+            assert all(s["site"] != "pipeline.stage" for s in plan.specs)
+
+
+# ---------------------------------------------------------------------------
+# satellite: link-aware ring rank placement
+# ---------------------------------------------------------------------------
+
+
+def test_ring_order_identity_without_signal():
+    assert ring_order(["a", "b", "c", "d"], None) == [0, 1, 2, 3]
+    assert ring_order(["a", "b", "c"], {}) == [0, 1, 2]
+    flat = {"a": 5.0, "b": 5.0, "c": 5.0}
+    assert ring_order(["a", "b", "c"], flat) == [0, 1, 2]
+    # n <= 2: every order is the same ring
+    assert ring_order(["a", "b"], {"a": 0.0, "b": 9e9}) == [0, 1]
+
+
+def test_ring_order_weaves_hot_links_apart():
+    labels = ["n0", "n1", "n2", "n3"]
+    tx = {"n0": 100.0, "n1": 0.0, "n2": 5.0, "n3": 50.0}
+    order = ring_order(labels, tx)
+    assert sorted(order) == [0, 1, 2, 3]
+    # the heaviest link's ring neighbors are the two lightest links
+    ring_pos = {member: k for k, member in enumerate(order)}
+    n = len(order)
+    heavy = max(range(n), key=lambda i: tx[labels[i]])
+    neighbors = {order[(ring_pos[heavy] + 1) % n],
+                 order[(ring_pos[heavy] - 1) % n]}
+    two_lightest = set(sorted(range(n), key=lambda i: tx[labels[i]])[:2])
+    assert neighbors == two_lightest
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_ring_order_heaviest_pair_never_adjacent(seed):
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(4, 9))
+    labels = [f"n{i}" for i in range(n)]
+    loads = rng.permutation(n).astype(float) * 10.0
+    tx = dict(zip(labels, loads))
+    order = ring_order(labels, tx)
+    assert sorted(order) == list(range(n))
+    by_load = sorted(range(n), key=lambda i: tx[labels[i]])
+    heavy, second = by_load[-1], by_load[-2]
+    pos = {m: k for k, m in enumerate(order)}
+    gap = abs(pos[heavy] - pos[second])
+    assert gap not in (1, n - 1), (order, tx)
+
+
+def test_worker_group_ring_ranks_link_aware():
+    """_ring_ranks inverts the ring order into per-position ranks; with
+    a flat signal it stays the identity."""
+    from ray_tpu.train.worker_group import WorkerGroup
+
+    wg = WorkerGroup.__new__(WorkerGroup)
+    wg.num_workers = 4
+    wg.node_ids = lambda: ["aa" * 4, "bb" * 4, "cc" * 4, "dd" * 4]
+    tx = {"aaaaaaaa": 100.0, "bbbbbbbb": 0.0,
+          "cccccccc": 5.0, "dddddddd": 50.0}
+    ranks = wg._ring_ranks(tx)
+    order = ring_order(["aaaaaaaa", "bbbbbbbb", "cccccccc", "dddddddd"],
+                       tx)
+    assert sorted(ranks) == [0, 1, 2, 3]
+    assert ranks != [0, 1, 2, 3]
+    # ranks is the inverse permutation: position order[k] holds rank k
+    for k, pos in enumerate(order):
+        assert ranks[pos] == k
+    assert wg._ring_ranks({"aaaaaaaa": 1.0, "bbbbbbbb": 1.0,
+                           "cccccccc": 1.0, "dddddddd": 1.0}) == [0, 1, 2, 3]
+
+
+def test_worker_group_link_aware_init_and_reform(cluster):
+    """Full path: a permuted link_tx signal routes through
+    init_collective into the actual group ranks; the collective still
+    works; reform_collective compacts ranks back to gang positions."""
+    from ray_tpu.train.worker_group import WorkerGroup
+
+    # local fn: cloudpickle ships closures by value regardless of the
+    # module's (pack_callable-transient) by-value registration
+    def _wg_allreduce(worker, group_name):
+        from ray_tpu.collective import collective as _c
+
+        rank = _c.get_rank(group_name)
+        out = _c.allreduce(np.full(3, float(rank + 1)), group_name,
+                           op="sum")
+        return rank, out.tolist()
+
+    wg = WorkerGroup(3, {"CPU": 0.5})
+    try:
+        # fake distinct node labels (the test cluster is one host) with
+        # a skewed byte signal: worker 0's link is hottest
+        wg.node_ids = lambda: ["aa" * 4, "bb" * 4, "cc" * 4]
+        name = wg.init_collective(
+            f"law-{uuid.uuid4().hex[:6]}",
+            link_tx={"aaaaaaaa": 9e9, "bbbbbbbb": 1.0, "cccccccc": 2.0})
+        assert sorted(wg.collective_ranks) == [0, 1, 2]
+        outs = wg.execute(_wg_allreduce, name, timeout=60)
+        assert sorted(r for r, _ in outs) == [0, 1, 2]
+        expect = [float(sum(range(1, 4)))] * 3
+        for _, o in outs:
+            assert o == expect
+        # reform (the heal path) compacts back to position order
+        wg.reform_collective(name)
+        assert wg.collective_ranks == [0, 1, 2]
+        outs = wg.execute(_wg_allreduce, name, timeout=60)
+        assert [r for r, _ in sorted(outs)] == [0, 1, 2]
+    finally:
+        wg.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# satellite: multi-group p2p isolation
+# ---------------------------------------------------------------------------
+
+
+@ray_tpu.remote(num_cpus=0)
+class P2PRank(col.CollectiveActorMixin):
+    """One member of several concurrent collective groups."""
+
+    def psend(self, group, dst, value):
+        col.paced_send(np.asarray(value, dtype=np.float64), dst, group)
+        return True
+
+    def precv(self, group, src, timeout=30.0):
+        return col.paced_recv(src, group, timeout=timeout)
+
+    def allred(self, group, value):
+        return col.allreduce(np.asarray(value, dtype=np.float64), group,
+                             op="sum")
+
+    def destroy(self, group):
+        col.destroy_collective_group(group)
+        return True
+
+    def pending_groups(self):
+        box = col._box
+        if box is None:
+            return []
+        with box.cond:
+            return sorted({k[0] for k in box.msgs})
+
+    def qos_peer_labels(self):
+        from ray_tpu._private import net_qos
+
+        return sorted(net_qos.stats().keys())
+
+
+def test_multi_group_p2p_isolation(cluster):
+    """Two pipeline p2p lanes over the SAME two actors, plus a live dp
+    allreduce group: identical (src, dst, seq) tuples on each lane never
+    cross-talk, and destroying one lane purges only its own mailbox
+    frames and pacer windows — the survivor keeps flowing."""
+    tag = uuid.uuid4().hex[:6]
+    ga, gb, gd = f"isoA-{tag}", f"isoB-{tag}", f"isoD-{tag}"
+    actors = [P2PRank.remote(), P2PRank.remote()]
+    try:
+        for g in (ga, gb, gd):
+            col.create_collective_group(actors, 2, [0, 1], group_name=g)
+        a0, a1 = actors
+        # same seq number (1) on both lanes, different payloads
+        ray_tpu.get([a0.psend.remote(ga, 1, np.full(4, 1.0)),
+                     a0.psend.remote(gb, 1, np.full(4, 2.0))], timeout=60)
+        va = ray_tpu.get(a1.precv.remote(ga, 0), timeout=60)
+        vb = ray_tpu.get(a1.precv.remote(gb, 0), timeout=60)
+        np.testing.assert_array_equal(va, np.full(4, 1.0))
+        np.testing.assert_array_equal(vb, np.full(4, 2.0))
+        # the allreduce group is live alongside both p2p lanes
+        outs = ray_tpu.get([a.allred.remote(gd, np.full(2, float(i + 1)))
+                            for i, a in enumerate(actors)], timeout=60)
+        for o in outs:
+            np.testing.assert_array_equal(o, np.full(2, 3.0))
+        # plant unconsumed frames on BOTH lanes at rank 1...
+        ray_tpu.get([a0.psend.remote(ga, 1, np.zeros(2)),
+                     a0.psend.remote(gb, 1, np.ones(2))], timeout=60)
+
+        def _wait_pending(want):
+            import time
+
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                got = ray_tpu.get(a1.pending_groups.remote(), timeout=30)
+                if set(want) <= set(got):
+                    return got
+            raise AssertionError(f"frames never arrived: want {want}")
+
+        _wait_pending([ga, gb])
+        labels_before = ray_tpu.get(a0.qos_peer_labels.remote(), timeout=30)
+        # ...then tear down lane A only, on both members
+        ray_tpu.get([a.destroy.remote(ga) for a in actors], timeout=60)
+        pending = ray_tpu.get(a1.pending_groups.remote(), timeout=30)
+        assert ga not in pending, "destroy left lane-A frames behind"
+        assert gb in pending, "destroy purged the OTHER lane's frames"
+        # lane-A pacer windows went with it; lane-B labels survive
+        labels_after = ray_tpu.get(a0.qos_peer_labels.remote(), timeout=30)
+        assert not [p for p in labels_after if p.startswith(f"{ga}:")]
+        for p in labels_before:
+            if p.startswith(f"{gb}:"):
+                assert p in labels_after
+        # the survivor lane still flows: the planted frame, then a fresh
+        # round-trip and the dp allreduce
+        vb2 = ray_tpu.get(a1.precv.remote(gb, 0), timeout=60)
+        np.testing.assert_array_equal(vb2, np.ones(2))
+        ray_tpu.get(a0.psend.remote(gb, 1, np.full(2, 7.0)), timeout=60)
+        vb3 = ray_tpu.get(a1.precv.remote(gb, 0), timeout=60)
+        np.testing.assert_array_equal(vb3, np.full(2, 7.0))
+        outs = ray_tpu.get([a.allred.remote(gd, np.full(2, 1.0))
+                            for a in actors], timeout=60)
+        for o in outs:
+            np.testing.assert_array_equal(o, np.full(2, 2.0))
+    finally:
+        for a in actors:
+            ray_tpu.kill(a)
