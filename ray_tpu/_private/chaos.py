@@ -97,12 +97,21 @@ RL_SITE_ACTIONS: dict[str, list[tuple[str, float]]] = {
     "serve.replica_pump": [("exit", 3.0), ("delay", 1.0)],
     "serve.prefill": [("exit", 2.0), ("die", 1.0), ("delay", 1.0)],
     "rl.rollout": [("drop", 1.0), ("delay", 2.0)],
+    # speculative verify step (decode_engine._pump_spec): "drop" makes
+    # the pump fall back to the plain kernel for that chunk — retryable
+    # by construction, the fallback emits the exact same tokens;
+    # stall/delay lengthen one verify dispatch (bounded). Not in any
+    # profile's site WEIGHTS: only drawable via an explicit sites=
+    # override, so existing fixed-seed plans stay byte-identical.
+    "serve.spec_verify": [("drop", 2.0), ("stall", 1.0),
+                          ("delay", 1.0)],
 }
 
 # serve-pool sites arm via the env-propagated RAY_TPU_FAULT_SPEC (the
 # pool's actor processes load it on first fire), not via train-loop
 # config or driver configure()
-SERVE_SITES = frozenset({"serve.replica_pump", "serve.prefill"})
+SERVE_SITES = frozenset({"serve.replica_pump", "serve.prefill",
+                         "serve.spec_verify"})
 
 # ---- the multi-tenant QoS fault surface (profile="qos") ----
 #
@@ -264,6 +273,12 @@ def gen_fault_plan(seed: int, *, world_size: int = 2,
             spec["match"] = {
                 "engine": f"decode-{rng.randrange(n_replicas) + 1}"}
             spec["after"] = rng.randrange(5, 120)
+        elif site == "serve.spec_verify":
+            # pin one replica's engine; the site fires once per
+            # speculative pump, so spread trips across a chunk's worth
+            spec["match"] = {
+                "engine": f"decode-{rng.randrange(n_replicas) + 1}"}
+            spec["after"] = rng.randrange(0, 20)
         elif site == "serve.prefill":
             spec["match"] = {
                 "worker": f"prefill-{rng.randrange(n_prefill) + 1}"}

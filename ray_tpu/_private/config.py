@@ -226,6 +226,18 @@ _DEFS: dict[str, Any] = {
     # family's uninstrumented baseline (propagates to spawned workers);
     # production always runs with it on
     "flight_recorder_enabled": True,
+    # speculative decoding on the serving slot batch
+    # (models/decode_engine.py). Both knobs are read at every pump —
+    # live-flippable like transfer_scatter_read, so an operator (or the
+    # bench) can kill or retune speculation on a running engine without
+    # a restart and the next chunk obeys. serve_spec_enabled gates the
+    # engine's configured depth; serve_spec_depth > 0 OVERRIDES the
+    # per-engine constructor depth (0 = use the engine's own setting).
+    # Emitted tokens are identical either way (the verify step emits
+    # the target's own lane-sampled tokens; speculation only changes
+    # how many arrive per dispatch), so flipping mid-stream is safe.
+    "serve_spec_enabled": True,
+    "serve_spec_depth": 0,
 }
 
 _cache: dict[str, Any] = {}

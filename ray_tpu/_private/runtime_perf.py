@@ -460,6 +460,111 @@ def run_serve_benchmarks(*, quick: bool = False) -> list[dict]:
     return results
 
 
+def run_serve_spec_benchmarks(*, quick: bool = False) -> list[dict]:
+    """The `serve_spec` family: speculative decoding's pump-rate win.
+
+    Same workload shape as the serve family (tiny model, emulated
+    chunk dispatch latency) with speculation off vs draft depth 2/4,
+    greedy and sampled. What speculation buys is PUMPS: each verify
+    round emits 1..K+1 tokens, so a stream finishes in fewer chunk
+    dispatches — under a real device's per-dispatch latency (the
+    chunk_delay_s stand-in) that is the whole win. Every spec record
+    also proves the correctness contract en passant: its token
+    sequences are compared bit-for-bit against the spec-off baseline
+    of the same seeds (``match_baseline``)."""
+    import threading
+
+    from ray_tpu.serve.llm_pool import LLMPool
+
+    prompt_len, new_tokens, chunk_delay = 16, 96, 0.05
+    chunk_tokens = 4  # short pumps: dispatch cadence dominates, as on device
+    n_requests = 16 if quick else 32
+    concurrency = 32
+    results = []
+
+    def prompt_for(i):
+        rng = np.random.RandomState(1000 + i)
+        return [int(x) for x in rng.randint(1, 250, prompt_len)]
+
+    def run_pool(spec_depth, temperature):
+        pool = LLMPool(
+            model_size="tiny", slots=8, max_len=128,
+            chunk_tokens=chunk_tokens,
+            prompt_buckets=(prompt_len,), min_replicas=1,
+            max_replicas=1, chunk_delay_s=chunk_delay,
+            spec_depth=spec_depth, spec_draft_layers=1,
+            autoscale=False)
+        try:
+            # warm: compiles prefill + the (spec or plain) decode kernel
+            pool.generate(prompt_for(0), 8, temperature=temperature,
+                          seed=1)
+            outs = [None] * n_requests
+            errs: list[str] = []
+            sem = threading.Semaphore(concurrency)
+
+            def one(i):
+                with sem:
+                    try:
+                        outs[i] = pool.generate(
+                            prompt_for(100 + i), new_tokens,
+                            temperature=temperature,
+                            seed=(100 + i) * 7 + 1)
+                    except Exception as e:  # noqa: BLE001
+                        errs.append(
+                            f"req {i}: {type(e).__name__}: {e}")
+
+            threads = [threading.Thread(target=one, args=(i,))
+                       for i in range(n_requests)]
+            t0 = time.perf_counter()
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            dt = time.perf_counter() - t0
+            if errs:
+                raise RuntimeError(
+                    f"{len(errs)}/{n_requests} spec pool requests "
+                    f"failed; first: {errs[0][:300]}")
+            total = sum(len(o["tokens"]) for o in outs)
+            st = pool.stats()
+            spec_st = next(
+                (s.get("spec") for s in st["per_replica"].values()
+                 if isinstance(s, dict) and s.get("spec")), None)
+            return {
+                "per_s": round(total / dt, 1),
+                "unit": "tokens/s",
+                "replicas": 1,
+                "concurrency": concurrency,
+                "n_requests": n_requests,
+                "new_tokens": new_tokens,
+                "chunk_delay_s": chunk_delay,
+                "chunk_tokens": chunk_tokens,
+                "spec_depth": spec_depth,
+                "temperature": temperature,
+                "acceptance_rate": (spec_st or {}).get(
+                    "acceptance_rate"),
+            }, [o["tokens"] for o in outs]
+        finally:
+            pool.shutdown()
+
+    for temperature, label in [(0.0, "greedy"), (0.8, "sampled")]:
+        baseline = None
+        for depth in (0, 2, 4):
+            r, toks = run_pool(depth, temperature)
+            if depth == 0:
+                baseline = toks
+            else:
+                # the correctness contract, measured on the bench
+                # workload itself: speculation must emit the exact
+                # sequences the plain path emits
+                r["match_baseline"] = (toks == baseline)
+            tag = "off" if depth == 0 else f"depth {depth}"
+            r = {"name": f"serve spec decode {tag} ({label})", **r}
+            results.append(r)
+            print(json.dumps(r), flush=True)
+    return results
+
+
 def run_rl_benchmarks(*, quick: bool = False) -> list[dict]:
     """The `rl` family: the actor–learner loop's three data paths.
 
@@ -1154,6 +1259,9 @@ def run_benchmarks(*, quick: bool = False) -> list[dict]:
     # ---- serving tier (LLM pool replica scaling + prefix cache) ----
     results.extend(run_serve_benchmarks(quick=quick))
 
+    # ---- speculative decoding (draft/verify pump-rate win) ----
+    results.extend(run_serve_spec_benchmarks(quick=quick))
+
     # ---- rl (actor-learner rollout / experience / publish paths) ----
     results.extend(run_rl_benchmarks(quick=quick))
 
@@ -1221,7 +1329,8 @@ def main(argv=None):
     p.add_argument("--quick", action="store_true")
     p.add_argument("--family", default="all",
                    choices=["all", "collective", "transfer", "serve",
-                            "rl", "obs", "qos", "pipeline"],
+                            "serve_spec", "rl", "obs", "qos",
+                            "pipeline"],
                    help="run one workload family only")
     p.add_argument("--in-process", action="store_true",
                    help="head in the driver process (debug only)")
@@ -1242,6 +1351,8 @@ def main(argv=None):
             results = run_transfer_benchmarks(quick=args.quick)
         elif args.family == "serve":
             results = run_serve_benchmarks(quick=args.quick)
+        elif args.family == "serve_spec":
+            results = run_serve_spec_benchmarks(quick=args.quick)
         elif args.family == "rl":
             results = run_rl_benchmarks(quick=args.quick)
         elif args.family == "obs":

@@ -219,8 +219,27 @@ class DashboardHead:
         for tn, pct in _hist_percentiles(
                 rows, "serve_tbt_seconds", group_key="tenant").items():
             per_tenant.setdefault(tn or "-", {})["tbt"] = pct
+        # speculative-decode acceptance per engine: the counters pair
+        # (decode_engine_spec_proposed/accepted_total) tells an operator
+        # whether the draft is earning its keep — acceptance_rate near 0
+        # means the verify pays the wide forward for nothing
+        spec: dict[str, dict] = {}
+        for r in rows:
+            if r["name"] not in ("decode_engine_spec_proposed_total",
+                                 "decode_engine_spec_accepted_total"):
+                continue
+            tags = dict(tuple(t) for t in r["tags"])
+            ent = spec.setdefault(tags.get("engine", "?"),
+                                  {"proposed": 0.0, "accepted": 0.0})
+            key = ("proposed" if r["name"].endswith("proposed_total")
+                   else "accepted")
+            ent[key] += r["value"]
+        for ent in spec.values():
+            ent["acceptance_rate"] = round(
+                ent["accepted"] / ent["proposed"], 4) \
+                if ent["proposed"] else 0.0
         return {"ttft": ttft.get("", {}), "tbt": tbt.get("", {}),
-                "per_tenant": per_tenant,
+                "per_tenant": per_tenant, "speculation": spec,
                 "train_step": step, "straggler": straggler}
 
     def _agent_call(self, node: dict, method: str, payload: dict,

@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ray_tpu.models import llama
+from ray_tpu.models import llama, mlp
 from ray_tpu.models.llama import LlamaConfig
 
 
@@ -62,6 +62,14 @@ def _get_metrics():
                 boundaries=(0.001, 0.005, 0.02, 0.05, 0.1, 0.25,
                             0.5, 1.0),
                 tag_keys=("engine", "tenant")),
+            "spec_proposed": M.Counter(
+                "decode_engine_spec_proposed_total",
+                "draft tokens proposed to the speculative verify step",
+                tag_keys=("engine",)),
+            "spec_accepted": M.Counter(
+                "decode_engine_spec_accepted_total",
+                "draft tokens accepted by the speculative verify step",
+                tag_keys=("engine",)),
         }
     return _metrics
 
@@ -108,6 +116,43 @@ def _layer_decode_ragged(cfg: LlamaConfig, h, p, sin, cos, ck, cv, pos):
     return h, ck, cv
 
 
+def _layer_verify_ragged(cfg: LlamaConfig, h, p, sin, cos, ck, cv, pos):
+    """T-query generalization of :func:`_layer_decode_ragged` for the
+    speculative VERIFY step: h is [B, T, D] (the current token plus the
+    K drafted tokens, T == K+1) and pos [B] is each slot's base
+    position. All T k/v rows scatter at pos..pos+T-1 in one write, and
+    the mask is per-query causal (query j of slot b attends
+    k_pos <= pos[b]+j) — so the wide pass computes exactly the T
+    sequential ragged-decode steps, in one layer sweep."""
+    from ray_tpu.ops.attention import _repeat_kv
+
+    b, t, _ = h.shape
+    hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    cdt = cfg.compute_dtype
+    s = ck.shape[1]
+
+    q, k, v = llama._qkv(cfg, p, h, sin, cos)  # [B, T, H*, hd]
+    rows = jnp.arange(b)[:, None]
+    cols = pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+    ck = ck.at[rows, cols].set(k)
+    cv = cv.at[rows, cols].set(v)
+
+    kk = _repeat_kv(ck, hq // hkv)
+    vv = _repeat_kv(cv, hq // hkv)
+    logits = jnp.einsum(
+        "bthd,bshd->bhts", q, kk, preferred_element_type=jnp.float32
+    ) * (hd ** -0.5)
+    k_pos = jnp.arange(s, dtype=jnp.int32)[None, None, :]  # [1, 1, S]
+    live = k_pos <= cols[:, :, None]  # [B, T, S]
+    logits = jnp.where(live[:, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(cdt)
+    o = jnp.einsum(
+        "bhts,bshd->bthd", probs, vv, preferred_element_type=jnp.float32
+    ).astype(cdt)
+    h = llama._attn_out_and_mlp(cfg, p, h, o)
+    return h, ck, cv
+
+
 def _sample_from_logits(logits, seeds, pos, temps, top_ps):
     """Per-slot stateless sampling lane: the RNG key for the token
     emitted from position `pos` of a stream is
@@ -139,11 +184,20 @@ def _sample_from_logits(logits, seeds, pos, temps, top_ps):
         cum = jnp.cumsum(probs)
         # smallest set of tokens whose mass reaches top_p (the exclusive
         # cumsum keeps at least the top token even for tiny top_p)
-        keep = (cum - probs) < top_p
-        filt = jnp.where(keep, srt, -jnp.inf)
-        idx = jax.random.categorical(key, filt)
-        lp = jax.nn.log_softmax(filt)[idx]
-        sampled = order[idx]
+        keep_sorted = (cum - probs) < top_p
+        keep = jnp.zeros_like(keep_sorted).at[order].set(keep_sorted)
+        filt = jnp.where(keep, scaled, -jnp.inf)
+        # TOKEN-space Gumbel-argmax (categorical's own construction,
+        # unsorted): the noise attached to token id v is a pure function
+        # of (key, v). The speculative draft (decode_chunk_spec) samples
+        # its proposal on the SAME lane key as the verify's token, so
+        # shared noise makes them agree whenever the two distributions
+        # are close — sampling over the SORTED vector would attach noise
+        # to ranks instead and decouple the draft whenever the orderings
+        # differ, collapsing the acceptance rate.
+        g = jax.random.gumbel(key, filt.shape)
+        sampled = jnp.argmax(filt + g)
+        lp = jax.nn.log_softmax(filt)[sampled]
         use = temp > 0.0
         return (jnp.where(use, sampled, greedy).astype(jnp.int32),
                 jnp.where(use, lp, greedy_lp))
@@ -240,6 +294,128 @@ def decode_chunk(params, cache, tok, active, cfg: LlamaConfig,
         one_step, (tok, cache["k"], cache["v"], cache["pos"]),
         None, length=chunk)
     return jnp.moveaxis(toks, 0, 1), {"k": k, "v": v, "pos": pos}, last
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "rounds", "depth",
+                                    "draft_layers"),
+                   donate_argnames=("cache", "tok"))
+def decode_chunk_spec(params, draft_head, cache, tok, active, seeds,
+                      temps, top_ps, cfg: LlamaConfig, rounds: int,
+                      depth: int, draft_layers: int):
+    """Speculative chunk: `rounds` rounds of (K sequential DRAFT steps +
+    ONE K+1-wide VERIFY forward), all inside one jit — one dispatch per
+    pump, like `decode_chunk`, but each round can emit up to K+1 tokens
+    per slot.
+
+    The draft is the target's own first `draft_layers` layers (a
+    shared-trunk weight view — llama.draft_params semantics — plus an
+    optional residual adapter head, mlp.apply_draft_head). Because the
+    trunk layers ARE the target's, the draft reads the target's ragged
+    cache rows directly; the k/v rows it writes for drafted positions
+    are kept in a private carry and DISCARDED — the verify re-writes
+    every layer's rows at pos..pos+K itself before attending, so draft
+    state never leaks into the persistent cache.
+
+    The verify computes the target's OWN token y_j at every position
+    via the same (seed, position) RNG lanes as the non-speculative
+    kernels (temperature 0 rows reduce to argmax), accepts draft tokens
+    up to the first mismatch with y, and emits the target token at the
+    mismatch — so the emitted sequence equals non-speculative decode
+    token for token, greedy or sampled, and failover seed-replay is
+    exact regardless of which draft lengths were accepted before a
+    kill. ROLLBACK is free: each slot's pos advances by its accepted
+    count only; rejected rows sit beyond the mask (invisible, like
+    inactive-slot garbage) and are overwritten by the next round's
+    writes before the mask can reach them.
+
+    Returns (toks [B, rounds, K+1], lps [B, rounds, K+1],
+    counts [B, rounds] — tokens emitted per round (0 for inactive
+    slots), new cache, [B] last token)."""
+    cdt = cfg.compute_dtype
+    w_out = (
+        params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    ).astype(cdt)
+    max_len = cache["k"].shape[2]
+    b = tok.shape[0]
+    t_wide = depth + 1
+    dlayers = jax.tree_util.tree_map(
+        lambda a: a[:draft_layers], params["layers"])
+    rows = jnp.arange(b)
+
+    def one_round(carry, _):
+        t, k, v, pos = carry
+
+        # -- draft: K sequential 1-wide steps over the trunk layers --
+        def draft_step(dc, _):
+            dt, kd, vd, dpos = dc
+            sin, cos = llama.rotary_embedding(
+                dpos[:, None], cfg.head_dim, cfg.rope_theta)
+            h = params["embed"].astype(cdt)[dt[:, None]]
+
+            def body(h_, xs):
+                p_, ck, cv = xs
+                h_, ck, cv = _layer_decode_ragged(
+                    cfg, h_, p_, sin, cos, ck, cv, dpos)
+                return h_, (ck, cv)
+
+            h, (kd, vd) = jax.lax.scan(body, h, (dlayers, kd, vd))
+            h = mlp.apply_draft_head(draft_head, h)
+            h = llama.rms_norm(h, params["final_norm"], cfg.rms_eps)
+            logits = (h[:, 0] @ w_out).astype(jnp.float32)
+            # the proposal for position dpos+1 rides lane dpos — the
+            # SAME lane the verify uses for its token at dpos+1's
+            # predecessor, so under sampling the draft and target draw
+            # with shared Gumbel noise (agreement is higher than the
+            # argmax overlap of their distributions)
+            d, _ = _sample_from_logits(logits, seeds, dpos, temps,
+                                       top_ps)
+            dpos = jnp.minimum(dpos + 1, max_len - 1)
+            return (d, kd, vd, dpos), d
+
+        (_, _, _, _), drafts = jax.lax.scan(
+            draft_step,
+            (t, k[:draft_layers], v[:draft_layers], pos),
+            None, length=depth)
+        drafts = jnp.moveaxis(drafts, 0, 1)  # [B, K]
+
+        # -- verify: ONE wide forward over the K+1 positions --
+        xs = jnp.concatenate([t[:, None], drafts], axis=1)  # [B, T]
+        qpos = pos[:, None] + jnp.arange(t_wide, dtype=jnp.int32)
+        sin, cos = llama.rotary_embedding(
+            qpos, cfg.head_dim, cfg.rope_theta)
+        h = params["embed"].astype(cdt)[xs]  # [B, T, D]
+
+        def vbody(h_, xs_):
+            p_, ck, cv = xs_
+            h_, ck, cv = _layer_verify_ragged(
+                cfg, h_, p_, sin, cos, ck, cv, pos)
+            return h_, (ck, cv)
+
+        h, (k, v) = jax.lax.scan(vbody, h, (params["layers"], k, v))
+        h = llama.rms_norm(h, params["final_norm"], cfg.rms_eps)
+        logits = (h @ w_out).astype(jnp.float32)  # [B, T, V]
+        y, lp = _sample_from_logits(
+            logits.reshape(b * t_wide, -1),
+            jnp.repeat(seeds, t_wide), qpos.reshape(-1),
+            jnp.repeat(temps, t_wide), jnp.repeat(top_ps, t_wide))
+        y = y.reshape(b, t_wide)
+        lp = lp.reshape(b, t_wide)
+
+        # -- accept until first mismatch; rollback = pos truncation --
+        match = (drafts == y[:, :depth]).astype(jnp.int32)
+        m = jnp.cumprod(match, axis=1).sum(axis=1) + 1  # [B] in 1..K+1
+        m = jnp.where(active, m, 0)
+        t = jnp.where(active, y[rows, jnp.maximum(m - 1, 0)], t)
+        pos = jnp.minimum(pos + m, max_len - 1)
+        return (t, k, v, pos), (y, lp, m)
+
+    (last, k, v, pos), (toks, lps, counts) = jax.lax.scan(
+        one_round, (tok, cache["k"], cache["v"], cache["pos"]),
+        None, length=rounds)
+    return (jnp.moveaxis(toks, 0, 1), jnp.moveaxis(lps, 0, 1),
+            jnp.moveaxis(counts, 0, 1), {"k": k, "v": v, "pos": pos},
+            last)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",),
@@ -413,7 +589,9 @@ class RaggedDecoder:
                  max_len: int = 512, chunk_tokens: int = 32,
                  prompt_buckets: tuple = (32, 64, 128, 256),
                  prefix_cache=None, name: str = "default",
-                 chunk_delay_s: float = 0.0, weights_version: int = 0):
+                 chunk_delay_s: float = 0.0, weights_version: int = 0,
+                 spec_depth: int = 0, spec_draft_layers: int = 0,
+                 spec_draft_head=None):
         self.params = params
         # Emulated per-chunk device dispatch latency for benchmarking
         # the SERVING tier on hosts without an accelerator: on a real
@@ -455,6 +633,22 @@ class RaggedDecoder:
         self._by_sid: dict[int, _Stream] = {}
         self.prefix_cache = prefix_cache  # models.kv_prefix_cache or None
         self.name = name
+        # speculative decoding (decode_chunk_spec): depth K drafts per
+        # verify round; 0 = off. The live config knobs
+        # serve_spec_enabled / serve_spec_depth are consulted at every
+        # pump (_spec_depth_now) so speculation can be flipped or
+        # re-depthed on a running engine — emitted tokens are identical
+        # either way, only the pump's token yield changes.
+        self.spec_depth = max(0, int(spec_depth))
+        ld = int(spec_draft_layers) or max(1, cfg.n_layers // 2)
+        self.spec_draft_layers = min(max(ld, 1), cfg.n_layers)
+        self.spec_draft_head = spec_draft_head
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_pumps = 0
+        # accepted-length histogram: accept_hist[m] = verify rounds (of
+        # active slots) that accepted exactly m draft tokens, 0..depth
+        self._spec_hist: collections.Counter = collections.Counter()
         self._total_tokens = 0
         # (stamp, n_tokens) per pump for the tokens/s scaling signal
         self._rate_window: collections.deque = collections.deque()
@@ -726,6 +920,17 @@ class RaggedDecoder:
             [st is not None for st in self.slot_stream])
         if not active_mask.any():
             return 0
+        depth = self._spec_depth_now()
+        if depth > 0:
+            from ray_tpu._private import fault_injection as _fi
+            # chaos site: "drop" falls back to the plain kernel for
+            # this pump — RETRYABLE by construction, the plain path
+            # emits the exact same tokens (just fewer per pump);
+            # "stall"/"delay" sleep inside fire() (bounded)
+            if _fi.fire("serve.spec_verify", engine=self.name) == "drop":
+                depth = 0
+        if depth > 0:
+            return self._pump_spec(active_mask, depth)
         if self._sampling_seen:
             toks, lps, self.cache, self.cur_tok = decode_chunk_sampled(
                 self.params, self.cache, self.cur_tok, active_mask,
@@ -777,6 +982,108 @@ class RaggedDecoder:
                 s.done = True
                 self.finished[s.sid] = s
                 self.slot_stream[slot] = None  # slot freed THIS chunk
+        self._account(t_now, delivered)
+        return int(active_mask.sum())
+
+    MAX_SPEC_DEPTH = 8  # each distinct depth compiles its own kernel
+
+    def _spec_depth_now(self) -> int:
+        """Effective draft depth for THIS pump. Read from live config
+        every pump (the transfer_scatter_read idiom): serve_spec_enabled
+        gates speculation, serve_spec_depth > 0 overrides the engine's
+        constructor depth. Returns 0 when speculation is off."""
+        from ray_tpu._private import config as _cfg
+        try:
+            if not _cfg.get("serve_spec_enabled"):
+                return 0
+            override = int(_cfg.get("serve_spec_depth"))
+        except Exception:  # noqa: BLE001 — config never breaks decode
+            return self.spec_depth
+        depth = override if override > 0 else self.spec_depth
+        return max(0, min(depth, self.MAX_SPEC_DEPTH))
+
+    def _pump_spec(self, active_mask, depth: int) -> int:
+        """Speculative pump: `chunk` draft/verify rounds in one
+        dispatch, emitting 1..depth+1 tokens per slot per round. Same
+        single device→host sync as the plain pump; per-slot sequences
+        are assembled host-side from the per-round accept counts."""
+        t0 = time.perf_counter()
+        toks, lps, counts, self.cache, self.cur_tok = decode_chunk_spec(
+            self.params, self.spec_draft_head, self.cache,
+            self.cur_tok, active_mask, jnp.asarray(self._slot_seed),
+            jnp.asarray(self._slot_temp), jnp.asarray(self._slot_topp),
+            self.cfg, self.chunk, depth, self.spec_draft_layers)
+        if self.chunk_delay_s:
+            time.sleep(self.chunk_delay_s)  # emulated dispatch latency
+        firsts, self._pending_first = self._pending_first, []
+        toks, lps, counts, pos_np, first_toks, first_lps = \
+            jax.device_get(
+                (toks, lps, counts, self.cache["pos"],
+                 [t for _, t, _ in firsts],
+                 [lp for _, _, lp in firsts]))
+        if not self._sampling_seen:
+            # greedy-only engine: match the plain kernel's logprob
+            # surface (placeholder 0.0) so spec on/off is
+            # indistinguishable to consumers
+            lps = np.zeros_like(lps)
+        t_now = time.perf_counter()
+        delivered = 0
+        for (s, _, _), tk0, lp0 in zip(firsts, first_toks, first_lps):
+            s.logprobs.append(float(lp0))
+            s.tokens.append(int(tk0))
+            s.token_times.append(t_now)
+            delivered += 1
+        proposed = accepted = 0
+        for slot, s in enumerate(self.slot_stream):
+            if s is None:
+                continue
+            seq_t: list = []
+            seq_lp: list = []
+            for r in range(counts.shape[1]):
+                m = int(counts[slot, r])
+                if m <= 0:
+                    continue
+                seq_t.extend(int(x) for x in toks[slot, r, :m])
+                seq_lp.extend(float(x) for x in lps[slot, r, :m])
+                proposed += depth
+                accepted += m - 1
+                self._spec_hist[m - 1] += 1
+            take = min(len(seq_t), s.max_new - len(s.tokens))
+            s.logprobs.extend(seq_lp[:take])
+            s.tokens.extend(seq_t[:take])
+            s.token_times.extend([t_now] * take)
+            delivered += take
+            if take > 0 and len(s.token_times) > take:
+                prev = s.token_times[-take - 1]
+                if t_now > prev:
+                    self._tbt_obs((t_now - prev) / take, s.tenant)
+            if len(s.tokens) >= s.max_new \
+                    or int(pos_np[slot]) >= self.max_len - 1:
+                s.done = True
+                self.finished[s.sid] = s
+                self.slot_stream[slot] = None
+        self._spec_proposed += proposed
+        self._spec_accepted += accepted
+        self._spec_pumps += 1
+        if proposed:
+            try:
+                m = _get_metrics()
+                tags = {"engine": self.name}
+                m["spec_proposed"].inc(proposed, tags)
+                m["spec_accepted"].inc(accepted, tags)
+            except Exception:  # noqa: BLE001 — telemetry never breaks
+                pass
+        try:
+            from ray_tpu._private import flight_recorder as _fr
+            off = time.monotonic() - time.perf_counter()
+            _fr.record(
+                "serve", "serve.spec_verify", t0 + off, t_now + off,
+                attrs={"engine": self.name, "depth": depth,
+                       "rounds": self.chunk, "proposed": proposed,
+                       "accepted": accepted},
+                flush=False)  # per-pump hot path: ring-only
+        except Exception:  # noqa: BLE001
+            pass
         self._account(t_now, delivered)
         return int(active_mask.sum())
 
@@ -842,6 +1149,21 @@ class RaggedDecoder:
         }
         if self.prefix_cache is not None:
             out["prefix_cache"] = self.prefix_cache.stats()
+        if self.spec_depth or self._spec_pumps:
+            prop, acc = self._spec_proposed, self._spec_accepted
+            out["spec"] = {
+                "depth": self.spec_depth,
+                "draft_layers": self.spec_draft_layers,
+                "pumps": self._spec_pumps,
+                "proposed": prop,
+                "accepted": acc,
+                "acceptance_rate":
+                    round(acc / prop, 4) if prop else 0.0,
+                # accepted-length histogram: length -> verify rounds
+                "accept_hist": {
+                    str(k): v
+                    for k, v in sorted(self._spec_hist.items())},
+            }
         return out
 
     def _export_metrics(self, st: dict) -> None:

@@ -555,6 +555,35 @@ def forward_with_cache(params, tokens, cfg: LlamaConfig, cache: dict):
     return logits, {"k": ck, "v": cv, "pos": pos + t}
 
 
+def draft_config(cfg: LlamaConfig, n_layers: int) -> LlamaConfig:
+    """Config of the shared-trunk draft: the target's FIRST `n_layers`
+    transformer blocks plus the target's own final norm and unembedding.
+    Everything else (vocab, heads, dims, rope) is inherited, so the
+    draft's logits live in the target's token space."""
+    if not 1 <= n_layers <= cfg.n_layers:
+        raise ValueError(
+            f"draft depth {n_layers} outside [1, {cfg.n_layers}]")
+    return LlamaConfig(**{**cfg.__dict__, "n_layers": n_layers})
+
+
+def draft_params(params, n_layers: int) -> dict:
+    """Weight VIEW for the shared-trunk draft used by speculative decode
+    (models/decode_engine.py): embedding + the first `n_layers` stacked
+    blocks + final norm (+ lm_head when untied), all shared with the
+    target — zero extra parameters, and the draft's layer-i KV for any
+    position equals the target's layer-i KV (identical weights applied
+    to the identical prefix), which is why the draft can read AND write
+    the first `n_layers` of the target's ragged cache instead of
+    keeping one of its own."""
+    out = {"embed": params["embed"],
+           "layers": jax.tree_util.tree_map(
+               lambda a: a[:n_layers], params["layers"]),
+           "final_norm": params["final_norm"]}
+    if "lm_head" in params:
+        out["lm_head"] = params["lm_head"]
+    return out
+
+
 @functools.partial(jax.jit, static_argnames=("cfg",))
 def _fwd_with_cache_jit(params, tokens, cache, cfg: LlamaConfig):
     # LlamaConfig is frozen/hashable, so the compiled step is cached per

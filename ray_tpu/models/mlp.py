@@ -59,3 +59,35 @@ def loss_fn(params, batch, cfg: MLPConfig):
     loss = softmax_cross_entropy(logits, labels).mean()
     acc = (logits.argmax(-1) == labels).mean()
     return loss, {"loss": loss, "accuracy": acc}
+
+
+# --------------------------------------------------------------------------
+# Residual adapter — the speculative-decode draft head
+# --------------------------------------------------------------------------
+#
+# A 2-layer bottleneck MLP applied residually to the draft trunk's hidden
+# state (models/decode_engine.py): h -> h + relu(h @ w1 + b1) @ w2. The
+# DOWN projection is ZERO-initialized, so at init the adapter is the
+# identity and the draft's proposals are exactly the truncated-trunk
+# argmax/sample — speculation correctness never depends on the head, and
+# a later distillation pass (EAGLE/Medusa-style) can train w2 away from
+# zero to raise the acceptance rate without touching the published
+# target weights.
+
+def init_draft_head(d_model: int, key, d_hidden: int = 0):
+    d_hidden = d_hidden or max(8, d_model // 4)
+    return {
+        "w1": jax.random.normal(key, (d_model, d_hidden), jnp.float32)
+        / math.sqrt(d_model),
+        "b1": jnp.zeros((d_hidden,), jnp.float32),
+        "w2": jnp.zeros((d_hidden, d_model), jnp.float32),
+    }
+
+
+def apply_draft_head(head, h):
+    """h: [..., d_model] (any leading shape). Identity when w2 == 0."""
+    if head is None:
+        return h
+    hd = h.astype(jnp.float32)
+    up = jax.nn.relu(hd @ head["w1"] + head["b1"])
+    return (hd + up @ head["w2"]).astype(h.dtype)

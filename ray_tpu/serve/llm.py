@@ -73,6 +73,31 @@ def build_model(model_size: str = "tiny", *, max_len: int = 512,
     return params, cfg
 
 
+def build_spec_draft(cfg, *, draft_layers: int = 0,
+                     draft_head: bool = False, seed: int = 0):
+    """Draft-model assets for speculative decoding, built alongside the
+    target (every replica derives the identical draft from the same cfg
+    + seed, so failover replicas propose identically — irrelevant for
+    correctness, it only keeps acceptance rates comparable). The draft
+    is a WEIGHT VIEW: the target's first `draft_layers` layers
+    (llama.draft_params semantics; default half the stack) plus an
+    optional zero-init residual adapter head (mlp.init_draft_head —
+    identity at init, a later distillation pass can train it). Returns
+    (draft_layers, head_tree_or_None); the head is ENGINE-LOCAL state,
+    never part of the published weight tree."""
+    import jax
+
+    from ray_tpu.models import mlp
+
+    n = int(draft_layers) or max(1, cfg.n_layers // 2)
+    n = min(max(n, 1), cfg.n_layers)
+    head = None
+    if draft_head:
+        head = mlp.init_draft_head(
+            cfg.d_model, jax.random.PRNGKey(int(seed) + 1))
+    return n, head
+
+
 class LLMServer:
     """Deployable class (wrap with @serve.deployment or Deployment(...)).
 
@@ -89,7 +114,9 @@ class LLMServer:
                  prompt_buckets: tuple = (32, 64, 128, 256),
                  params_blob=None, prefix_cache_block: int = 0,
                  prefix_cache_mb: int = 256, engine_name: str = "",
-                 chunk_delay_s: float = 0.0, weights_version: int = 0):
+                 chunk_delay_s: float = 0.0, weights_version: int = 0,
+                 spec_depth: int = 0, spec_draft_layers: int = 0,
+                 spec_draft_head: bool = False):
         import os
 
         import jax
@@ -111,12 +138,17 @@ class LLMServer:
             prefix_cache = PrefixCache(
                 block=prefix_cache_block,
                 max_bytes=prefix_cache_mb * 2**20)
+        draft_layers, draft_head = build_spec_draft(
+            cfg, draft_layers=spec_draft_layers,
+            draft_head=spec_draft_head, seed=seed)
         self.engine = RaggedDecoder(
             params, cfg, slots=slots, max_len=max_len,
             chunk_tokens=chunk_tokens, prompt_buckets=prompt_buckets,
             prefix_cache=prefix_cache, chunk_delay_s=chunk_delay_s,
             name=engine_name or f"llm-{os.getpid()}",
-            weights_version=weights_version)
+            weights_version=weights_version,
+            spec_depth=spec_depth, spec_draft_layers=draft_layers,
+            spec_draft_head=draft_head)
         # (host params tree, version) staged by update_weights(); the
         # pump thread adopts it at the next chunk boundary — engine
         # params are touched only by the pump owner

@@ -240,7 +240,10 @@ class LLMPool:
                  prefix_cache_mb: int = 256,
                  max_inflight_per_replica: int | None = None,
                  autoscale: bool = True, chunk_delay_s: float = 0.0,
-                 tenant_weights: dict | None = None):
+                 tenant_weights: dict | None = None,
+                 spec_depth: int = 0, spec_draft_layers: int = 0,
+                 spec_draft_head: bool = False,
+                 max_resident_models: int = 3):
         import jax
         import numpy as np
 
@@ -252,7 +255,9 @@ class LLMPool:
             chunk_tokens=chunk_tokens, vocab_size=vocab_size, seed=seed,
             prompt_buckets=tuple(prompt_buckets),
             prefix_cache_block=prefix_cache_block,
-            prefix_cache_mb=prefix_cache_mb, chunk_delay_s=chunk_delay_s)
+            prefix_cache_mb=prefix_cache_mb, chunk_delay_s=chunk_delay_s,
+            spec_depth=spec_depth, spec_draft_layers=spec_draft_layers,
+            spec_draft_head=spec_draft_head)
         self.slots = slots
         self.min_replicas = max(1, min_replicas)
         self.max_replicas = max(self.min_replicas, max_replicas)
@@ -270,6 +275,24 @@ class LLMPool:
             lambda a: np.asarray(jax.device_get(a)), params)
         self._params_ref = ray_tpu.put(host_tree)
         del params, host_tree
+
+        # model multiplexing (serve/multiplex.py): register_model() adds
+        # swappable weight sets; requests routed with a model id
+        # (handle.options(multiplexed_model_id=...) or an explicit
+        # model_id argument) activate theirs pool-wide via the one-put
+        # publish_weights path. The registry holds host trees (the
+        # "on-disk" form); the multiplexed() LRU caches their
+        # object-store refs (the resident form) — evicting a model
+        # releases its blob, re-activating re-puts from the registry.
+        from ray_tpu.serve.multiplex import multiplexed
+
+        self._model_store: dict = {}
+        self._base_ref = self._params_ref  # model_id "" stays pinned
+        self._active_model = ""
+        self._mux_lock = threading.Lock()
+        self._resident_ref = multiplexed(
+            max_num_models_per_replica=max(1, max_resident_models)
+        )(self._put_model)
 
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -455,6 +478,68 @@ class LLMPool:
             return None
         return vals[min(len(vals) - 1, int(0.99 * len(vals)))]
 
+    # ---------- model multiplexing ----------
+
+    def register_model(self, model_id: str, params) -> None:
+        """Register a swappable weight set under ``model_id`` (the same
+        tree shape as the pool's model — llama.init_params). The host
+        tree is the registry's source of truth; activation puts it into
+        the object store (LRU-resident, `max_resident_models`) and
+        broadcasts it to every replica via publish_weights."""
+        import jax
+        import numpy as np
+
+        if not model_id:
+            raise ValueError("model_id must be non-empty")
+        host = jax.tree_util.tree_map(
+            lambda a: np.asarray(jax.device_get(a)), params)
+        with self._lock:
+            self._model_store[model_id] = host
+
+    def _put_model(self, model_id: str):
+        """LRU miss path (wrapped by multiplexed() in __init__): pin the
+        registered host tree into the object store."""
+        with self._lock:
+            host = self._model_store[model_id]
+        return ray_tpu.put(host)
+
+    def _ensure_model(self, model_id: str | None) -> None:
+        """Make the request's model the pool-wide active weights. The
+        id comes from the explicit argument, else the multiplex
+        contextvar (set by handle.options(multiplexed_model_id=...));
+        "" is the construction-time model. A switch swaps EVERY replica
+        at its next chunk boundary (publish_weights + wait_version) —
+        in-flight streams of the previous model finish under the mixed-
+        version contract weight publishing already defines (bounded
+        staleness, exact per-token logprobs), and the version bump makes
+        the failover splice guard truncate rather than splice across
+        models. Swaps serialize on _mux_lock: interleaved requests for
+        two models take turns (residency is the LRU's job; pacing the
+        thrash is the router's — the proxy hashes a model id to a
+        preferred pool, serve/api.py)."""
+        from ray_tpu.serve.multiplex import get_multiplexed_model_id
+
+        mid = (model_id if model_id is not None
+               else get_multiplexed_model_id()) or ""
+        if mid == self._active_model:
+            return
+        with self._mux_lock:
+            if mid == self._active_model:
+                return
+            if mid == "":
+                ref = self._base_ref
+            else:
+                with self._lock:
+                    known = mid in self._model_store
+                if not known:
+                    raise KeyError(
+                        f"model {mid!r} is not registered "
+                        f"(register_model first)")
+                ref = self._resident_ref(mid)
+            v = self.publish_weights(ref)
+            self.wait_version(v)
+            self._active_model = mid
+
     # ---------- request paths ----------
 
     def _assign_seed(self, temperature: float, seed) -> int:
@@ -510,7 +595,8 @@ class LLMPool:
 
     def generate(self, prompt_ids: list, max_tokens: int = 64, *,
                  temperature: float = 0.0, top_p: float = 1.0,
-                 seed: int | None = None, tenant: str = "-") -> dict:
+                 seed: int | None = None, tenant: str = "-",
+                 model_id: str | None = None) -> dict:
         """Blocking generate with transparent replica failover. The
         whole request runs under ONE trace id (joined from the ambient
         context when deployed as an actor, rooted fresh for direct
@@ -519,12 +605,14 @@ class LLMPool:
         with _trace.root_scope():
             return self._generate_traced(
                 prompt_ids, max_tokens, temperature=temperature,
-                top_p=top_p, seed=seed, tenant=tenant)
+                top_p=top_p, seed=seed, tenant=tenant,
+                model_id=model_id)
 
     def _generate_traced(self, prompt_ids: list, max_tokens: int = 64, *,
                          temperature: float = 0.0, top_p: float = 1.0,
-                         seed: int | None = None,
-                         tenant: str = "-") -> dict:
+                         seed: int | None = None, tenant: str = "-",
+                         model_id: str | None = None) -> dict:
+        self._ensure_model(model_id)
         prompt_ids = list(prompt_ids)
         max_tokens = int(max_tokens)
         tenant = str(tenant)
@@ -585,7 +673,8 @@ class LLMPool:
             temperature=float(req.get("temperature", 0.0)),
             top_p=float(req.get("top_p", 1.0)),
             seed=req.get("seed"),
-            tenant=str(req.get("tenant", "-")))
+            tenant=str(req.get("tenant", "-")),
+            model_id=req.get("model_id"))
 
     # ---------- streaming ----------
 
@@ -604,6 +693,7 @@ class LLMPool:
 
     def submit_stream(self, req: dict) -> dict:
         self._sweep_streams()
+        self._ensure_model(req.get("model_id"))
         prompt_ids = list(req["prompt_ids"])
         max_tokens = int(req.get("max_tokens", 64))
         temperature = float(req.get("temperature", 0.0))
@@ -1102,6 +1192,9 @@ class LLMPool:
             "prefill_workers": len(self._prefill),
             "prefix_cache_hit_rate": (hits / total) if total else None,
             "weights_version": self._weights_version,
+            "active_model": self._active_model,
+            "registered_models": sorted(self._model_store),
+            "resident_models": list(self._resident_ref._cache),
             "per_replica": per_replica,
         }
 

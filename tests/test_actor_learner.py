@@ -305,6 +305,7 @@ def test_weight_version_staleness_bounded(cluster):
         pool.shutdown()
 
 
+@pytest.mark.slow  # ~39s e2e; reward improvement covered by test_rl.py PPO corridor
 def test_e2e_improves_reward_deterministically(cluster):
     """THE acceptance run: frozen init → trained policy improves mean
     reward on the synthetic reward; sync mode makes the whole loop
@@ -336,6 +337,7 @@ def test_e2e_improves_reward_deterministically(cluster):
     assert a["rewards"] == b["rewards"], (a["rewards"], b["rewards"])
 
 
+@pytest.mark.slow  # ~40s chaos soak; faster kill coverage: pool replica-kill tests + test_soak_smoke
 def test_chaos_replica_and_learner_kill_recover_inplace(cluster):
     """Mid-run decode-replica kill AND learner-rank kill: the loop
     finishes with zero gang restarts (the learner death resumes
@@ -435,6 +437,7 @@ def _run_rl_seed(cluster, seed: int, deadline_s: float):
         _cfg.set_system_config({"fault_spec": ""})
 
 
+@pytest.mark.slow  # ~36s soak; tier-1 keeps off-by-one + staleness e2e above
 def test_rl_soak_smoke(cluster):
     """Tier-1: one fixed rl-profile seed (decode-replica death) under a
     hard deadline."""

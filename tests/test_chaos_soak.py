@@ -241,6 +241,36 @@ def test_fault_plan_is_deterministic():
         fi.clear()
 
 
+def test_spec_verify_site_only_via_explicit_override():
+    """ISSUE-19 satellite: serve.spec_verify is registered for targeted
+    speculation soaks but carries NO profile weight — existing
+    train/rl/qos/pipeline plans never draw it, so every fixed seed
+    recorded before the site existed expands byte-for-byte the same.
+    An explicit sites= override drafts it, pinned to one decode
+    replica, with actions from its own (all-recoverable) table."""
+    from ray_tpu._private.chaos import RL_SITE_ACTIONS, SERVE_SITES
+
+    assert "serve.spec_verify" in SERVE_SITES
+    for seed in range(60):
+        for profile in ("train", "rl", "qos", "pipeline"):
+            plan = gen_fault_plan(seed, world_size=WORLD,
+                                  profile=profile)
+            assert all(s["site"] != "serve.spec_verify"
+                       for s in plan.specs), (seed, profile)
+    allowed = {a for a, _ in RL_SITE_ACTIONS["serve.spec_verify"]}
+    assert allowed == {"drop", "stall", "delay"}  # never "die"
+    only = {"serve.spec_verify": 1.0}
+    a = gen_fault_plan(3, world_size=WORLD, profile="rl", sites=only)
+    assert a.specs
+    for s in a.specs:
+        assert s["site"] == "serve.spec_verify"
+        assert s["match"]["engine"].startswith("decode-")
+        assert s["action"] in allowed
+    # replay contract holds for the new site too
+    b = gen_fault_plan(3, world_size=WORLD, profile="rl", sites=only)
+    assert a.env_value() == b.env_value()
+
+
 def test_fault_plan_covers_site_space():
     """Across a modest seed range the generator must exercise every
     instrumented site and both fault localities."""

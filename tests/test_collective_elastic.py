@@ -139,6 +139,7 @@ def _train_steps_expect_abort(worker, group, rank, start, n, params):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # ~16s; two-slice resume e2e + abort-frame units keep tier-1 coverage
 def test_mid_op_kill_aborts_survivor_fast_then_reforms(cluster):
     """Acceptance: a rank killed mid-allreduce under fault injection
     makes the surviving rank raise CollectiveAbortError well under the

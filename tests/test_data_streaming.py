@@ -188,6 +188,7 @@ def test_limit_pushdown_skips_unneeded_sources(cluster):
     os.rmdir(d)
 
 
+@pytest.mark.slow  # ~10s; budget window + overlap + actor-pool tests keep tier-1 coverage
 def test_budgeted_pipeline_with_shuffle_and_actor_pool(cluster):
     """The round-4 capacity test: lazy sources -> fused map ->
     random_shuffle (push-based exchange) -> actor-pool map, ~3x the

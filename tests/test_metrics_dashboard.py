@@ -128,6 +128,32 @@ def test_dashboard_endpoints(cluster):
     assert status == 404
 
 
+def test_slo_speculation_acceptance_block(cluster):
+    """ISSUE-19 satellite: /api/slo aggregates the speculative-decode
+    counter pair into a per-engine acceptance block, so an operator can
+    see whether the draft model is earning its verify cost."""
+    from ray_tpu.dashboard import start_dashboard
+
+    Counter("decode_engine_spec_proposed_total",
+            tag_keys=("engine",)).inc(40, {"engine": "decode-9"})
+    Counter("decode_engine_spec_accepted_total",
+            tag_keys=("engine",)).inc(25, {"engine": "decode-9"})
+    flush_once()
+    addr = start_dashboard()
+    deadline = time.monotonic() + 15
+    spec = {}
+    while time.monotonic() < deadline:
+        status, body = _get(addr, "/api/slo")
+        assert status == 200
+        spec = json.loads(body).get("speculation", {})
+        if "decode-9" in spec:
+            break
+        time.sleep(0.2)
+    ent = spec["decode-9"]
+    assert ent["proposed"] >= 40 and ent["accepted"] >= 25
+    assert 0.0 < ent["acceptance_rate"] <= 1.0
+
+
 def test_dashboard_stacks(cluster):
     from ray_tpu.dashboard import start_dashboard
 

@@ -101,6 +101,7 @@ def test_expert_flops_scale_down():
     assert fc < 0.75 * fd, f"capacity flops {fc} vs dense {fd}"
 
 
+@pytest.mark.slow  # ~16s train loop; capacity/drop/flops units above are tier-1
 def test_moe_tiny_trains():
     """moe-tiny end-to-end: loss decreases with the capacity impl and
     tracks the dense impl's trajectory."""

@@ -75,6 +75,7 @@ def test_placement_group_pack(cluster3):
     ray_tpu.remove_placement_group(pg)
 
 
+@pytest.mark.slow  # ~16s; node-death task-retry/queued-fail/chaos-kill tests keep tier-1 coverage
 def test_node_death_actor_restarts_elsewhere(cluster3):
     # 1-CPU actors on 2-CPU nodes: after a node dies, the survivors still
     # have spare capacity so the restart is actually placeable.

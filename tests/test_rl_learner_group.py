@@ -123,6 +123,7 @@ def test_scaling_2_and_4_learners(cluster):  # cover the update path
     assert times[4] < 90.0, times  # absolute sanity: no hang/collapse
 
 
+@pytest.mark.slow  # ~19s; gradient-parity + replica-identity tests above are tier-1
 def test_ppo_with_learner_group(cluster):
     """PPO end-to-end with num_learners=2 learns CartPole-ish dynamics
     (the same toy env the single-learner PPO test uses)."""

@@ -71,6 +71,7 @@ def test_package_cache_gc_evicts_plugin_uris(tmp_path):
     assert alive == uris[-re_mod.IDLE_CACHE_KEEP:]
 
 
+@pytest.mark.slow  # ~10s venv build; uri/cache/failure-path tests keep tier-1 coverage
 def test_pip_env_task_runs_package_driver_lacks(tmp_path):
     with pytest.raises(ImportError):
         import graftpkg  # noqa: F401 — the driver must NOT have it

@@ -348,6 +348,97 @@ def test_pool_chaos_replica_kill_no_client_visible_error(cluster):
         pool.shutdown()
 
 
+def _sampled_ref(params, prompt, n, *, temperature, seed):
+    """Reference sampled decode on a plain (non-speculative) engine —
+    the sequence any replica must reproduce for this (prompt, seed)."""
+    eng = RaggedDecoder(params, TINY, slots=2, max_len=96,
+                        chunk_tokens=4, prompt_buckets=(8,))
+    sid = eng.submit(np.asarray(prompt, np.int32), n,
+                     temperature=temperature, seed=seed)
+    eng.drain()
+    return np.asarray(eng.pop_finished(sid).tokens[:n])
+
+
+def test_pool_replica_kill_failover_spec_sampled_exact(cluster):
+    """ISSUE-19 acceptance: kill a decode replica mid-stream with
+    speculative decoding ON and sampling ON; the re-queued stream must
+    reproduce the EXACT token sequence of a plain non-speculative
+    engine — acceptance is judged against the target's own
+    (seed, position) RNG-lane token, so seed-replay is exact no matter
+    how many draft tokens each pump accepted before or after the
+    kill."""
+    pool = LLMPool(model_size="tiny", slots=2, max_len=96,
+                   chunk_tokens=2, prompt_buckets=(8,),
+                   min_replicas=2, max_replicas=2, autoscale=False,
+                   chunk_delay_s=0.02,
+                   spec_depth=4, spec_draft_layers=1)
+    try:
+        params = llama.init_params(TINY, jax.random.PRNGKey(0))
+        rng = np.random.RandomState(7)
+        p = rng.randint(1, 256, size=6).astype(np.int32)
+        ref = _sampled_ref(params, p, 32, temperature=0.8, seed=12345)
+        rid = pool.submit_stream(
+            {"prompt_ids": p.tolist(), "max_tokens": 32,
+             "temperature": 0.8, "seed": 12345})["rid"]
+        toks = []
+        while len(toks) < 6:
+            out = pool.poll_stream(rid)
+            toks.extend(out["tokens"])
+            assert not out["done"]
+            time.sleep(0.01)
+        ray_tpu.kill(pool._streams[rid]["rep"].handle)  # mid-stream
+        t0 = time.time()
+        while time.time() - t0 < 120:
+            out = pool.poll_stream(rid)
+            toks.extend(out["tokens"])
+            if out["done"]:
+                break
+            time.sleep(0.01)
+        np.testing.assert_array_equal(np.asarray(toks), ref)
+        # speculation actually ran on the decoding replicas
+        st = pool.stats()
+        specs = [s.get("spec") for s in st["per_replica"].values()
+                 if isinstance(s, dict)]
+        assert any(sp and sp["pumps"] > 0 for sp in specs)
+    finally:
+        pool.shutdown()
+
+
+def test_pool_multiplex_routes_by_model_id(cluster):
+    """Model multiplexing (serve/multiplex.py wired to real weight
+    swaps): requests routed by model_id decode under THAT model's
+    weights — each compared against its own reference greedy decode —
+    with the construction model addressable as "" and unregistered ids
+    rejected.  The LRU keeps swapped-in models resident as object-store
+    refs."""
+    pool = LLMPool(model_size="tiny", slots=2, max_len=96,
+                   chunk_tokens=4, prompt_buckets=(8,),
+                   min_replicas=1, max_replicas=1, autoscale=False)
+    try:
+        base = llama.init_params(TINY, jax.random.PRNGKey(0))
+        alt = llama.init_params(TINY, jax.random.PRNGKey(42))
+        pool.register_model("alt", alt)
+        rng = np.random.RandomState(8)
+        p = rng.randint(1, 256, size=6).astype(np.int32)
+        a1 = pool.generate(p.tolist(), 12)
+        np.testing.assert_array_equal(a1["tokens"],
+                                      _greedy(base, p, 12))
+        b = pool.generate(p.tolist(), 12, model_id="alt")
+        np.testing.assert_array_equal(b["tokens"], _greedy(alt, p, 12))
+        assert not np.array_equal(b["tokens"], a1["tokens"])
+        # back to the construction model by its reserved id
+        a2 = pool.generate(p.tolist(), 12, model_id="")
+        np.testing.assert_array_equal(a2["tokens"], a1["tokens"])
+        st = pool.stats()
+        assert st["active_model"] == ""
+        assert st["registered_models"] == ["alt"]
+        assert "alt" in st["resident_models"]
+        with pytest.raises(KeyError):
+            pool.generate(p.tolist(), 4, model_id="nope")
+    finally:
+        pool.shutdown()
+
+
 def test_pool_autoscales_up_and_drains_down(cluster):
     """Queue pressure scales the pool toward max_replicas via the
     demand hook; idleness drains it back to min (draining replicas get

@@ -18,6 +18,7 @@ def cluster():
     c.shutdown()
 
 
+@pytest.mark.slow  # ~15s; test_sklearn_fit_from_dataset below keeps tier-1 coverage
 def test_sklearn_fit_and_batch_predict(cluster):
     from sklearn.linear_model import LogisticRegression
 

@@ -90,6 +90,7 @@ def test_pbt_exploit_decision_and_explore():
 
 # ---------------- end-to-end PBT ----------------
 
+@pytest.mark.slow  # ~7s e2e; PBT exploit/explore decision units above are tier-1
 def test_pbt_end_to_end_transfers_good_config(cluster):
     """Trainables descend toward loss=|lr-0.1|; bad-lr trials must adopt
     (a mutation of) the good trial's lr via exploit+checkpoint."""
