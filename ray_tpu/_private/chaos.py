@@ -71,7 +71,7 @@ SITE_ACTIONS: dict[str, list[tuple[str, float]]] = {
 # sites that fire in the driver/agent process rather than a train worker
 DRIVER_SITES = frozenset(
     {"agent.heartbeat", "object.read_chunk", "worker.lease_push",
-     "rl.rollout", "net.pace"})
+     "rl.rollout", "net.pace", "overload.shed"})
 
 # ---- the serving-pool / RL-loop fault surface (profile="rl") ----
 #
@@ -157,6 +157,31 @@ PIPELINE_SITE_ACTIONS: dict[str, list[tuple[str, float]]] = {
                        ("stall", 1.0)],
 }
 
+# ---- the train+serve colocation fault surface (profile="colocate") ----
+#
+# The ROADMAP-item-1 scenario: a DCN training gang (collective), a
+# multi-tenant serving pool (kv), and checkpoint shipping (bulk) on the
+# SAME agents. The sweep hits every traffic class's hot path at once —
+# pacer grants, decode pumps, ring chunks, checkpoint members — plus
+# ``overload.shed``, which trips at the moment the overload guardian
+# refuses an admission: ``drop`` suppresses the shed (the request is
+# admitted anyway, exercising the queue-bound backstop), ``delay``
+# lengthens the refusal path. The colocation soak asserts BOTH SLO
+# floors hold simultaneously, bulk completes, and the gang never
+# cold-restarts.
+COLOCATE_SITE_WEIGHTS: dict[str, float] = {
+    "net.pace": 2.0,             # pacer grant drop/delay under 3-class load
+    "serve.replica_pump": 1.5,   # decode replica death with a gang running
+    "ring.send": 2.0,            # gang rank death while tenants queue
+    "checkpoint.save": 1.0,      # torn bulk write mid-squeeze
+    "object.read_chunk": 0.75,   # paced bulk chunk refusal
+    "overload.shed": 1.0,        # guardian refusal suppressed/delayed
+}
+
+COLOCATE_SITE_ACTIONS: dict[str, list[tuple[str, float]]] = {
+    "overload.shed": [("drop", 2.0), ("delay", 1.0)],
+}
+
 
 @dataclass
 class FaultPlan:
@@ -225,8 +250,14 @@ def gen_fault_plan(seed: int, *, world_size: int = 2,
     (PIPELINE_SITE_WEIGHTS): stage-boundary p2p kills and stalls
     (``pipeline.stage``, rank-pinned against the pipeline p2p group's
     world — pass the TOTAL stage-worker count as ``world_size``), plus
-    the dp-allreduce ring and per-stage checkpoint sites. Profile
-    selection happens before any rng draw, so train/rl/qos plans stay
+    the dp-allreduce ring and per-stage checkpoint sites.
+
+    ``profile="colocate"`` sweeps the train+serve colocation surface
+    (COLOCATE_SITE_WEIGHTS): pacer grants, decode-pump deaths, gang
+    ring kills, torn checkpoint members, and guardian-shed suppression
+    (``overload.shed``) — the sites a shared cluster exercises when all
+    three traffic classes contend at once. Profile selection happens
+    before any rng draw, so train/rl/qos/pipeline plans stay
     byte-identical across seeds.
     """
     rng = random.Random(seed)
@@ -243,6 +274,10 @@ def gen_fault_plan(seed: int, *, world_size: int = 2,
     elif profile == "pipeline":
         default_weights = dict(PIPELINE_SITE_WEIGHTS)
         actions = {**SITE_ACTIONS, **PIPELINE_SITE_ACTIONS}
+    elif profile == "colocate":
+        default_weights = dict(COLOCATE_SITE_WEIGHTS)
+        actions = {**SITE_ACTIONS, **RL_SITE_ACTIONS, **QOS_SITE_ACTIONS,
+                   **COLOCATE_SITE_ACTIONS}
     elif profile == "train":
         default_weights = SITE_WEIGHTS
         actions = SITE_ACTIONS

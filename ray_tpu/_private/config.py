@@ -238,6 +238,38 @@ _DEFS: dict[str, Any] = {
     # how many arrive per dispatch), so flipping mid-stream is safe.
     "serve_spec_enabled": True,
     "serve_spec_depth": 0,
+    # -- overload guardian (serve/overload.py) --
+    # master switch: an LLMPool instantiates a per-pool brownout
+    # controller that walks the L0-L3 degradation ladder off the pool's
+    # own pressure signals (admission queue, TTFT p99, decode rate,
+    # link saturation)
+    "overload_enabled": True,
+    # escalation watermark: queued admissions per live replica above
+    # this reads as overload pressure
+    "overload_queue_per_replica_high": 8.0,
+    # recovery watermarks sit at this fraction of the escalation ones —
+    # the hysteresis band between them is where the ladder holds still
+    "overload_recovery_fraction": 0.5,
+    # pressure must persist this long before the ladder climbs one level
+    "overload_escalate_dwell_s": 1.0,
+    # calm must persist this long before the ladder descends one level
+    # (recovery re-climbs one level per dwell — never straight to L0)
+    "overload_recover_dwell_s": 3.0,
+    # L2 squeeze: the bulk share net_qos enforces while degraded
+    # (restored to the prior value on recovery)
+    "overload_bulk_share_squeezed": 0.05,
+    # L2 squeeze: checkpoint ship defers up to this long while the
+    # ladder sits at L2+ (then proceeds — freshness beats deferral)
+    "overload_ship_defer_max_s": 15.0,
+    # L3 shed: hard bound on admission-queue depth; every new request
+    # beyond it is refused typed-retryable. Lowest-WFQ-weight tenants
+    # shed earlier, at half this bound.
+    "overload_shed_queue_bound": 64,
+    # floor for the retry-after hint carried by PoolOverloadedError
+    "overload_retry_after_min_s": 0.5,
+    # link-saturation pressure threshold: the hottest peer's observed
+    # bytes/s over the configured net_qos rate (0 rate = signal off)
+    "overload_link_saturation": 0.9,
 }
 
 _cache: dict[str, Any] = {}

@@ -107,6 +107,26 @@ def link_tx_by_peer(rows: list[dict]) -> dict[str, float]:
     return out
 
 
+def link_utilization(prev: dict[str, float], cur: dict[str, float],
+                     dt_s: float,
+                     capacity_bytes_per_s: float) -> float:
+    """Hottest-link utilization from two ``link_tx_by_peer`` samples a
+    window apart: max per-peer (bytes moved / dt) over the per-peer
+    capacity. The overload guardian's saturation signal — the same
+    tick-over-tick sampling `get_nodes_to_launch` callers use to turn
+    cumulative byte totals into a rate. Returns 0.0 with no capacity
+    configured or a degenerate window; counters that reset between
+    samples (process restart) read as 0 for that peer, not negative."""
+    if capacity_bytes_per_s <= 0 or dt_s <= 1e-9:
+        return 0.0
+    hottest = 0.0
+    for peer, now_total in (cur or {}).items():
+        moved = now_total - (prev or {}).get(peer, 0.0)
+        if moved > 0:
+            hottest = max(hottest, moved / dt_s)
+    return hottest / capacity_bytes_per_s
+
+
 def ring_order(labels: list[str],
                link_tx_bytes_per_s: dict[str, float] | None) -> list[int]:
     """Ring rank placement off the same per-link signal replica

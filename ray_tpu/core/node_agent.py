@@ -273,6 +273,11 @@ class NodeAgent:
         ))
         self._spilling = False
         self._bg: list[asyncio.Task] = []
+        # SIGKILL-escalation tasks spawned by _kill_worker. Tracked so
+        # stop() can cancel+await them — a fire-and-forget coro still
+        # pending at loop teardown logs "Task was destroyed but it is
+        # pending!" and skips the kill.
+        self._escalations: set[asyncio.Task] = set()
         # Native (C++) hybrid placement core; None falls back to the pure-
         # Python policy in _choose_node (e.g. no g++ on the host).
         self._native_sched = None
@@ -329,6 +334,14 @@ class NodeAgent:
             t.cancel()
         for w in list(self.workers.values()):
             self._kill_worker(w)
+        # settle escalation tasks before the loop dies: cancelling runs
+        # each one's ``finally`` (immediate SIGKILL for stragglers) and
+        # keeps teardown free of destroyed-pending-task warnings
+        if self._escalations:
+            pending = list(self._escalations)
+            for t in pending:
+                t.cancel()
+            await asyncio.gather(*pending, return_exceptions=True)
         if self.head is not None:
             await self.head.close()
         for c in self._peer_clients.values():
@@ -1011,13 +1024,24 @@ class NodeAgent:
             w.proc.terminate()
 
             async def _escalate(proc=w.proc):
-                # don't block the event loop on proc.wait; SIGKILL after grace
-                await asyncio.sleep(2)
-                if proc.poll() is None:
-                    proc.kill()
+                # don't block the event loop on proc.wait; SIGKILL after
+                # grace. Poll in small steps so cancellation (agent
+                # shutdown) lands promptly, and kill in ``finally`` so a
+                # cancelled escalation still never leaks the process.
+                try:
+                    deadline = time.monotonic() + 2.0
+                    while time.monotonic() < deadline:
+                        if proc.poll() is not None:
+                            return
+                        await asyncio.sleep(0.05)
+                finally:
+                    if proc.poll() is None:
+                        proc.kill()
 
             try:
-                asyncio.ensure_future(_escalate())
+                task = asyncio.ensure_future(_escalate())
+                self._escalations.add(task)
+                task.add_done_callback(self._escalations.discard)
             except RuntimeError:  # no running loop (shutdown path)
                 try:
                     w.proc.wait(timeout=2)

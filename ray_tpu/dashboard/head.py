@@ -238,9 +238,27 @@ class DashboardHead:
             ent["acceptance_rate"] = round(
                 ent["accepted"] / ent["proposed"], 4) \
                 if ent["proposed"] else 0.0
+        # overload-guardian posture: current ladder level plus shed /
+        # deadline-fast-fail tallies, so an operator can tell "tenant B
+        # is seeing retryable 'overloaded' errors" apart from "the pool
+        # is broken" at a glance
+        degradation: dict = {"level": 0, "shed": {}, "deadline_failfast": 0.0}
+        for r in rows:
+            if r["name"] == "pool_degradation_level":
+                degradation["level"] = max(
+                    degradation["level"], int(r["value"]))
+            elif r["name"] == "pool_shed_total":
+                tags = dict(tuple(t) for t in r["tags"])
+                key = (f"{tags.get('tenant', '-') or '-'}"
+                       f"/{tags.get('reason', '?') or '?'}")
+                degradation["shed"][key] = \
+                    degradation["shed"].get(key, 0.0) + r["value"]
+            elif r["name"] == "pool_deadline_failfast_total":
+                degradation["deadline_failfast"] += r["value"]
         return {"ttft": ttft.get("", {}), "tbt": tbt.get("", {}),
                 "per_tenant": per_tenant, "speculation": spec,
-                "train_step": step, "straggler": straggler}
+                "train_step": step, "straggler": straggler,
+                "degradation": degradation}
 
     def _agent_call(self, node: dict, method: str, payload: dict,
                     timeout: float = 10.0):

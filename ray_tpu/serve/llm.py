@@ -487,6 +487,19 @@ class LLMServer:
     def weights_version(self) -> int:
         return self.engine.weights_version
 
+    def apply_config(self, config: dict) -> dict:
+        """Apply live config overrides in THIS replica's process — the
+        pool-wide flip path for knobs the engine reads per pump
+        (``serve_spec_enabled`` / ``serve_spec_depth`` /
+        ``net_qos_bulk_share``). A driver-side ``set_system_config``
+        only reaches processes spawned afterwards; the overload
+        guardian broadcasts degradation flips here so a RUNNING pool
+        sheds speculation within one chunk. Returns the applied dict."""
+        from ray_tpu._private import config as _cfg
+
+        _cfg.set_system_config(dict(config))
+        return {k: _cfg.get(k) for k in config}
+
     def __call__(self, req: dict) -> dict:
         """HTTP entrypoint (serve http_proxy: POST body -> __call__):
         {"prompt_ids": [...], "max_tokens": N} -> generate()."""

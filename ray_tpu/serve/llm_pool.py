@@ -42,9 +42,18 @@ import threading
 import time
 
 import ray_tpu
+from ray_tpu._private import config as _cfg
+from ray_tpu._private import fault_injection as _fi
 from ray_tpu._private import flight_recorder as _fr
 from ray_tpu._private import trace as _trace
 from ray_tpu.serve.llm import LLMServer, build_model
+from ray_tpu.serve.overload import (
+    L3_SHED_ADMISSION,
+    DeadlineExceededError,
+    OverloadGuardian,
+    PoolOverloadedError,
+    get_overload_metrics,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -243,7 +252,8 @@ class LLMPool:
                  tenant_weights: dict | None = None,
                  spec_depth: int = 0, spec_draft_layers: int = 0,
                  spec_draft_head: bool = False,
-                 max_resident_models: int = 3):
+                 max_resident_models: int = 3,
+                 overload_guardian: bool | None = None):
         import jax
         import numpy as np
 
@@ -269,8 +279,8 @@ class LLMPool:
 
         # ONE weight build + ONE object-store put; every pool member
         # adopts the ref (multi-source pull on later replicas)
-        params, _cfg = build_model(model_size, max_len=max_len,
-                                   vocab_size=vocab_size, seed=seed)
+        params, _mcfg = build_model(model_size, max_len=max_len,
+                                    vocab_size=vocab_size, seed=seed)
         host_tree = jax.tree_util.tree_map(
             lambda a: np.asarray(jax.device_get(a)), params)
         self._params_ref = ray_tpu.put(host_tree)
@@ -317,6 +327,16 @@ class LLMPool:
         # build; publish_weights bumps it and rebroadcasts
         self._weights_version = 0
         self._next_seed = 0
+        # overload-guardian signal state: recent admission stamps (the
+        # observed service rate the deadline predictor divides queue
+        # depth by) and a decode-token window (the tokens/s signal)
+        self._admits: collections.deque = collections.deque(maxlen=256)
+        self._token_window: collections.deque = collections.deque()
+        self.TOKEN_WINDOW_S = 10.0
+        guardian_on = (bool(_cfg.get("overload_enabled"))
+                       if overload_guardian is None
+                       else bool(overload_guardian))
+        self._guardian = OverloadGuardian(self) if guardian_on else None
 
         for _ in range(self.min_replicas):
             self._replicas.append(self._spawn_replica())
@@ -399,14 +419,92 @@ class LLMPool:
         ts = self._tenants[pick]
         return pick == tenant and ts["queue"][0] is ticket
 
-    def _acquire(self, tenant: str = "-") -> _Replica:
+    def _admit_rate_locked(self, now: float) -> float | None:
+        """Observed admission service rate (admissions/s over the recent
+        window), under the lock. None until enough samples exist — a
+        cold pool never fast-fails on a guessed rate."""
+        cut = now - self.TTFT_WINDOW_S
+        stamps = [t for t in self._admits if t >= cut]
+        if len(stamps) < 2 or now - stamps[0] <= 1e-6:
+            return None
+        return len(stamps) / (now - stamps[0])
+
+    def _admission_shed(self, tenant: str,
+                        deadline_abs: float | None):
+        """Pre-admission gate: deadline fast-fail (predicted TTFT =
+        queue depth x observed service time already over the deadline)
+        and, at ladder level L3, queue-bounded shedding — lowest-WFQ-
+        weight tenants shed first (their bound scales down with their
+        weight share), every tenant sheds at the hard bound. Returns
+        ``None`` (admit) or ``(reason, retry_after_s, exc_class)``."""
+        now = time.monotonic()
+        with self._lock:
+            waiting = self._waiting
+            rate = self._admit_rate_locked(now)
+        predicted = (waiting + 1) / rate if rate else None
+        if (deadline_abs is not None and predicted is not None
+                and now + predicted > deadline_abs):
+            return ("deadline", predicted, DeadlineExceededError)
+        g = self._guardian
+        if g is None or g.level < L3_SHED_ADMISSION:
+            return None
+        bound = max(1, int(_cfg.get("overload_shed_queue_bound")))
+        w = float(self._tenant_weights.get(tenant, 1.0))
+        wmax = max([float(v) for v in self._tenant_weights.values()]
+                   + [w, 1.0])
+        # weight-proportional bound: the lowest-weight tenant sheds
+        # from ~bound/4, the highest-weight tenant only at the hard
+        # bound — "shed lowest-WFQ-weight tenants first"
+        thresh = bound * (0.25 + 0.75 * (w / wmax))
+        if waiting + 1 <= thresh:
+            return None
+        retry = max(float(_cfg.get("overload_retry_after_min_s")),
+                    predicted if predicted is not None else 1.0)
+        reason = ("queue_bound" if waiting + 1 > bound
+                  else "low_weight")
+        return (reason, retry, PoolOverloadedError)
+
+    def _shed(self, tenant: str, reason: str, retry_after: float,
+              exc_class) -> None:
+        """Refuse one admission, typed: chaos site first (``drop``
+        suppresses the shed — the request is admitted anyway), then
+        counters, then the retryable error."""
+        g = self._guardian
+        level = g.level if g is not None else 0
+        act = _fi.fire("overload.shed", tenant=tenant, reason=reason,
+                       level=level)
+        if act == "drop":
+            return  # injected: skip the shed, admit anyway
+        try:
+            m = get_overload_metrics()
+            if exc_class is DeadlineExceededError:
+                m["deadline"].inc()
+            m["shed"].inc(tags={"tenant": tenant, "reason": reason})
+        except Exception:  # noqa: BLE001 — metrics best-effort
+            pass
+        raise exc_class(tenant, reason, retry_after, level=level)
+
+    def _acquire(self, tenant: str = "-",
+                 deadline_abs: float | None = None,
+                 first: bool = True) -> _Replica:
         """Block until some live, non-draining replica has an in-flight
         slot AND it is this tenant's weighted-fair turn. The count of
         blocked handler threads IS the shared admission queue — its
         depth feeds the autoscaler. A hot tenant flooding submissions
         only queues behind ITSELF: each admission advances its virtual
         clock by 1/weight, so other tenants' requests keep interleaving
-        at their weighted share regardless of queue depth."""
+        at their weighted share regardless of queue depth.
+
+        ``deadline_abs`` (monotonic) is the request's client deadline:
+        unmeetable-at-admission requests fast-fail typed before queuing
+        and queued requests are reaped the moment they expire — neither
+        burns a decode slot. ``first=False`` marks a failover re-acquire
+        of already-admitted work: it is never shed (the no-client-
+        visible-error failover contract outranks the ladder)."""
+        if first:
+            shed = self._admission_shed(tenant, deadline_abs)
+            if shed is not None:
+                self._shed(tenant, *shed)
         deadline = time.monotonic() + self.ACQUIRE_TIMEOUT_S
         ticket = object()
         with self._cond:
@@ -429,11 +527,29 @@ class LLMPool:
                         ts["queue"].popleft()  # == ticket
                         ts["vtime"] += 1.0 / max(1e-6, ts["weight"])
                         self._vclock = max(self._vclock, ts["vtime"])
+                        self._admits.append(time.monotonic())
                         self._cond.notify_all()  # next tenant's turn
                         return rep
-                    if not self._cond.wait(
-                            timeout=max(0.0,
-                                        deadline - time.monotonic())):
+                    now = time.monotonic()
+                    if deadline_abs is not None and now >= deadline_abs:
+                        # expired in the queue: reap it typed (the
+                        # finally block removes the ticket)
+                        try:
+                            get_overload_metrics()["deadline"].inc()
+                        except Exception:  # noqa: BLE001
+                            pass
+                        rate = self._admit_rate_locked(now)
+                        hint = ((self._waiting / rate) if rate
+                                else float(_cfg.get(
+                                    "overload_retry_after_min_s")))
+                        raise DeadlineExceededError(
+                            tenant, "deadline_expired", hint,
+                            level=(self._guardian.level
+                                   if self._guardian else 0))
+                    wait_until = deadline if deadline_abs is None \
+                        else min(deadline, deadline_abs)
+                    self._cond.wait(timeout=max(0.0, wait_until - now))
+                    if time.monotonic() >= deadline:
                         raise TimeoutError(
                             f"no decode replica admitted the request "
                             f"within {self.ACQUIRE_TIMEOUT_S}s "
@@ -477,6 +593,26 @@ class LLMPool:
         if not vals:
             return None
         return vals[min(len(vals) - 1, int(0.99 * len(vals)))]
+
+    def _note_tokens(self, n: int) -> None:
+        """Fold delivered tokens into the decode-rate window (the
+        guardian's tokens/s signal)."""
+        if n <= 0:
+            return
+        now = time.monotonic()
+        with self._lock:
+            self._token_window.append((now, n))
+            cut = now - self.TOKEN_WINDOW_S
+            while self._token_window and self._token_window[0][0] < cut:
+                self._token_window.popleft()
+
+    def tokens_per_s(self) -> float:
+        """Pool-wide delivered tokens/s over the recent window."""
+        now = time.monotonic()
+        with self._lock:
+            cut = now - self.TOKEN_WINDOW_S
+            total = sum(n for t, n in self._token_window if t >= cut)
+        return total / self.TOKEN_WINDOW_S
 
     # ---------- model multiplexing ----------
 
@@ -596,22 +732,30 @@ class LLMPool:
     def generate(self, prompt_ids: list, max_tokens: int = 64, *,
                  temperature: float = 0.0, top_p: float = 1.0,
                  seed: int | None = None, tenant: str = "-",
-                 model_id: str | None = None) -> dict:
+                 model_id: str | None = None,
+                 deadline_s: float | None = None) -> dict:
         """Blocking generate with transparent replica failover. The
         whole request runs under ONE trace id (joined from the ambient
         context when deployed as an actor, rooted fresh for direct
         use), so the prefill worker's and decode replica's spans
-        decompose this request's TTFT in the timeline."""
+        decompose this request's TTFT in the timeline.
+
+        ``deadline_s`` is the client's TTFT budget from submission: a
+        request whose predicted queue wait already exceeds it fast-
+        fails typed (:class:`DeadlineExceededError`, retryable) at
+        admission, and one that expires while queued is reaped —
+        neither burns a decode slot."""
         with _trace.root_scope():
             return self._generate_traced(
                 prompt_ids, max_tokens, temperature=temperature,
                 top_p=top_p, seed=seed, tenant=tenant,
-                model_id=model_id)
+                model_id=model_id, deadline_s=deadline_s)
 
     def _generate_traced(self, prompt_ids: list, max_tokens: int = 64, *,
                          temperature: float = 0.0, top_p: float = 1.0,
                          seed: int | None = None, tenant: str = "-",
-                         model_id: str | None = None) -> dict:
+                         model_id: str | None = None,
+                         deadline_s: float | None = None) -> dict:
         self._ensure_model(model_id)
         prompt_ids = list(prompt_ids)
         max_tokens = int(max_tokens)
@@ -622,8 +766,11 @@ class LLMPool:
         kv_ref = self._maybe_prefill(prompt_ids, sampling, tenant)
         last_err: Exception | None = None
         t_enqueue = time.monotonic()
-        for _ in range(self.max_replicas + 2):
-            rep = self._acquire(tenant)
+        deadline_abs = (t_enqueue + float(deadline_s)
+                        if deadline_s is not None else None)
+        for attempt in range(self.max_replicas + 2):
+            rep = self._acquire(tenant, deadline_abs,
+                                first=(attempt == 0))
             t_admitted = time.monotonic()
             queue_wait = t_admitted - t_enqueue
             _fr.record("serve", "serve.admission_wait", t_enqueue,
@@ -642,6 +789,7 @@ class LLMPool:
                         **sampling)
                 out = ray_tpu.get(ref, timeout=600)
                 self._record_ttft(out, queue_wait, tenant)
+                self._note_tokens(len(out.get("tokens", [])))
                 return out
             except ray_tpu.RayActorError as e:
                 last_err = e
@@ -668,13 +816,15 @@ class LLMPool:
             f"request failed over too many dead replicas: {last_err}")
 
     def __call__(self, req: dict) -> dict:
+        dl = req.get("deadline_s")
         return self.generate(
             list(req["prompt_ids"]), int(req.get("max_tokens", 64)),
             temperature=float(req.get("temperature", 0.0)),
             top_p=float(req.get("top_p", 1.0)),
             seed=req.get("seed"),
             tenant=str(req.get("tenant", "-")),
-            model_id=req.get("model_id"))
+            model_id=req.get("model_id"),
+            deadline_s=float(dl) if dl is not None else None)
 
     # ---------- streaming ----------
 
@@ -711,11 +861,14 @@ class LLMPool:
         # pinned on the record rather than read from the contextvar)
         tr = _trace.current() or (_trace.new_trace_id(),
                                   _trace.new_span_id())
+        dl = req.get("deadline_s")
         rec = {"prompt_ids": prompt_ids, "max_tokens": max_tokens,
                "emitted": 0, "rep": None, "sid": None, "done": False,
                "last_poll": time.monotonic(), "sampling": sampling,
                "version": self._weights_version, "trace": tr,
-               "tenant": tenant}
+               "tenant": tenant,
+               "deadline_abs": (time.monotonic() + float(dl)
+                                if dl is not None else None)}
         with _trace.scope(*tr):
             rec["kv_ref"] = self._maybe_prefill(prompt_ids, sampling,
                                                 tenant)
@@ -737,7 +890,11 @@ class LLMPool:
     def _assign_stream_traced(self, rec: dict):
         t_enqueue = time.monotonic()
         tenant = rec.get("tenant", "-")
-        rep = self._acquire(tenant)
+        # only the FIRST assignment is an admission the ladder may
+        # shed; failover re-assignments carry already-admitted work
+        rep = self._acquire(tenant, rec.get("deadline_abs"),
+                            first=not rec.get("was_assigned"))
+        rec["was_assigned"] = True
         _fr.record("serve", "serve.admission_wait", t_enqueue,
                    time.monotonic(), attrs={"replica": rep.name,
                                             "tenant": tenant,
@@ -909,6 +1066,7 @@ class LLMPool:
             rec["replayed"] = rec.get("replayed", 0) + skip
         fresh = new[skip:]
         fresh_lps = lps[skip:] if lps else []
+        self._note_tokens(len(fresh))
         rec["emitted"] += len(fresh)
         rec["replayed"] = rec.get("replayed", 0) + len(fresh)
         if fresh or out["done"]:
@@ -1090,6 +1248,11 @@ class LLMPool:
                 m["ttft_p99"].set(ttft)
         except Exception:  # noqa: BLE001
             pass
+        if self._guardian is not None:
+            # the brownout ladder rides the same cadence as scaling:
+            # degradation buys time while new replicas spin up, and
+            # recovery follows the same observed signals back down
+            self._guardian.tick()
         if desired > n:
             if (time.monotonic() - self._last_scale_up
                     < self.SCALE_UP_COOLDOWN_S):
@@ -1196,6 +1359,9 @@ class LLMPool:
             "registered_models": sorted(self._model_store),
             "resident_models": list(self._resident_ref._cache),
             "per_replica": per_replica,
+            "tokens_per_s_window": round(self.tokens_per_s(), 1),
+            "overload": (self._guardian.state()
+                         if self._guardian is not None else None),
         }
 
     def health(self) -> bool:

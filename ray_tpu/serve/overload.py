@@ -1,0 +1,452 @@
+"""Per-pool overload guardian: graceful degradation under colocation.
+
+When a serving pool shares its cluster with a training gang and bulk
+transfers (the ROADMAP's colocation scenario), demand can exceed
+capacity faster than the autoscaler can add replicas — and without an
+active response every tenant's TTFT collapses together. This module is
+the brownout controller: it watches the signals the system already
+exports and walks a hysteretic degradation ladder, shedding the
+cheapest work first:
+
+- **L0 (healthy)** — nothing engaged.
+- **L1 (shed speculation)** — flip ``serve_spec_enabled`` off pool-wide
+  (driver config + an ``apply_config`` RPC to every replica process).
+  Speculation spends extra decode FLOPs to lower latency when slots are
+  idle; under overload those FLOPs starve the batch.
+- **L2 (squeeze bulk)** — tighten ``net_qos_bulk_share`` to the
+  configured squeezed share and defer checkpoint shipping (bounded by
+  ``overload_ship_defer_max_s``). Bulk is the only traffic class with
+  no latency SLO.
+- **L3 (shed admission)** — bound the admission queue and refuse new
+  requests with the typed, RETRYABLE :class:`PoolOverloadedError`
+  carrying a retry-after hint. Lowest-WFQ-weight tenants shed first
+  (at half the queue bound); every tenant sheds at the hard bound.
+
+Escalation requires pressure to persist for ``overload_escalate_dwell_s``
+and recovery requires calm for ``overload_recover_dwell_s`` — one level
+per dwell in each direction, with a dead band between the escalate and
+recovery watermarks (``overload_recovery_fraction``), so an oscillating
+load cannot flap the ladder. Every transition is a flight-recorder span
+(``overload.transition``) and moves the ``pool_degradation_level``
+gauge; sheds count in ``pool_shed_total{tenant,reason}`` and deadline
+fast-fails in ``pool_deadline_failfast_total`` — all surfaced on the
+dashboard's ``/api/slo`` ``degradation`` block.
+
+Signals (read each tick, all already exported elsewhere):
+
+- admission queue depth per live replica (the pool's ``_waiting``);
+- TTFT p99 against the pool's ``target_ttft_s`` (when set);
+- decode tokens/s over a short window (reported in spans for
+  postmortems; not a trip signal — it collapses for benign reasons);
+- per-peer link saturation from the net_accounting tx tally, sampled
+  tick-over-tick through ``demand_scheduler.link_utilization`` against
+  the configured ``net_qos_rate_mbps``.
+
+The ``overload.shed`` fault-injection site fires at the moment a
+request is about to be refused: ``drop`` suppresses the shed (the
+request is admitted anyway — exercising the queue-bound backstop),
+``delay``/``stall`` lengthen the refusal path. Both recoverable by
+construction, mirroring the qos chaos surface.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+#: ladder levels, in escalation order
+L0_HEALTHY = 0
+L1_SHED_SPECULATION = 1
+L2_SQUEEZE_BULK = 2
+L3_SHED_ADMISSION = 3
+
+LEVEL_NAMES = ("L0", "L1", "L2", "L3")
+
+
+class PoolOverloadedError(RuntimeError):
+    """Typed, RETRYABLE admission refusal: the pool's overload guardian
+    is shedding load (degradation level L3, or a deadline that cannot
+    be met). ``retry_after_s`` is the pool's estimate of when capacity
+    returns — clients should back off at least that long and resubmit;
+    the request was never admitted, so a retry is always safe."""
+
+    retryable = True
+
+    def __init__(self, tenant: str, reason: str, retry_after_s: float,
+                 level: int = L3_SHED_ADMISSION, msg: str = ""):
+        self.tenant = tenant
+        self.reason = reason
+        self.retry_after_s = float(retry_after_s)
+        self.level = int(level)
+        super().__init__(
+            msg or f"pool overloaded ({LEVEL_NAMES[min(level, 3)]}, "
+                   f"{reason}): tenant {tenant!r} shed, retry after "
+                   f"{retry_after_s:.2f}s")
+
+
+class DeadlineExceededError(PoolOverloadedError):
+    """Deadline-aware admission refusal: the request's ``deadline_s``
+    is (predicted to be) unmeetable — either fast-failed at admission
+    (predicted TTFT from queue depth x observed service rate already
+    exceeds it) or reaped after expiring in the queue. Retryable with
+    a fresh deadline; no decode slot was spent."""
+
+
+# ---------------------------------------------------------------------------
+# operator metrics (satellite: Prometheus surface for guardian state)
+# ---------------------------------------------------------------------------
+
+_metrics = None
+
+
+def get_overload_metrics():
+    global _metrics
+    if _metrics is None:
+        from ray_tpu.util import metrics as M
+
+        _metrics = {
+            "level": M.Gauge(
+                "pool_degradation_level",
+                "overload-guardian ladder level (0=healthy..3=shedding)"),
+            "shed": M.Counter(
+                "pool_shed_total",
+                "admissions refused by the overload guardian",
+                tag_keys=("tenant", "reason")),
+            "deadline": M.Counter(
+                "pool_deadline_failfast_total",
+                "requests fast-failed or reaped for an unmeetable "
+                "deadline"),
+        }
+    return _metrics
+
+
+# ---------------------------------------------------------------------------
+# checkpoint-ship deferral (L2 hook consulted by train/checkpoint.py)
+# ---------------------------------------------------------------------------
+
+_defer_lock = threading.Lock()
+_bulk_defer_until = 0.0
+
+
+def _set_bulk_deferral(engaged: bool) -> None:
+    """L2 engage/disengage: while engaged, ship_checkpoint defers (up
+    to its bounded budget). The deferral horizon is refreshed every
+    guardian tick at L2+, so a dead guardian cannot park shipping
+    forever — the flag decays within one tick period."""
+    global _bulk_defer_until
+    from ray_tpu._private import config as _cfg
+
+    with _defer_lock:
+        if engaged:
+            _bulk_defer_until = time.monotonic() + max(
+                2.0, float(_cfg.get("overload_ship_defer_max_s")))
+        else:
+            _bulk_defer_until = 0.0
+
+
+def bulk_deferred() -> bool:
+    """Is checkpoint shipping currently asked to defer (ladder at L2+)?
+    Process-local: the guardian and the trainer's ship call share the
+    driver process in the colocated deployment this serves."""
+    with _defer_lock:
+        return time.monotonic() < _bulk_defer_until
+
+
+def wait_bulk_clearance(max_wait_s: float | None = None,
+                        poll_s: float = 0.1) -> float:
+    """Block while the guardian holds bulk deferred, up to the bounded
+    budget (``overload_ship_defer_max_s`` unless overridden). Returns
+    the seconds actually waited — 0.0 on the healthy fast path."""
+    from ray_tpu._private import config as _cfg
+
+    if not bulk_deferred():
+        return 0.0
+    budget = (float(_cfg.get("overload_ship_defer_max_s"))
+              if max_wait_s is None else float(max_wait_s))
+    t0 = time.monotonic()
+    while bulk_deferred() and time.monotonic() - t0 < budget:
+        time.sleep(poll_s)
+    return time.monotonic() - t0
+
+
+# ---------------------------------------------------------------------------
+# ladder actions
+# ---------------------------------------------------------------------------
+
+
+class PoolActions:
+    """The per-level side effects, applied against a live LLMPool.
+
+    Engage/disengage are idempotent and remember the pre-engage config
+    values so recovery restores the operator's settings rather than
+    hard-coded defaults (an operator who ran with speculation OFF must
+    not get it flipped on by a guardian recovery)."""
+
+    def __init__(self, pool):
+        self.pool = pool
+        self._saved: dict = {}
+
+    def _broadcast_config(self, config: dict) -> None:
+        """Driver-side set_system_config plus an apply_config RPC to
+        every live replica: the replica pumps read these knobs from
+        their OWN process config, which a driver env flip does not
+        reach."""
+        import ray_tpu
+        from ray_tpu._private import config as _cfg
+
+        _cfg.set_system_config(config)
+        pool = self.pool
+        if pool is None:
+            return
+        refs = []
+        for rep in list(pool._alive()):
+            try:
+                refs.append(rep.handle.apply_config.remote(dict(config)))
+            except Exception:  # noqa: BLE001 — dying replica
+                pass
+        for ref in refs:
+            try:
+                ray_tpu.get(ref, timeout=30)
+            except Exception:  # noqa: BLE001 — best-effort: a replica
+                pass  # that missed the flip re-reads at respawn (env)
+
+    def shed_speculation(self, engage: bool) -> None:
+        from ray_tpu._private import config as _cfg
+
+        if engage:
+            self._saved.setdefault(
+                "serve_spec_enabled", _cfg.get("serve_spec_enabled"))
+            self._broadcast_config({"serve_spec_enabled": False})
+        elif "serve_spec_enabled" in self._saved:
+            self._broadcast_config(
+                {"serve_spec_enabled":
+                     self._saved.pop("serve_spec_enabled")})
+
+    def squeeze_bulk(self, engage: bool) -> None:
+        from ray_tpu._private import config as _cfg
+
+        if engage:
+            self._saved.setdefault(
+                "net_qos_bulk_share", _cfg.get("net_qos_bulk_share"))
+            _cfg.set_system_config({
+                "net_qos_bulk_share":
+                    float(_cfg.get("overload_bulk_share_squeezed"))})
+        elif "net_qos_bulk_share" in self._saved:
+            _cfg.set_system_config({
+                "net_qos_bulk_share":
+                    self._saved.pop("net_qos_bulk_share")})
+        _set_bulk_deferral(engage)
+
+    def shed_admission(self, engage: bool) -> None:
+        # no side effect to apply: the pool's admission path consults
+        # guardian.level directly; the method exists so tests can
+        # observe the transition through a recording actions object
+        pass
+
+
+class OverloadGuardian:
+    """Hysteretic L0-L3 brownout ladder for one serving pool.
+
+    ``tick()`` is driven from the pool's autoscale loop (or manually in
+    tests/benches). Signals may be injected for hermetic unit tests;
+    ``clock`` likewise. ``actions`` defaults to :class:`PoolActions`
+    against the owning pool."""
+
+    def __init__(self, pool=None, *, actions=None, clock=time.monotonic):
+        from ray_tpu._private import config as _cfg
+
+        self.pool = pool
+        self.actions = actions if actions is not None \
+            else PoolActions(pool)
+        self._clock = clock
+        self.level = L0_HEALTHY
+        self.transitions: list[dict] = []  # {"t","from","to","signals"}
+        self._hot_since: float | None = None
+        self._cool_since: float | None = None
+        self._last_change = clock()
+        self._lock = threading.Lock()
+        # tick-over-tick link sample for the saturation signal
+        self._link_prev: dict[str, float] | None = None
+        self._link_prev_t = clock()
+        self._cfg = _cfg
+
+    # ---- signal collection (overridden by injected signals in tests) --
+
+    def _link_saturation(self) -> float:
+        """Hottest-peer outbound utilization vs the configured pacer
+        rate, sampled tick-over-tick from the local net_accounting tx
+        tally (the same rows ``demand_scheduler.link_tx_by_peer``
+        aggregates at the head). 0.0 when pacing is unlimited."""
+        from ray_tpu._private import net_accounting as _net
+        from ray_tpu.autoscaler.demand_scheduler import link_utilization
+
+        rate_mbps = float(self._cfg.get("net_qos_rate_mbps"))
+        if rate_mbps <= 0:
+            return 0.0
+        now = self._clock()
+        cur: dict[str, float] = {}
+        try:
+            for (_d, peer, _q, _o, _t), v in \
+                    _net.local_totals("tx").items():
+                cur[peer] = cur.get(peer, 0.0) + v
+        except Exception:  # noqa: BLE001 — accounting best-effort
+            return 0.0
+        prev, prev_t = self._link_prev, self._link_prev_t
+        self._link_prev, self._link_prev_t = cur, now
+        if prev is None:
+            return 0.0
+        return link_utilization(prev, cur, now - prev_t,
+                                rate_mbps * 125_000.0)
+
+    def signals(self) -> dict:
+        pool = self.pool
+        if pool is None:
+            return {"queue_per_replica": 0.0, "ttft_p99_s": None,
+                    "target_ttft_s": None, "tokens_per_s": 0.0,
+                    "link_saturation": 0.0}
+        with pool._lock:
+            waiting = pool._waiting
+            n = max(1, len([r for r in pool._replicas if not r.dead]))
+        return {
+            "queue_per_replica": waiting / n,
+            "ttft_p99_s": pool.ttft_p99(),
+            "target_ttft_s": pool.target_ttft_s,
+            "tokens_per_s": pool.tokens_per_s(),
+            "link_saturation": self._link_saturation(),
+        }
+
+    # ---- pressure classification ----
+
+    def _classify(self, sig: dict) -> str:
+        """One of "hot" (escalation pressure), "cool" (recovery calm),
+        or "hold" (inside the hysteresis dead band)."""
+        cfg = self._cfg
+        q_high = float(cfg.get("overload_queue_per_replica_high"))
+        frac = float(cfg.get("overload_recovery_fraction"))
+        link_high = float(cfg.get("overload_link_saturation"))
+        q = float(sig.get("queue_per_replica", 0.0))
+        link = float(sig.get("link_saturation", 0.0))
+        ttft = sig.get("ttft_p99_s")
+        target = sig.get("target_ttft_s")
+        hot = q > q_high or link > link_high or (
+            target is not None and ttft is not None and ttft > target)
+        if hot:
+            return "hot"
+        cool = q <= q_high * frac and link <= link_high * frac and (
+            target is None or ttft is None or ttft <= target * frac)
+        return "cool" if cool else "hold"
+
+    # ---- ladder mechanics ----
+
+    def _apply(self, old: int, new: int) -> None:
+        acts = self.actions
+        try:
+            if new >= L1_SHED_SPECULATION > old:
+                acts.shed_speculation(True)
+            elif old >= L1_SHED_SPECULATION > new:
+                acts.shed_speculation(False)
+            if new >= L2_SQUEEZE_BULK > old:
+                acts.squeeze_bulk(True)
+            elif old >= L2_SQUEEZE_BULK > new:
+                acts.squeeze_bulk(False)
+            if new >= L3_SHED_ADMISSION > old:
+                acts.shed_admission(True)
+            elif old >= L3_SHED_ADMISSION > new:
+                acts.shed_admission(False)
+        except Exception:  # noqa: BLE001 — a failed action must not
+            logger.exception("overload guardian action failed")  # wedge
+        # L2 deferral horizon refresh (decays if the guardian dies)
+        if new >= L2_SQUEEZE_BULK:
+            _set_bulk_deferral(True)
+
+    def _transition(self, new: int, sig: dict, now: float) -> None:
+        from ray_tpu._private import flight_recorder as _fr
+
+        old = self.level
+        self.level = new
+        self._last_change = now
+        self._hot_since = self._cool_since = None
+        rec = {"t": now, "from": LEVEL_NAMES[old],
+               "to": LEVEL_NAMES[new], "signals": dict(sig)}
+        self.transitions.append(rec)
+        self._apply(old, new)
+        try:
+            get_overload_metrics()["level"].set(new)
+        except Exception:  # noqa: BLE001 — metrics best-effort
+            pass
+        try:
+            attrs = {"from": LEVEL_NAMES[old], "to": LEVEL_NAMES[new],
+                     "queue_per_replica":
+                         round(float(sig.get("queue_per_replica", 0.0)),
+                               3),
+                     "link_saturation":
+                         round(float(sig.get("link_saturation", 0.0)),
+                               3),
+                     "tokens_per_s":
+                         round(float(sig.get("tokens_per_s") or 0.0), 1)}
+            if sig.get("ttft_p99_s") is not None:
+                attrs["ttft_p99_s"] = round(float(sig["ttft_p99_s"]), 4)
+            _fr.record("serve", "overload.transition", now,
+                       self._clock(), attrs=attrs)
+        except Exception:  # noqa: BLE001 — observability best-effort
+            pass
+        logger.warning("overload guardian: %s -> %s (%s)",
+                       LEVEL_NAMES[old], LEVEL_NAMES[new],
+                       {k: v for k, v in sig.items()
+                        if not isinstance(v, dict)})
+
+    def tick(self, signals: dict | None = None) -> int:
+        """One controller step: classify pressure, move at most ONE
+        ladder level when the dwell is met. Returns the (possibly new)
+        level. Thread-safe; cheap at L0 with no pressure."""
+        with self._lock:
+            if not bool(self._cfg.get("overload_enabled")):
+                return self.level
+            now = self._clock()
+            sig = self.signals() if signals is None else signals
+            state = self._classify(sig)
+            if state == "hot":
+                self._cool_since = None
+                if self._hot_since is None:
+                    self._hot_since = now
+                dwell = float(
+                    self._cfg.get("overload_escalate_dwell_s"))
+                if (self.level < L3_SHED_ADMISSION
+                        and now - self._hot_since >= dwell):
+                    self._transition(self.level + 1, sig, now)
+                    # the NEXT level's dwell starts at this transition:
+                    # sustained pressure climbs one level per dwell
+                    self._hot_since = now
+            elif state == "cool":
+                self._hot_since = None
+                if self._cool_since is None:
+                    self._cool_since = now
+                dwell = float(
+                    self._cfg.get("overload_recover_dwell_s"))
+                if (self.level > L0_HEALTHY
+                        and now - self._cool_since >= dwell):
+                    self._transition(self.level - 1, sig, now)
+                    # sustained calm likewise re-climbs down one level
+                    # per recovery dwell
+                    self._cool_since = now
+            else:  # hold: inside the dead band — freeze both timers
+                self._hot_since = self._cool_since = None
+            if self.level >= L2_SQUEEZE_BULK:
+                _set_bulk_deferral(True)
+            try:
+                get_overload_metrics()["level"].set(self.level)
+            except Exception:  # noqa: BLE001
+                pass
+            return self.level
+
+    def state(self) -> dict:
+        return {
+            "level": self.level,
+            "level_name": LEVEL_NAMES[self.level],
+            "transitions": len(self.transitions),
+            "last_transition":
+                dict(self.transitions[-1]) if self.transitions else None,
+        }

@@ -351,7 +351,8 @@ class BackendExecutor:
                 _setup_backend_local, self.num_workers,
                 self.devices_per_worker, self.platform, timeout=180.0,
             )
-            self.group_name = self.worker_group.init_collective()
+            self.group_name = self.worker_group.init_collective(
+                link_tx=self._live_link_tx())
         else:
             coordinator = self.worker_group.execute_single(
                 0, _pick_coordinator)
@@ -364,6 +365,25 @@ class BackendExecutor:
         self._seed_assignments()
         logger.info("train backend up (%s): %s", self.backend, infos)
         return infos
+
+    @staticmethod
+    def _live_link_tx() -> dict[str, float] | None:
+        """Cluster-wide per-peer tx byte tally from the head's metric
+        rows — the signal link-aware ring formation orders ranks by.
+        Driver-local accounting only sees this process's sends, which is
+        blind to serving/bulk traffic between agents (the colocation
+        case); the head aggregates every node's export. None (fall back
+        to local accounting, then identity order) when the head is
+        unreachable — placement is an optimization, never a gate."""
+        try:
+            from ray_tpu._private.api import _get_worker
+            from ray_tpu.autoscaler.demand_scheduler import link_tx_by_peer
+
+            rows = _get_worker().head.call("get_metrics", {}) or []
+            tx = link_tx_by_peer(rows)
+            return tx or None
+        except Exception:  # noqa: BLE001 — best-effort signal
+            return None
 
     # ---- dataset shard assignment (driver-side source of truth) ----
 
@@ -578,7 +598,8 @@ class BackendExecutor:
         # so a gang-wide RPC round here would buy nothing on the
         # latency-critical resume
         wg.reform_collective(
-            timeout=float(config.get("collective_reform_timeout_s")))
+            timeout=float(config.get("collective_reform_timeout_s")),
+            link_tx=self._live_link_tx())
         self._rebalance_assignments()
         self.num_workers = world
         logger.info(

@@ -519,6 +519,16 @@ def ship_checkpoint(ckpt: "Checkpoint | str") -> Any:
 
     import ray_tpu
 
+    try:
+        # When the overload guardian has squeezed bulk (L2+), hold the
+        # ship until the deferral horizon clears — bounded by
+        # overload_ship_defer_max_s, so a dead guardian can't park
+        # checkpoints forever.
+        from ray_tpu.serve.overload import wait_bulk_clearance
+        wait_bulk_clearance()
+    except Exception:  # pragma: no cover — serve layer optional here
+        pass
+
     path = ckpt.path if isinstance(ckpt, Checkpoint) else \
         os.path.abspath(ckpt)
     if not os.path.isdir(path):

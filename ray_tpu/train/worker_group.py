@@ -304,13 +304,19 @@ class WorkerGroup:
         return ranks
 
     def reform_collective(self, group_name: str | None = None,
-                          timeout: float = 120.0) -> str:
+                          timeout: float = 120.0, *,
+                          link_tx: dict[str, float] | None = None) -> str:
         """Driver-coordinated reform after :meth:`heal`: bump the
         group's epoch channel, then have every CURRENT member
-        re-rendezvous under the bumped epoch (rank = gang position, so a
-        shrunk gang gets contiguous ranks). Frames from the old
-        incarnation are rejected at ingress; its error-feedback
-        residuals are dropped."""
+        re-rendezvous under the bumped epoch. Rank placement reuses the
+        link-aware ring order (``_ring_ranks``) so a reform that lands
+        mid-colocation — serving or bulk traffic saturating one node's
+        link — weaves the hot links apart, exactly like first-time init;
+        with a flat byte signal ranks stay ``range(n)``. ``link_tx``
+        overrides the live per-peer tally (tests; a driver passing
+        head-aggregated rows). Frames from the old incarnation are
+        rejected at ingress; its error-feedback residuals are
+        dropped."""
         import msgpack
 
         from ray_tpu._private.api import _get_worker
@@ -336,14 +342,15 @@ class WorkerGroup:
             "ns": KV_NS, "key": _epoch_key(name),
             "value": msgpack.packb(epoch),
         })
+        ranks = self._ring_ranks(link_tx)
         refs = [
             a.__ray_tpu_reform_collective__.remote(
-                self.num_workers, r, name, epoch)
-            for r, a in enumerate(self.workers)
+                self.num_workers, ranks[pos], name, epoch)
+            for pos, a in enumerate(self.workers)
         ]
         ray_tpu.get(refs, timeout=timeout)
         self._coll_group = name
-        self.collective_ranks = list(range(self.num_workers))
+        self.collective_ranks = ranks
         return name
 
     def destroy_collective(self):

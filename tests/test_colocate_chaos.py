@@ -1,0 +1,539 @@
+"""Colocation survival: train + serve + bulk on ONE cluster, under
+chaos and overcommit (ISSUE-20 tentpole, cluster half).
+
+The scenario ROADMAP item 1 calls the framework's reason to exist: a
+JaxTrainer DCN gang (collective class), a two-tenant LLMPool (kv
+class), and periodic checkpoint shipping (bulk class) share the same
+agents while the ``colocate`` chaos profile fires across the pacer,
+decode pumps, ring sends, and checkpoint writes. Both SLO floors must
+hold SIMULTANEOUSLY: the gang converges with zero cold restarts and
+every tenant's TTFT stays bounded, while bulk completes.
+
+Separately, a 2x-overcommitted pool must walk the overload guardian's
+ladder to L3, shed admissions TYPED-RETRYABLE (lowest-weight tenant
+first), keep the surviving tenant inside its TTFT floor, and — once
+the flood stops — recover to L0 without oscillating.
+"""
+
+import json
+import sys
+import threading
+import time
+
+import cloudpickle
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import config as _cfg
+from ray_tpu._private import fault_injection as fi
+from ray_tpu._private.chaos import gen_fault_plan
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.serve.llm_pool import LLMPool
+from ray_tpu.serve.overload import (
+    DeadlineExceededError,
+    PoolOverloadedError,
+)
+from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+# worker subprocesses can't import the tests package: ship helpers by value
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+N_BLOCKS = 8
+DIM = 16
+LR = 0.1
+STEPS = 5
+WORLD = 2
+
+# fixed tier-1 colocate seed: rank-0 ring.send exit at occurrence 0
+# (immediate gang kill -> in-place resume) PLUS a decode-1 pump exit
+# (replica death under tenant load) — both classes take a hit at once
+SMOKE_SEEDS = (9,)
+SMOKE_DEADLINE_S = 180.0
+SOAK_SEEDS = tuple(range(0, 16))
+SOAK_DEADLINE_S = 240.0
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(head_resources={"CPU": 8, "memory": 4 * 2**30})
+    c.connect()
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    fi.clear()
+    yield
+    fi.clear()
+    _cfg.set_system_config({"fault_spec": ""})
+
+
+def _block_grad(i, step):
+    rng = np.random.default_rng(7919 * (i + 1) + step)
+    return rng.standard_normal(DIM).astype(np.float32)
+
+
+def _ref_params(steps):
+    p = np.zeros(DIM, np.float32)
+    for s in range(steps):
+        total = np.zeros(DIM, np.float32)
+        for i in range(N_BLOCKS):
+            total = total + _block_grad(i, s)
+        p = p - LR * (total / N_BLOCKS)
+    return p
+
+
+def _colo_loop(config):
+    """Same world-size-invariant training as the chaos soak (any
+    elastic trajectory produces identical parameters), running while a
+    serving pool and bulk ships contend for the same cluster."""
+    import os as _os
+
+    import numpy as _np
+
+    from ray_tpu._private import fault_injection as _fi
+    from ray_tpu.train import dcn_allreduce_grads, session
+    from ray_tpu.train.checkpoint import Checkpoint as _Ck
+
+    rank = session.get_world_rank()
+    seq = session.get_resume_seq()
+    if seq == 0 and config.get("worker_specs"):
+        _fi.configure(config["worker_specs"])
+    shard = session.get_dataset_shard("train")
+    group = session.get_collective_group()
+    params = _np.zeros(DIM, _np.float32)
+    start = 0
+    ck = session.get_checkpoint()
+    if ck is not None:
+        d = ck.to_dict()
+        params = _np.asarray(d["params"], _np.float32)
+        start = int(d["step"])
+    for step in range(start, config["steps"]):
+        contrib = _np.zeros(DIM, _np.float32)
+        for i in shard.assigned_indices():
+            contrib = contrib + _block_grad(i, step)
+        total = dcn_allreduce_grads({"g": contrib}, group, op="sum",
+                                    timeout=10.0)["g"]
+        params = params - LR * (total / N_BLOCKS)
+        ckpt = None
+        if rank == 0:
+            ckpt = _Ck.from_dict(
+                {"step": step + 1, "params": params},
+                _os.path.join(config["ck_dir"], f"ck_s{seq}_{step}"))
+        session.report({"step": step + 1,
+                        "loss": float(_np.square(params).sum())},
+                       checkpoint=ckpt)
+
+
+class _ServeLoad:
+    """Two tenants hammering the pool from threads until stopped.
+    Typed-retryable sheds are counted, not failures; anything else is
+    a failure."""
+
+    def __init__(self, pool, tenants=("A", "B"), threads_per=2,
+                 new_tokens=16):
+        self.pool = pool
+        self.stop = threading.Event()
+        self.errs: list[str] = []
+        self.sheds = 0
+        self.done = 0
+        self._threads = [
+            threading.Thread(target=self._one, args=(tn, k),
+                             daemon=True)
+            for tn in tenants for k in range(threads_per)
+        ]
+        self.new_tokens = new_tokens
+
+    def _one(self, tenant, k):
+        rng = np.random.RandomState(hash((tenant, k)) % 2**31)
+        while not self.stop.is_set():
+            prompt = [int(x) for x in rng.randint(1, 250, 12)]
+            try:
+                out = self.pool.generate(prompt, self.new_tokens,
+                                         tenant=tenant)
+                assert len(out["tokens"]) == self.new_tokens
+                self.done += 1
+            except PoolOverloadedError as e:
+                assert e.retryable and e.retry_after_s > 0
+                self.sheds += 1
+                time.sleep(min(e.retry_after_s, 0.5))
+            except Exception as e:  # noqa: BLE001
+                self.errs.append(
+                    f"{tenant}/{k}: {type(e).__name__}: {e}")
+                return
+
+    def start(self):
+        for t in self._threads:
+            t.start()
+
+    def finish(self, timeout=60):
+        self.stop.set()
+        for t in self._threads:
+            t.join(timeout=timeout)
+
+
+class _BulkShips:
+    """Periodic checkpoint ship+fetch round-trips (the bulk class)."""
+
+    def __init__(self, tmp_path):
+        self.tmp = tmp_path
+        self.stop = threading.Event()
+        self.completed = 0
+        self.errs: list[str] = []
+        self._t = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        from ray_tpu.train.checkpoint import Checkpoint, ship_checkpoint
+
+        i = 0
+        while not self.stop.is_set():
+            try:
+                ck = Checkpoint.from_dict(
+                    {"step": i, "blob": np.zeros(64_000, np.uint8)},
+                    str(self.tmp / f"ship_{i}"))
+                ref = ship_checkpoint(ck)
+                out = ray_tpu.get(ref, timeout=120)
+                assert out["members"]
+                self.completed += 1
+            except Exception as e:  # noqa: BLE001
+                self.errs.append(f"ship {i}: {type(e).__name__}: {e}")
+            i += 1
+            self.stop.wait(1.0)
+
+    def start(self):
+        self._t.start()
+
+    def finish(self, timeout=130):
+        self.stop.set()
+        self._t.join(timeout=timeout)
+
+
+def _run_colocate_seed(cluster, tmp_path, seed: int, deadline_s: float):
+    plan = gen_fault_plan(seed, world_size=WORLD, max_faults=2,
+                          profile="colocate", n_replicas=2)
+    fi.clear()
+    if plan.driver_specs:
+        fi.configure(plan.driver_specs)
+    # decode replicas arm via the env-propagated spec: set BEFORE spawn
+    _cfg.set_system_config({
+        "fault_spec": json.dumps(plan.serve_specs)
+        if plan.serve_specs else ""})
+    out = tmp_path / f"colo{seed}"
+    out.mkdir()
+    pool = LLMPool(model_size="tiny", slots=2, max_len=96,
+                   chunk_tokens=8, prompt_buckets=(16,),
+                   min_replicas=2, max_replicas=2, chunk_delay_s=0.02,
+                   autoscale=True,
+                   tenant_weights={"A": 2.0, "B": 1.0})
+    load = _ServeLoad(pool)
+    ships = _BulkShips(out)
+    trainer = JaxTrainer(
+        _colo_loop,
+        train_loop_config={
+            "steps": STEPS,
+            "ck_dir": str(out / "ckpts"),
+            "worker_specs": plan.worker_specs,
+        },
+        scaling_config=ScalingConfig(
+            num_workers=WORLD, resources_per_worker={"CPU": 1},
+            backend="dcn", min_workers=1, placement_strategy="PACK",
+        ),
+        run_config=RunConfig(
+            name=f"colo{seed}", storage_path=str(out),
+            max_failures=4, max_inplace_resumes=12,
+        ),
+        datasets={"train": list(range(N_BLOCKS))},
+    )
+    t0 = time.monotonic()
+    try:
+        # warm every replica's jit cache before measuring TTFT: compile
+        # time is a cold-start cost, not a colocation cost
+        warm = [int(x) for x in
+                np.random.RandomState(5).randint(1, 250, 12)]
+        ray_tpu.get([r.handle.generate.remote(warm, 8)
+                     for r in pool._alive()], timeout=600)
+        load.start()
+        ships.start()
+        result = trainer.fit()
+        train_s = time.monotonic() - t0
+        # keep contending until every class has proof of life (the
+        # tiny-model pool spends its first seconds jit-compiling, so
+        # the serve side may lag a fast training run)
+        while ((ships.completed < 2 or load.done < 8
+                or pool.ttft_p99("A") is None
+                or pool.ttft_p99("B") is None)
+               and not load.errs and not ships.errs
+               and time.monotonic() - t0 < deadline_s):
+            time.sleep(0.5)
+        load.finish()
+        ships.finish()
+        elapsed = time.monotonic() - t0
+
+        # -- training floor: converged, exact, ZERO gang restarts --
+        assert result.error is None, result.error
+        assert result.metrics["step"] == STEPS, result.metrics
+        ref = _ref_params(STEPS)
+        np.testing.assert_allclose(
+            np.asarray(result.checkpoint.to_dict()["params"]), ref,
+            rtol=1e-5, atol=1e-6)
+        assert result.resumes["gang"] == 0, result.resumes
+        assert train_s < deadline_s, (
+            f"seed {seed} train took {train_s:.1f}s: {plan.describe()}")
+
+        # -- serve floor: both tenants served, TTFT bounded, typed
+        # errors only --
+        assert not load.errs, load.errs[0]
+        assert load.done >= 8, (load.done, load.sheds)
+        for tn in ("A", "B"):
+            p99 = pool.ttft_p99(tn)
+            assert p99 is not None, f"tenant {tn} never served"
+            assert p99 < 8.0, f"tenant {tn} TTFT p99 {p99:.2f}s"
+
+        # -- bulk floor: ships completed despite the squeeze window --
+        assert not ships.errs, ships.errs[0]
+        assert ships.completed >= 2, ships.completed
+
+        # the guardian rode along (ladder state visible to operators)
+        assert pool.stats()["overload"] is not None
+        return result, load, ships, elapsed
+    except BaseException:
+        print(f"\nCOLOCATE CHAOS FAILURE {plan.describe()}\n"
+              f"replay: RAY_TPU_FAULT_SPEC='{plan.env_value()}'\n",
+              file=sys.stderr, flush=True)
+        raise
+    finally:
+        load.stop.set()
+        ships.stop.set()
+        pool.shutdown()
+        fi.clear()
+        _cfg.set_system_config({"fault_spec": ""})
+
+
+def test_colocate_smoke(cluster, tmp_path):
+    """Tier-1: one fixed colocate seed — immediate gang rank kill plus
+    a decode-replica pump death — with both SLO floors asserted while
+    checkpoint ships complete."""
+    for seed in SMOKE_SEEDS:
+        result, load, ships, elapsed = _run_colocate_seed(
+            cluster, tmp_path, seed, SMOKE_DEADLINE_S)
+        print(f"colocate seed {seed}: {elapsed:.1f}s "
+              f"resumes={result.resumes} served={load.done} "
+              f"sheds={load.sheds} ships={ships.completed}")
+
+
+@pytest.mark.slow
+def test_colocate_soak_randomized(cluster, tmp_path):
+    """The sweep: every colocate-profile seed must keep both floors."""
+    report = []
+    for seed in SOAK_SEEDS:
+        result, load, ships, elapsed = _run_colocate_seed(
+            cluster, tmp_path, seed, SOAK_DEADLINE_S)
+        report.append((seed, round(elapsed, 1), result.resumes,
+                       load.done, load.sheds, ships.completed))
+    print("\ncolocate soak (seed, s, resumes, served, sheds, ships):")
+    for row in report:
+        print(f"  {row}")
+    assert len(report) == len(SOAK_SEEDS)
+
+
+# ---------------------------------------------------------------------------
+# 2x overcommit: ladder to L3, typed sheds, survivor floor, L0 recovery
+# ---------------------------------------------------------------------------
+
+FAST_KNOBS = {
+    "overload_escalate_dwell_s": 0.2,
+    "overload_recover_dwell_s": 0.3,
+    "overload_queue_per_replica_high": 2.0,
+    "overload_shed_queue_bound": 8,
+}
+def _restore_overload_knobs():
+    _cfg.set_system_config({
+        "overload_escalate_dwell_s": 1.0,
+        "overload_recover_dwell_s": 3.0,
+        "overload_queue_per_replica_high": 8.0,
+        "overload_shed_queue_bound": 64,
+    })
+
+
+def test_overcommit_sheds_typed_and_recovers(cluster):
+    """A single-replica pool flooded at ~2x its admission capacity must
+    escalate to L3, refuse overflow TYPED-RETRYABLE (lowest-weight
+    tenant first — the high-weight tenant's p99 stays floored), and
+    after the flood stops walk back to L0 without oscillating."""
+    from ray_tpu._private import flight_recorder as _fr
+
+    _cfg.set_system_config(dict(FAST_KNOBS))
+    # max_inflight 2 makes the admission queue the contended resource:
+    # 8 flood threads against 2 slots is the 2x+ overcommit
+    pool = LLMPool(model_size="tiny", slots=2, max_len=96,
+                   chunk_tokens=8, prompt_buckets=(16,),
+                   min_replicas=1, max_replicas=1, chunk_delay_s=0.05,
+                   max_inflight_per_replica=2, autoscale=True,
+                   tenant_weights={"gold": 4.0, "bronze": 1.0})
+    stop = threading.Event()
+    shed_errs: list[PoolOverloadedError] = []
+    hard_errs: list[str] = []
+    ok = {"gold": 0, "bronze": 0}
+    lock = threading.Lock()
+
+    def flood(tenant, k):
+        rng = np.random.RandomState(42 + k)
+        while not stop.is_set():
+            prompt = [int(x) for x in rng.randint(1, 250, 12)]
+            try:
+                out = pool.generate(prompt, 24, tenant=tenant)
+                assert len(out["tokens"]) == 24
+                with lock:
+                    ok[tenant] += 1
+            except PoolOverloadedError as e:
+                with lock:
+                    shed_errs.append(e)
+                time.sleep(0.2)
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    hard_errs.append(f"{tenant}: "
+                                     f"{type(e).__name__}: {e}")
+                return
+
+    threads = ([threading.Thread(target=flood, args=("bronze", k),
+                                 daemon=True) for k in range(6)]
+               + [threading.Thread(target=flood, args=("gold", 10 + k),
+                                   daemon=True) for k in range(2)])
+    try:
+        for t in threads:
+            t.start()
+        # sustained 2x overcommit: the guardian must reach L3 and shed
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if shed_errs and pool._guardian.level == 3:
+                break
+            time.sleep(0.25)
+        assert pool._guardian.level == 3, (
+            f"ladder stuck at L{pool._guardian.level} "
+            f"(sheds={len(shed_errs)})")
+        assert shed_errs, "L3 without a single typed shed"
+        e = shed_errs[0]
+        assert e.retryable is True
+        assert e.retry_after_s >= float(
+            _cfg.get("overload_retry_after_min_s"))
+        assert e.tenant in ("gold", "bronze")
+        # escalation was monotonic: L0->L1->L2->L3, no skips
+        ups = [x["to"] for x in pool._guardian.transitions]
+        assert ups[:3] == ["L1", "L2", "L3"]
+
+        # keep the flood on long enough to accumulate tenant stats
+        time.sleep(2.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        assert not hard_errs, hard_errs[0]
+        # shedding favored the low-weight tenant; gold kept being
+        # served and stayed inside its floor
+        bronze_sheds = sum(1 for x in shed_errs if x.tenant == "bronze")
+        gold_sheds = len(shed_errs) - bronze_sheds
+        assert bronze_sheds >= gold_sheds, (bronze_sheds, gold_sheds)
+        assert ok["gold"] >= 3, ok
+        gold_p99 = pool.ttft_p99("gold")
+        assert gold_p99 is not None and gold_p99 < 5.0, gold_p99
+
+        # -- recovery: back to L0 on sustained calm, then STAYS there --
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if pool._guardian.level == 0:
+                break
+            time.sleep(0.25)
+        assert pool._guardian.level == 0, (
+            f"never recovered: L{pool._guardian.level} "
+            f"{pool._guardian.transitions}")
+        n_trans = len(pool._guardian.transitions)
+        time.sleep(3.0)  # several tick periods of idle
+        assert pool._guardian.level == 0
+        assert len(pool._guardian.transitions) == n_trans, (
+            "ladder flapped after recovery: "
+            f"{pool._guardian.transitions[n_trans:]}")
+        # full descent recorded, ending at L0
+        downs = [x for x in pool._guardian.transitions
+                 if x["to"] < x["from"]]
+        assert len(downs) >= 3, pool._guardian.transitions
+
+        # transitions are operator-visible as flight-recorder spans
+        spans = [s for s in _fr._get().ring
+                 if s.get("name") == "overload.transition"]
+        assert len(spans) >= 6  # 3 up + 3 down at least
+    finally:
+        stop.set()
+        pool.shutdown()
+        _restore_overload_knobs()
+
+
+def test_deadline_fast_fail_e2e(cluster):
+    """Deadline-aware admission on a live pool: a request whose
+    deadline cannot cover the queue's predicted drain fast-fails typed
+    (no decode slot burned), a generous deadline sails through, and a
+    queued request that expires is reaped typed."""
+    pool = LLMPool(model_size="tiny", slots=1, max_len=96,
+                   chunk_tokens=8, prompt_buckets=(16,),
+                   min_replicas=1, max_replicas=1, chunk_delay_s=0.05,
+                   autoscale=False)
+    stop = threading.Event()
+
+    def background(k):
+        rng = np.random.RandomState(800 + k)
+        while not stop.is_set():
+            prompt = [int(x) for x in rng.randint(1, 250, 12)]
+            try:
+                pool.generate(prompt, 24)
+            except Exception:  # noqa: BLE001
+                return
+
+    threads = [threading.Thread(target=background, args=(k,),
+                                daemon=True) for k in range(6)]
+    try:
+        prompt = [1, 2, 3, 4]
+        # generous deadline admits even while busy
+        out = pool.generate(prompt, 8, deadline_s=120.0)
+        assert len(out["tokens"]) == 8
+        for t in threads:
+            t.start()
+        time.sleep(1.5)  # build a queue + an observed admit rate
+        with pytest.raises(DeadlineExceededError) as ei:
+            # 1ms can cover neither the predicted wait nor the queue:
+            # fast-fail at admission or reap at expiry — typed either way
+            pool.generate(prompt, 8, tenant="dl", deadline_s=0.001)
+        assert ei.value.retryable is True
+        assert ei.value.retry_after_s > 0
+        # the tight deadline burned no decode slot and poisoned nothing:
+        # the pool still serves
+        out = pool.generate(prompt, 8, deadline_s=120.0)
+        assert len(out["tokens"]) == 8
+    finally:
+        stop.set()
+        pool.shutdown()
+
+
+def test_ship_checkpoint_respects_bulk_squeeze(cluster, tmp_path):
+    """train/checkpoint.py consults the guardian's bulk-deferral
+    horizon: an engaged squeeze delays the ship (bounded), never blocks
+    it, and the shipped bytes are intact."""
+    from ray_tpu.serve import overload as ov
+    from ray_tpu.train.checkpoint import Checkpoint, ship_checkpoint
+
+    _cfg.set_system_config({"overload_ship_defer_max_s": 0.01})
+    try:
+        ov._set_bulk_deferral(True)  # horizon floor: 2s
+        ck = Checkpoint.from_dict(
+            {"step": 7, "blob": np.arange(1000, dtype=np.int32)},
+            str(tmp_path / "squeezed"))
+        t0 = time.monotonic()
+        ref = ship_checkpoint(ck)
+        waited = time.monotonic() - t0
+        out = ray_tpu.get(ref, timeout=120)
+        assert out["members"]
+        # bounded: the defer budget (0.01s here) expires long before
+        # the 2s horizon floor does
+        assert waited < 2.0, waited
+    finally:
+        ov._set_bulk_deferral(False)
+        _cfg.set_system_config({"overload_ship_defer_max_s": 15.0})

@@ -387,13 +387,44 @@ def test_worker_group_link_aware_init_and_reform(cluster):
         expect = [float(sum(range(1, 4)))] * 3
         for _, o in outs:
             assert o == expect
-        # reform (the heal path) compacts back to position order
+        # reform (the heal path) with a flat signal compacts back to
+        # position order
         wg.reform_collective(name)
         assert wg.collective_ranks == [0, 1, 2]
         outs = wg.execute(_wg_allreduce, name, timeout=60)
         assert [r for r, _ in sorted(outs)] == [0, 1, 2]
+        # reform UNDER a skewed signal (a colocation heal: serving/bulk
+        # saturating one node's link) re-weaves ranks exactly like init
+        skew = {"aaaaaaaa": 9e9, "bbbbbbbb": 1.0, "cccccccc": 2.0}
+        wg.reform_collective(name, link_tx=skew)
+        assert sorted(wg.collective_ranks) == [0, 1, 2]
+        assert wg.collective_ranks == wg._ring_ranks(skew)
+        outs = wg.execute(_wg_allreduce, name, timeout=60)
+        assert sorted(r for r, _ in outs) == [0, 1, 2]
+        for _, o in outs:
+            assert o == expect
     finally:
         wg.shutdown()
+
+
+def test_reform_rank_weave_separates_saturated_links():
+    """ISSUE-20 satellite: the rank layout reform_collective applies
+    (``_ring_ranks``) places the two hottest node links ring-non-
+    adjacent — a link saturated by colocated serving traffic never
+    neighbors the next-hottest in the allreduce ring."""
+    from ray_tpu.train.worker_group import WorkerGroup
+
+    wg = WorkerGroup.__new__(WorkerGroup)
+    wg.num_workers = 4
+    labels = ["aaaaaaaa", "bbbbbbbb", "cccccccc", "dddddddd"]
+    wg.node_ids = lambda: [lb * 4 for lb in ["aa", "bb", "cc", "dd"]]
+    tx = {"aaaaaaaa": 9e9, "bbbbbbbb": 8e9,
+          "cccccccc": 10.0, "dddddddd": 20.0}
+    ranks = wg._ring_ranks(tx)
+    assert sorted(ranks) == [0, 1, 2, 3]
+    hot = sorted(range(4), key=lambda i: tx[labels[i]])[-2:]
+    gap = abs(ranks[hot[0]] - ranks[hot[1]])
+    assert gap not in (1, 3), (ranks, tx)
 
 
 # ---------------------------------------------------------------------------

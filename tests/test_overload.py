@@ -1,0 +1,465 @@
+"""Overload guardian (serve/overload.py): hermetic ladder units.
+
+ISSUE-20 tentpole, no-cluster half: hysteretic L0-L3 ladder mechanics
+(monotonic escalation, one level per dwell, hold-band no-flap,
+hysteretic recovery), deadline-aware admission semantics against a stub
+pool, bounded checkpoint-ship deferral, PoolActions config
+save/restore, and chaos-plan determinism (existing profiles stay
+byte-identical with the colocate profile added)."""
+
+import collections
+import threading
+import time
+
+import pytest
+
+from ray_tpu._private import config as _cfg
+from ray_tpu._private import fault_injection as fi
+from ray_tpu.serve import overload as ov
+from ray_tpu.serve.overload import (
+    L0_HEALTHY,
+    L1_SHED_SPECULATION,
+    L2_SQUEEZE_BULK,
+    L3_SHED_ADMISSION,
+    DeadlineExceededError,
+    OverloadGuardian,
+    PoolOverloadedError,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    fi.clear()
+    ov._set_bulk_deferral(False)
+    yield
+    fi.clear()
+    ov._set_bulk_deferral(False)
+
+
+class _Acts:
+    """Recording actions object: the guardian's side-effect log."""
+
+    def __init__(self):
+        self.calls = []
+
+    def shed_speculation(self, engage):
+        self.calls.append(("spec", engage))
+
+    def squeeze_bulk(self, engage):
+        self.calls.append(("bulk", engage))
+
+    def shed_admission(self, engage):
+        self.calls.append(("adm", engage))
+
+
+HOT = {"queue_per_replica": 99.0, "ttft_p99_s": None,
+       "target_ttft_s": None, "tokens_per_s": 0.0,
+       "link_saturation": 0.0}
+COOL = {"queue_per_replica": 0.0, "ttft_p99_s": None,
+        "target_ttft_s": None, "tokens_per_s": 0.0,
+        "link_saturation": 0.0}
+
+
+def _guardian():
+    t = [0.0]
+    acts = _Acts()
+    g = OverloadGuardian(actions=acts, clock=lambda: t[0])
+    return g, acts, t
+
+
+# ---------------------------------------------------------------------------
+# ladder mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_ladder_escalates_one_level_per_dwell():
+    g, acts, t = _guardian()
+    dwell = float(_cfg.get("overload_escalate_dwell_s"))
+    # sub-dwell pressure never moves the ladder
+    t[0] += dwell * 0.5
+    assert g.tick(HOT) == L0_HEALTHY
+    # each full dwell of sustained pressure buys exactly ONE level
+    for want in (L1_SHED_SPECULATION, L2_SQUEEZE_BULK,
+                 L3_SHED_ADMISSION):
+        t[0] += dwell + 0.01
+        assert g.tick(HOT) == want
+    # L3 is the ceiling
+    t[0] += dwell * 3
+    assert g.tick(HOT) == L3_SHED_ADMISSION
+    assert acts.calls == [("spec", True), ("bulk", True), ("adm", True)]
+    assert [x["to"] for x in g.transitions] == ["L1", "L2", "L3"]
+
+
+def test_ladder_recovery_is_hysteretic_and_restores():
+    g, acts, t = _guardian()
+    esc = float(_cfg.get("overload_escalate_dwell_s"))
+    rec = float(_cfg.get("overload_recover_dwell_s"))
+    g.tick(HOT)  # arm the pressure timer
+    for _ in range(3):
+        t[0] += esc + 0.01
+        g.tick(HOT)
+    assert g.level == L3_SHED_ADMISSION
+    acts.calls.clear()
+    # calm shorter than the recovery dwell does not descend
+    g.tick(COOL)  # arm the calm timer
+    t[0] += rec * 0.5
+    assert g.tick(COOL) == L3_SHED_ADMISSION
+    for want in (L2_SQUEEZE_BULK, L1_SHED_SPECULATION, L0_HEALTHY):
+        t[0] += rec + 0.01
+        assert g.tick(COOL) == want
+    # disengage order mirrors engage order, outermost level first
+    assert acts.calls == [("adm", False), ("bulk", False),
+                          ("spec", False)]
+
+
+def test_ladder_hold_band_never_flaps():
+    """A signal oscillating inside the dead band (below the escalate
+    watermark, above the recovery watermark) freezes the ladder: no
+    transition in either direction, ever."""
+    g, acts, t = _guardian()
+    esc = float(_cfg.get("overload_escalate_dwell_s"))
+    q_high = float(_cfg.get("overload_queue_per_replica_high"))
+    frac = float(_cfg.get("overload_recovery_fraction"))
+    g.tick(HOT)  # arm
+    t[0] += esc + 0.01
+    g.tick(HOT)
+    assert g.level == L1_SHED_SPECULATION
+    n0 = len(g.transitions)
+    mid = dict(COOL)
+    mid["queue_per_replica"] = q_high * (frac + 1.0) / 2.0  # dead band
+    for _ in range(50):
+        t[0] += 7.0  # far past both dwells
+        g.tick(mid)
+    assert g.level == L1_SHED_SPECULATION
+    assert len(g.transitions) == n0  # zero flaps
+    # the hold also resets accumulated heat: one hot tick after a long
+    # hold must not instantly escalate
+    g.tick(HOT)
+    assert g.level == L1_SHED_SPECULATION
+
+
+def test_ladder_disabled_by_config():
+    g, acts, t = _guardian()
+    _cfg.set_system_config({"overload_enabled": False})
+    try:
+        for _ in range(10):
+            t[0] += 5.0
+            assert g.tick(HOT) == L0_HEALTHY
+        assert acts.calls == []
+    finally:
+        _cfg.set_system_config({"overload_enabled": True})
+
+
+def test_ttft_breach_is_escalation_pressure():
+    g, acts, t = _guardian()
+    esc = float(_cfg.get("overload_escalate_dwell_s"))
+    sig = dict(COOL)
+    sig["ttft_p99_s"], sig["target_ttft_s"] = 2.0, 0.5
+    g.tick(sig)  # arm
+    t[0] += esc + 0.01
+    assert g.tick(sig) == L1_SHED_SPECULATION
+
+
+def test_transitions_recorded_in_flight_recorder():
+    from ray_tpu._private import flight_recorder as _fr
+
+    g, acts, t = _guardian()
+    t[0] = time.monotonic()  # recorder clamps spans to real time
+    g.tick(HOT)  # arm
+    t[0] += float(_cfg.get("overload_escalate_dwell_s")) + 0.01
+    g.tick(HOT)
+    spans = [s for s in _fr._get().ring
+             if s.get("name") == "overload.transition"]
+    assert spans, "transition must leave a flight-recorder span"
+    attrs = spans[-1].get("attrs", {})
+    assert attrs.get("from") == "L0" and attrs.get("to") == "L1"
+    assert "queue_per_replica" in attrs
+
+
+# ---------------------------------------------------------------------------
+# checkpoint-ship deferral (the L2 hook train/checkpoint.py consults)
+# ---------------------------------------------------------------------------
+
+
+def test_bulk_deferral_engage_disengage_and_bound():
+    assert not ov.bulk_deferred()
+    assert ov.wait_bulk_clearance() == 0.0  # healthy fast path
+    ov._set_bulk_deferral(True)
+    assert ov.bulk_deferred()
+    t0 = time.monotonic()
+    waited = ov.wait_bulk_clearance(max_wait_s=0.3, poll_s=0.02)
+    assert 0.25 <= waited <= 2.0  # bounded: gives up, never parks
+    assert time.monotonic() - t0 < 2.0
+    ov._set_bulk_deferral(False)
+    assert not ov.bulk_deferred()
+
+
+def test_bulk_deferral_decays_without_guardian_refresh():
+    """The horizon is a decaying timestamp, not a latched flag: a dead
+    guardian cannot park checkpoint shipping forever."""
+    _cfg.set_system_config({"overload_ship_defer_max_s": 0.01})
+    try:
+        ov._set_bulk_deferral(True)
+        # floor of the horizon is 2s; it expires on its own
+        assert ov.bulk_deferred()
+        assert ov._bulk_defer_until <= time.monotonic() + 2.5
+    finally:
+        _cfg.set_system_config({"overload_ship_defer_max_s": 15.0})
+        ov._set_bulk_deferral(False)
+
+
+# ---------------------------------------------------------------------------
+# PoolActions: driver-config engage saves + restores operator values
+# ---------------------------------------------------------------------------
+
+
+def test_pool_actions_save_and_restore_operator_config():
+    acts = ov.PoolActions(None)  # driver-only (no replica broadcast)
+    _cfg.set_system_config({"serve_spec_enabled": True,
+                            "net_qos_bulk_share": 0.2})
+    try:
+        acts.shed_speculation(True)
+        assert _cfg.get("serve_spec_enabled") is False
+        acts.squeeze_bulk(True)
+        assert float(_cfg.get("net_qos_bulk_share")) == pytest.approx(
+            float(_cfg.get("overload_bulk_share_squeezed")))
+        assert ov.bulk_deferred()
+        acts.squeeze_bulk(False)
+        assert float(_cfg.get("net_qos_bulk_share")) == 0.2
+        assert not ov.bulk_deferred()
+        acts.shed_speculation(False)
+        assert _cfg.get("serve_spec_enabled") is True
+    finally:
+        _cfg.set_system_config({"serve_spec_enabled": True,
+                                "net_qos_bulk_share": 0.2})
+        ov._set_bulk_deferral(False)
+
+
+def test_pool_actions_respect_operator_off():
+    """An operator who runs with speculation OFF must not have it
+    flipped ON by a guardian recovery."""
+    acts = ov.PoolActions(None)
+    _cfg.set_system_config({"serve_spec_enabled": False})
+    try:
+        acts.shed_speculation(True)
+        acts.shed_speculation(False)
+        assert _cfg.get("serve_spec_enabled") is False
+    finally:
+        _cfg.set_system_config({"serve_spec_enabled": True})
+
+
+# ---------------------------------------------------------------------------
+# typed errors + deadline-aware admission against a stub pool
+# ---------------------------------------------------------------------------
+
+
+def test_pool_overloaded_error_is_typed_retryable():
+    e = PoolOverloadedError("tenantB", "low_weight", 1.5)
+    assert e.retryable is True
+    assert e.tenant == "tenantB" and e.reason == "low_weight"
+    assert e.retry_after_s == 1.5
+    assert isinstance(e, RuntimeError)
+    d = DeadlineExceededError("a", "deadline", 0.7)
+    assert d.retryable is True and isinstance(d, PoolOverloadedError)
+
+
+class _StubPool:
+    """Just the state _admission_shed/_shed/_admit_rate_locked touch —
+    the admission gate unit-tested without spawning replica actors."""
+
+    TTFT_WINDOW_S = 30.0
+
+    def __init__(self, waiting=0, weights=None, level=L0_HEALTHY):
+        self._lock = threading.Lock()
+        self._waiting = waiting
+        self._admits = collections.deque(maxlen=256)
+        self._tenant_weights = dict(weights or {})
+
+        class _G:
+            pass
+
+        self._guardian = _G()
+        self._guardian.level = level
+
+    def seed_rate(self, per_s, n=20):
+        now = time.monotonic()
+        for i in range(n):
+            self._admits.append(now - (n - 1 - i) / per_s)
+
+    def _admit_rate_locked(self, now):
+        from ray_tpu.serve.llm_pool import LLMPool
+
+        return LLMPool._admit_rate_locked(self, now)
+
+
+from ray_tpu.serve.llm_pool import LLMPool  # noqa: E402
+
+
+def _shed_of(pool, tenant, deadline_abs=None):
+    return LLMPool._admission_shed(pool, tenant, deadline_abs)
+
+
+def test_deadline_fast_fail_predicts_from_observed_rate():
+    # 10 admissions/s observed, 50 already waiting -> ~5.1s predicted
+    p = _StubPool(waiting=50)
+    p.seed_rate(10.0)
+    out = _shed_of(p, "a", deadline_abs=time.monotonic() + 1.0)
+    assert out is not None
+    reason, retry, exc = out
+    assert reason == "deadline" and exc is DeadlineExceededError
+    assert retry > 1.0  # the hint reflects the predicted wait
+    # a meetable deadline admits
+    assert _shed_of(p, "a", deadline_abs=time.monotonic() + 60) is None
+
+
+def test_deadline_cold_pool_never_fast_fails_on_a_guess():
+    p = _StubPool(waiting=50)  # no admission history -> no rate
+    assert _shed_of(p, "a", deadline_abs=time.monotonic() + 0.1) is None
+
+
+def test_l3_sheds_lowest_weight_first_then_everyone():
+    bound = int(_cfg.get("overload_shed_queue_bound"))
+    weights = {"gold": 4.0, "bronze": 1.0}
+    # below every threshold: nobody sheds even at L3
+    p = _StubPool(waiting=2, weights=weights, level=L3_SHED_ADMISSION)
+    assert _shed_of(p, "gold") is None
+    assert _shed_of(p, "bronze") is None
+    # mid-queue: bronze (weight share 1/4) sheds, gold rides on
+    mid = int(bound * 0.6)
+    p = _StubPool(waiting=mid, weights=weights, level=L3_SHED_ADMISSION)
+    p.seed_rate(5.0)
+    assert _shed_of(p, "gold") is None
+    out = _shed_of(p, "bronze")
+    assert out is not None
+    reason, retry, exc = out
+    assert reason == "low_weight" and exc is PoolOverloadedError
+    assert retry >= float(_cfg.get("overload_retry_after_min_s"))
+    # over the hard bound: every tenant sheds
+    p = _StubPool(waiting=bound + 5, weights=weights,
+                  level=L3_SHED_ADMISSION)
+    for tn in ("gold", "bronze"):
+        out = _shed_of(p, tn)
+        assert out is not None and out[0] == "queue_bound"
+
+
+def test_below_l3_never_sheds_regardless_of_queue():
+    p = _StubPool(waiting=10_000, weights={"a": 1.0},
+                  level=L2_SQUEEZE_BULK)
+    assert _shed_of(p, "a") is None
+
+
+def test_shed_raises_typed_and_counts():
+    p = _StubPool(level=L3_SHED_ADMISSION)
+    with pytest.raises(PoolOverloadedError) as ei:
+        LLMPool._shed(p, "bronze", "queue_bound", 2.0,
+                      PoolOverloadedError)
+    assert ei.value.retryable and ei.value.retry_after_s == 2.0
+    assert ei.value.level == L3_SHED_ADMISSION
+
+
+def test_chaos_drop_suppresses_the_shed():
+    """The ``overload.shed`` site's ``drop`` action admits the request
+    anyway — the colocate chaos plan exercises the queue-bound
+    backstop through it."""
+    p = _StubPool(level=L3_SHED_ADMISSION)
+    fi.configure([{"site": "overload.shed", "action": "drop",
+                   "count": 1}])
+    LLMPool._shed(p, "bronze", "queue_bound", 2.0,
+                  PoolOverloadedError)  # no raise: suppressed
+    assert fi.hits() and fi.hits()[0]["site"] == "overload.shed"
+    # the injection is exhausted -> the next shed is real
+    with pytest.raises(PoolOverloadedError):
+        LLMPool._shed(p, "bronze", "queue_bound", 2.0,
+                      PoolOverloadedError)
+
+
+def test_admit_rate_window():
+    p = _StubPool()
+    now = time.monotonic()
+    assert LLMPool._admit_rate_locked(p, now) is None  # cold
+    p.seed_rate(8.0, n=16)
+    rate = LLMPool._admit_rate_locked(p, now)
+    assert rate == pytest.approx(8.0, rel=0.3)
+
+
+# ---------------------------------------------------------------------------
+# chaos-plan determinism (satellite: colocate added, legacy plans frozen)
+# ---------------------------------------------------------------------------
+
+# env_value() captured at the commit BEFORE the colocate profile was
+# added: the soak suites replay these exact seeds, so plan generation
+# must stay byte-identical for every legacy profile.
+GOLDEN_PLANS = {
+    ("train", 1): '[{"action": "delay", "after": 4, "count": 1, "delay_s": 0.079, "match": {"rank": 0}, "site": "collective.send"}]',  # noqa: E501
+    ("train", 2): '[{"action": "exit", "after": 4, "count": 1, "match": {"rank": 0}, "site": "ring.send"}]',  # noqa: E501
+    ("train", 3): '[{"action": "die", "after": 9, "count": 1, "match": {"rank": 1}, "site": "collective.send"}]',  # noqa: E501
+    ("train", 38): '[{"action": "exit", "after": 5, "count": 1, "match": {"rank": 0}, "site": "ring.recv"}, {"action": "drop", "after": 1, "count": 1, "site": "checkpoint.save"}]',  # noqa: E501
+    ("train", 47): '[{"action": "die", "after": 4, "count": 1, "match": {"rank": 1}, "site": "ring.send"}, {"action": "die", "after": 6, "count": 1, "match": {"rank": 0}, "site": "collective.send"}]',  # noqa: E501
+    ("train", 59): '[{"action": "die", "after": 0, "count": 1, "match": {"rank": 1}, "site": "ring.send"}]',  # noqa: E501
+    ("rl", 1): '[{"action": "drop", "after": 4, "count": 1, "match": {"rank": 0}, "site": "ring.send"}]',  # noqa: E501
+    ("rl", 2): '[{"action": "exit", "after": 99, "count": 1, "match": {"engine": "decode-1"}, "site": "serve.replica_pump"}]',  # noqa: E501
+    ("rl", 3): '[{"action": "exit", "after": 9, "count": 1, "match": {"rank": 1}, "site": "ring.send"}]',  # noqa: E501
+    ("rl", 38): '[{"action": "delay", "after": 5, "count": 1, "delay_s": 0.224, "match": {"actor": 0}, "site": "rl.rollout"}, {"action": "delay", "after": 5, "count": 1, "delay_s": 0.132, "match": {"actor": 0}, "site": "rl.rollout"}]',  # noqa: E501
+    ("rl", 47): '[{"action": "exit", "after": 6, "count": 1, "match": {"rank": 0}, "site": "ring.send"}, {"action": "exit", "after": 37, "count": 1, "match": {"engine": "decode-2"}, "site": "serve.replica_pump"}]',  # noqa: E501
+    ("rl", 59): '[{"action": "exit", "after": 7, "count": 1, "match": {"engine": "decode-2"}, "site": "serve.replica_pump"}]',  # noqa: E501
+    ("qos", 1): '[{"action": "delay", "after": 0, "count": 1, "delay_s": 0.114, "site": "object.read_chunk"}]',  # noqa: E501
+    ("qos", 2): '[{"action": "drop", "after": 1, "count": 1, "site": "net.pace"}]',  # noqa: E501
+    ("qos", 3): '[{"action": "drop", "after": 4, "count": 1, "site": "object.read_chunk"}]',  # noqa: E501
+    ("qos", 38): '[{"action": "delay", "after": 0, "count": 1, "delay_s": 0.142, "site": "net.pace"}, {"action": "drop", "after": 5, "count": 1, "site": "object.read_chunk"}]',  # noqa: E501
+    ("qos", 47): '[{"action": "delay", "after": 4, "count": 1, "delay_s": 0.136, "site": "net.pace"}, {"action": "drop", "after": 0, "count": 1, "site": "object.read_chunk"}]',  # noqa: E501
+    ("qos", 59): '[{"action": "delay", "after": 3, "count": 1, "delay_s": 0.056, "site": "net.pace"}]',  # noqa: E501
+    ("pipeline", 1): '[{"action": "drop", "after": 4, "count": 1, "match": {"rank": 0}, "site": "ring.send"}]',  # noqa: E501
+    ("pipeline", 2): '[{"action": "die", "after": 4, "count": 1, "match": {"rank": 0}, "site": "pipeline.stage"}]',  # noqa: E501
+    ("pipeline", 3): '[{"action": "exit", "after": 9, "count": 1, "match": {"rank": 1}, "site": "ring.send"}]',  # noqa: E501
+    ("pipeline", 38): '[{"action": "delay", "after": 5, "count": 1, "delay_s": 0.224, "match": {"rank": 0}, "site": "pipeline.stage"}, {"action": "exit", "after": 9, "count": 1, "match": {"rank": 0}, "site": "pipeline.stage"}]',  # noqa: E501
+    ("pipeline", 47): '[{"action": "exit", "after": 4, "count": 1, "match": {"rank": 1}, "site": "pipeline.stage"}, {"action": "exit", "after": 6, "count": 1, "match": {"rank": 0}, "site": "ring.send"}]',  # noqa: E501
+    ("pipeline", 59): '[{"action": "delay", "after": 0, "count": 1, "delay_s": 0.084, "match": {"rank": 1}, "site": "pipeline.stage"}]',  # noqa: E501
+}
+
+
+def test_legacy_chaos_plans_byte_identical():
+    from ray_tpu._private.chaos import gen_fault_plan
+
+    for (profile, seed), want in GOLDEN_PLANS.items():
+        got = gen_fault_plan(
+            seed, world_size=2, max_faults=2, profile=profile,
+            n_replicas=2, n_prefill=0, n_rollout=1).env_value()
+        assert got == want, (profile, seed)
+
+
+def test_colocate_plans_deterministic_and_scoped():
+    import json
+
+    from ray_tpu._private.chaos import (
+        COLOCATE_SITE_WEIGHTS,
+        gen_fault_plan,
+    )
+
+    sites = set()
+    for seed in range(80):
+        p = gen_fault_plan(seed, world_size=2, max_faults=2,
+                           profile="colocate", n_replicas=2)
+        q = gen_fault_plan(seed, world_size=2, max_faults=2,
+                           profile="colocate", n_replicas=2)
+        assert p.env_value() == q.env_value()
+        for spec in (p.worker_specs + p.driver_specs + p.serve_specs):
+            sites.add(spec["site"])
+    assert sites <= set(COLOCATE_SITE_WEIGHTS)
+    assert "overload.shed" in sites  # the new site is reachable
+    # legacy profiles never draw the new site
+    for profile in ("train", "rl", "qos", "pipeline"):
+        for seed in range(80):
+            assert "overload.shed" not in gen_fault_plan(
+                seed, world_size=2, max_faults=2, profile=profile,
+                n_replicas=2).env_value()
+
+
+def test_overload_shed_routes_to_driver_specs():
+    from ray_tpu._private.chaos import DRIVER_SITES, gen_fault_plan
+
+    assert "overload.shed" in DRIVER_SITES
+    for seed in range(200):
+        p = gen_fault_plan(seed, world_size=2, max_faults=2,
+                           profile="colocate", n_replicas=2)
+        for spec in p.worker_specs + p.serve_specs:
+            assert spec["site"] != "overload.shed"

@@ -57,6 +57,9 @@ def test_gae_shapes_and_values():
     np.testing.assert_allclose(ret, adv + values)
 
 
+@pytest.mark.slow  # learning-improvement soak; PPO update path stays
+# tier-1 via test_rl_learner_group.test_ppo_with_learner_group and the
+# connector-pipeline PPO run in test_rl_sac
 def test_ppo_improves_on_corridor(cluster):
     cfg = PPOConfig(
         env_creator=Corridor,

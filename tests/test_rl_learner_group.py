@@ -56,6 +56,8 @@ def test_gradient_parity_vs_single_learner(cluster):
         group.shutdown()
 
 
+@pytest.mark.slow  # parity stays tier-1 via the even-shard
+# test_gradient_parity_vs_single_learner + test_replicas_stay_identical
 def test_gradient_parity_unequal_shards(cluster):
     """n=65 across 2 learners (33/32 split): row-weighted allreduce must
     still equal the single learner's full-batch update."""

@@ -359,6 +359,9 @@ def _sampled_ref(params, prompt, n, *, temperature, seed):
     return np.asarray(eng.pop_finished(sid).tokens[:n])
 
 
+@pytest.mark.slow  # heaviest failover soak; replica-kill failover stays
+# tier-1 via test_pool_chaos_replica_kill_no_client_visible_error and
+# the spec-on greedy path via the decode-spec unit tests
 def test_pool_replica_kill_failover_spec_sampled_exact(cluster):
     """ISSUE-19 acceptance: kill a decode replica mid-stream with
     speculative decoding ON and sampling ON; the re-queued stream must
